@@ -1,0 +1,28 @@
+"""geomx_tpu — a TPU-native geo-distributed training framework.
+
+A from-scratch reimplementation of the capabilities of GeoMX
+(hierarchical parameter server for multi-datacenter training) designed
+for TPU hardware: JAX/XLA/pjit for the compute path, `jax.lax` collectives
+over ICI for intra-datacenter aggregation, and a host-side hierarchical
+parameter-server runtime for the WAN tier.
+
+Layer map (bottom → top), mirroring the reference architecture
+(see SURVEY.md §1; reference = INET-RC/GeoMX):
+
+- ``transport``  — message fabric (Van): in-proc sim + TCP, fault injection,
+                   priority send queues, DGT multi-channel scheduling.
+- ``ps``         — parameter-server runtime: Postoffice (node table,
+                   barriers), Customer (request tracking), KVWorker/KVServer.
+- ``kvstore``    — the HiPS logic: worker-side dist kvstore, the two-tier
+                   hierarchical server, sync modes (FSA/MixedSync/HFA).
+- ``compression``— wire codecs: FP16, 2-bit quant, Bi-Sparse top-k, MPQ.
+- ``sched``      — P3 priority propagation, TSEngine overlay, DGT.
+- ``parallel``   — TPU mesh parallelism: DP/TP/SP shardings, ring attention.
+- ``models``     — reference workloads (CNN) + flagship transformer.
+- ``optim``      — optimizers including DCASGD.
+- ``utils``      — profiler, metrics, logging.
+"""
+
+__version__ = "0.1.0"
+
+from geomx_tpu.core.config import Config, Role, Topology  # noqa: F401

@@ -1,0 +1,1 @@
+from geomx_tpu.core.config import Config, Role, Topology, NodeId  # noqa: F401

@@ -1,0 +1,332 @@
+"""Role model, topology, and configuration surface.
+
+The reference derives everything from environment variables parsed in
+``Postoffice::InitEnvironment`` (ref: ps-lite/src/postoffice.cc:18-58) and a
+catalog of feature flags (ref: docs/source/env-var-summary.rst).  We mirror
+that surface — every ``DMLC_*`` / ``MXNET_*`` / feature env var has an
+equivalent here — but expose it as a typed dataclass so in-process
+simulations can construct configs directly without env plumbing.
+
+Topology model (ref: README.md:14, postoffice.cc:32-58): the system is a
+set of *parties* (data centers).  Each normal party has one local
+scheduler, one local server, and N workers.  The *central party* has the
+global scheduler, M global servers, plus its own local tier.  A local
+server is simultaneously a SERVER in its party's local domain and a
+"global worker" in the WAN domain (ref: van.h:98 dual node identity).
+
+On TPU, one party = one TPU slice: the party's "workers" are the hosts of
+the slice, intra-party aggregation lowers to ``jax.lax.psum`` over ICI,
+and only the party's local-server process speaks WAN (DCN) to the global
+servers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+from typing import Optional
+
+
+class Role(enum.Enum):
+    """Node roles (ref: ps-lite/include/ps/internal/message.h:74)."""
+
+    WORKER = "worker"
+    SERVER = "server"                    # local server (tier-1 aggregator)
+    SCHEDULER = "scheduler"              # per-party local scheduler
+    GLOBAL_SERVER = "global_server"      # tier-2, runs the optimizer
+    GLOBAL_SCHEDULER = "global_scheduler"
+
+    @property
+    def is_scheduler(self) -> bool:
+        return self in (Role.SCHEDULER, Role.GLOBAL_SCHEDULER)
+
+
+# Node groups for barriers / broadcast targets
+# (ref: ps-lite/include/ps/base.h node-group constants).
+class Group(enum.Flag):
+    NONE = 0
+    WORKERS = enum.auto()          # workers of one party
+    SERVERS = enum.auto()          # the party's local server
+    SCHEDULER = enum.auto()
+    GLOBAL_SERVERS = enum.auto()   # all global servers (WAN domain)
+    GLOBAL_WORKERS = enum.auto()   # all local servers acting as global workers
+    GLOBAL_SCHEDULER = enum.auto()
+    ALL_LOCAL = WORKERS | SERVERS | SCHEDULER
+    ALL_GLOBAL = GLOBAL_SERVERS | GLOBAL_WORKERS | GLOBAL_SCHEDULER
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class NodeId:
+    """Structured node identity.
+
+    The reference packs identity into integer arithmetic (rank*2+8 etc.,
+    ref: ps-lite/include/ps/base.h:36-38, postoffice.h:104-116) and parity
+    tests like ``sender % 2 == 1`` scattered through the server (ref:
+    kvstore_dist_server.h:471,488).  We use a structured id instead; the
+    wire form is its string repr.
+
+    ``party`` is None for WAN-domain-only roles (global scheduler / global
+    servers live in the central party but are addressed domain-wide).
+    """
+
+    role: Role
+    rank: int = 0
+    party: Optional[int] = None
+
+    def __str__(self) -> str:
+        if self.party is None:
+            return f"{self.role.value}:{self.rank}"
+        return f"{self.role.value}:{self.rank}@p{self.party}"
+
+    @staticmethod
+    def parse(s: str) -> "NodeId":
+        party: Optional[int] = None
+        if "@p" in s:
+            s, p = s.split("@p")
+            party = int(p)
+        role, rank = s.split(":")
+        return NodeId(Role(role), int(rank), party)
+
+    @property
+    def is_worker(self) -> bool:
+        return self.role is Role.WORKER
+
+    @property
+    def is_server(self) -> bool:
+        return self.role is Role.SERVER
+
+    @property
+    def is_global_server(self) -> bool:
+        return self.role is Role.GLOBAL_SERVER
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Static cluster shape.
+
+    ref counts: DMLC_NUM_WORKER / DMLC_NUM_SERVER / DMLC_NUM_GLOBAL_SERVER /
+    DMLC_NUM_ALL_WORKER (postoffice.cc:18-58).  The reference enforces one
+    local server per party (postoffice.cc:55-57); we keep that constraint
+    at tier 1 and allow M global servers (MultiGPS, ref: README.md:40).
+    """
+
+    num_parties: int = 1
+    workers_per_party: int = 1
+    num_global_servers: int = 1
+    central_party: int = 0  # which party hosts the global tier
+
+    def __post_init__(self):
+        if self.num_parties < 1 or self.workers_per_party < 1:
+            raise ValueError("need >=1 party and >=1 worker per party")
+        if self.num_global_servers < 1:
+            raise ValueError("need >=1 global server")
+
+    # ---- enumeration helpers -------------------------------------------------
+    def workers(self, party: int):
+        return [NodeId(Role.WORKER, r, party) for r in range(self.workers_per_party)]
+
+    def all_workers(self):
+        return [w for p in range(self.num_parties) for w in self.workers(p)]
+
+    def server(self, party: int) -> NodeId:
+        return NodeId(Role.SERVER, 0, party)
+
+    def servers(self):
+        return [self.server(p) for p in range(self.num_parties)]
+
+    def scheduler(self, party: int) -> NodeId:
+        return NodeId(Role.SCHEDULER, 0, party)
+
+    def global_servers(self):
+        return [NodeId(Role.GLOBAL_SERVER, r) for r in range(self.num_global_servers)]
+
+    def global_scheduler(self) -> NodeId:
+        return NodeId(Role.GLOBAL_SCHEDULER, 0)
+
+    def all_nodes(self):
+        nodes = []
+        for p in range(self.num_parties):
+            nodes.append(self.scheduler(p))
+            nodes.append(self.server(p))
+            nodes.extend(self.workers(p))
+        nodes.append(self.global_scheduler())
+        nodes.extend(self.global_servers())
+        return nodes
+
+    @property
+    def num_workers_total(self) -> int:
+        """ref: DMLC_NUM_ALL_WORKER."""
+        return self.num_parties * self.workers_per_party
+
+    @property
+    def num_global_workers(self) -> int:
+        """Local servers acting as tier-2 pushers (one per party)."""
+        return self.num_parties
+
+    def members(self, group: Group, party: Optional[int] = None):
+        """Resolve a Group flag to concrete node ids.
+
+        Local groups (WORKERS/SERVERS/SCHEDULER) require ``party``.
+        """
+        out = []
+        if group & Group.WORKERS:
+            assert party is not None
+            out += self.workers(party)
+        if group & Group.SERVERS:
+            assert party is not None
+            out.append(self.server(party))
+        if group & Group.SCHEDULER:
+            assert party is not None
+            out.append(self.scheduler(party))
+        if group & Group.GLOBAL_WORKERS:
+            out += self.servers()
+        if group & Group.GLOBAL_SERVERS:
+            out += self.global_servers()
+        if group & Group.GLOBAL_SCHEDULER:
+            out.append(self.global_scheduler())
+        return out
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("", "0", "false", "off", "no")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return default if v is None else int(v)
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return default if v is None else float(v)
+
+
+@dataclasses.dataclass
+class Config:
+    """Full feature-flag / tuning surface.
+
+    Mirrors the reference env catalog (ref: docs/source/env-var-summary.rst),
+    one field per knob.  ``Config.from_env()`` accepts both the GEOMX_*
+    names and the reference's legacy names where one exists.
+    """
+
+    topology: Topology = dataclasses.field(default_factory=Topology)
+
+    # --- sync modes (ref: kvstore.cc:53-63; kvstore_dist_server.h:1918-1919)
+    sync_mode: bool = True          # intra-party tier synchronous
+    sync_global_mode: bool = True   # WAN tier synchronous (False = MixedSync)
+
+    # --- HFA (ref: kvstore_dist_server.h:185-187, env MXNET_KVSTORE_USE_HFA/K1/K2)
+    use_hfa: bool = False
+    hfa_k1: int = 1     # local steps between local syncs (client-side)
+    hfa_k2: int = 1     # local syncs between global syncs (server-side gate)
+
+    # --- compression (ref: gradient_compression.h:38-51, examples/cnn_*.py)
+    compression: str = "none"       # none | fp16 | 2bit | bsc | mpq
+    bsc_ratio: float = 0.01         # Bi-Sparse keep ratio (ref: cnn_bsc.py default)
+    bsc_sample_rate: float = 0.005  # threshold sampling rate (ref: gradient_compression.cc:219)
+    bsc_momentum: float = 0.9       # momentum correction (ref: gradient_compression.cc:197)
+    twobit_threshold: float = 0.5   # pos/neg threshold (ref: gradient_compression.cc:52)
+    mpq_size_bound: int = 200_000   # MPQ small/large split (ref: kvstore_dist_server.h:183)
+
+    # --- sharding (ref: kvstore_dist.h:69 MXNET_KVSTORE_BIGARRAY_BOUND)
+    bigarray_bound: int = 1_000_000
+
+    # --- P3 (ref: van.cc:539-549 ENABLE_P3; kvstore_dist.h:763-799)
+    enable_p3: bool = False
+    p3_slice_elems: int = 0  # 0 → use bigarray_bound as slice size
+
+    # --- TSEngine (ref: kv_app.h:111-112,434-435; van.cc:436-443)
+    enable_intra_ts: bool = False
+    enable_inter_ts: bool = False
+    ts_max_greed_rate: float = 0.9
+
+    # --- DGT (ref: kv_app.h:841-850)
+    enable_dgt: int = 0           # 0 off; 1 UDP-like lossy; 2 reliable; 3 reliable+requant
+    dgt_block_size: int = 4096    # elements per chunk
+    dgt_k: float = 0.5            # initial fraction on the reliable channel
+    dgt_k_min: float = 0.2
+    dgt_adaptive_k: bool = False
+    dgt_udp_channels: int = 3
+    dgt_contrib_alpha: float = 0.3
+
+    # --- fault injection / reliability (ref: van.cc:497-533 PS_DROP_MSG, PS_RESEND)
+    drop_rate: float = 0.0
+    resend_timeout_ms: int = 0    # 0 = resender off
+
+    # --- misc runtime
+    heartbeat_interval_s: float = 0.0   # 0 = off
+    heartbeat_timeout_s: float = 10.0
+    verbose: int = 0
+
+    def __post_init__(self):
+        if not 0.0 <= self.drop_rate <= 1.0:
+            raise ValueError(
+                f"drop_rate must be a fraction in [0,1], got {self.drop_rate} "
+                "(note: the GEOMX_DROP_MSG / PS_DROP_MSG env vars are percents)"
+            )
+
+    @staticmethod
+    def from_env() -> "Config":
+        topo = Topology(
+            num_parties=_env_int("GEOMX_NUM_PARTIES", 1),
+            workers_per_party=_env_int(
+                "GEOMX_WORKERS_PER_PARTY", _env_int("DMLC_NUM_WORKER", 1)
+            ),
+            num_global_servers=_env_int(
+                "GEOMX_NUM_GLOBAL_SERVERS", _env_int("DMLC_NUM_GLOBAL_SERVER", 1)
+            ),
+        )
+        return Config(
+            topology=topo,
+            sync_mode=_env_bool("GEOMX_SYNC", True),
+            sync_global_mode=_env_bool("GEOMX_SYNC_GLOBAL", True),
+            use_hfa=_env_bool("GEOMX_USE_HFA", _env_bool("MXNET_KVSTORE_USE_HFA")),
+            hfa_k1=_env_int("GEOMX_HFA_K1", _env_int("MXNET_KVSTORE_HFA_K1", 1)),
+            hfa_k2=_env_int("GEOMX_HFA_K2", _env_int("MXNET_KVSTORE_HFA_K2", 1)),
+            compression=os.environ.get("GEOMX_COMPRESSION", "none"),
+            bsc_ratio=_env_float("GEOMX_BSC_RATIO", 0.01),
+            mpq_size_bound=_env_int(
+                "GEOMX_MPQ_SIZE_BOUND", _env_int("MXNET_KVSTORE_SIZE_LOWER_BOUND", 200_000)
+            ),
+            bigarray_bound=_env_int(
+                "GEOMX_BIGARRAY_BOUND", _env_int("MXNET_KVSTORE_BIGARRAY_BOUND", 1_000_000)
+            ),
+            enable_p3=_env_bool("GEOMX_ENABLE_P3", _env_bool("ENABLE_P3")),
+            enable_intra_ts=_env_bool("GEOMX_ENABLE_INTRA_TS", _env_bool("ENABLE_INTRA_TS")),
+            enable_inter_ts=_env_bool("GEOMX_ENABLE_INTER_TS", _env_bool("ENABLE_INTER_TS")),
+            ts_max_greed_rate=_env_float("GEOMX_TS_GREED", _env_float("MAX_GREED_RATE_TS", 0.9)),
+            enable_dgt=_env_int("GEOMX_ENABLE_DGT", _env_int("ENABLE_DGT", 0)),
+            dgt_block_size=_env_int("GEOMX_DGT_BLOCK_SIZE", _env_int("DGT_BLOCK_SIZE", 4096)),
+            dgt_k=_env_float("GEOMX_DGT_K", _env_float("DMLC_K", 0.5)),
+            dgt_k_min=_env_float("GEOMX_DGT_K_MIN", _env_float("DMLC_K_MIN", 0.2)),
+            dgt_adaptive_k=_env_bool("GEOMX_DGT_ADAPTIVE", _env_bool("ADAPTIVE_K_FLAG")),
+            dgt_udp_channels=_env_int(
+                "GEOMX_DGT_CHANNELS", _env_int("DMLC_UDP_CHANNEL_NUM", 3)
+            ),
+            dgt_contrib_alpha=_env_float(
+                "GEOMX_DGT_ALPHA", _env_float("DGT_CONTRIBUTION_ALPHA", 0.3)
+            ),
+            bsc_sample_rate=_env_float("GEOMX_BSC_SAMPLE_RATE", 0.005),
+            bsc_momentum=_env_float("GEOMX_BSC_MOMENTUM", 0.9),
+            twobit_threshold=_env_float("GEOMX_2BIT_THRESHOLD", 0.5),
+            p3_slice_elems=_env_int("GEOMX_P3_SLICE", 0),
+            # both names follow the legacy percent convention (PS_DROP_MSG=10
+            # means 10%, ref: van.cc:497-499)
+            drop_rate=_env_float("GEOMX_DROP_MSG", _env_float("PS_DROP_MSG", 0.0)) / 100.0,
+            resend_timeout_ms=_env_int(
+                "GEOMX_RESEND_TIMEOUT_MS",
+                _env_int("PS_RESEND_TIMEOUT", 1000) if _env_bool("PS_RESEND") else 0,
+            ),
+            heartbeat_interval_s=_env_float(
+                "GEOMX_HEARTBEAT_INTERVAL", _env_float("PS_HEARTBEAT_INTERVAL", 0.0)
+            ),
+            heartbeat_timeout_s=_env_float(
+                "GEOMX_HEARTBEAT_TIMEOUT", _env_float("PS_HEARTBEAT_TIMEOUT", 10.0)
+            ),
+            verbose=_env_int("GEOMX_VERBOSE", _env_int("PS_VERBOSE", 0)),
+        )

@@ -1,0 +1,2 @@
+from geomx_tpu.transport.message import Message, Control, Domain  # noqa: F401
+from geomx_tpu.transport.van import Van, InProcFabric, FaultPolicy  # noqa: F401

@@ -1,0 +1,194 @@
+"""Wire message format.
+
+Mirrors the information content of the reference's ``Meta`` / ``Message``
+(ref: ps-lite/include/ps/internal/message.h:160-290 and the protobuf wire
+form meta.proto:34-80) including the DGT chunk fields (message.h:237-251),
+but as a plain dataclass carrying numpy arrays.  The in-proc fabric passes
+it by reference (zero-copy); the TCP van serializes it with a small binary
+header + raw array bytes (no pickle on the data path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import io
+import pickle
+import struct
+from typing import Any, Optional
+
+import numpy as np
+
+from geomx_tpu.core.config import NodeId
+
+
+class Control(enum.Enum):
+    """Control message types (ref: message.h:125-137)."""
+
+    EMPTY = 0          # data message
+    TERMINATE = 1
+    ADD_NODE = 2
+    BARRIER = 3
+    ACK = 4
+    HEARTBEAT = 5
+    # TSEngine control plane (ref: message.h:135-136)
+    ASK_PULL = 6       # node asks scheduler who to relay pull-model to
+    ASK_PUSH = 7       # node asks scheduler for a push-merge pairing
+    REPLY = 8          # scheduler's answer
+    AUTOPULL_REPLY = 9 # receiver confirms overlay delivery
+
+
+class Domain(enum.Enum):
+    """Which communication domain a message travels in.
+
+    The reference keeps two sockets/threads per dual-role node — local and
+    global (ref: van.h:98, van.cc:557-671).  We tag messages instead; the
+    fabric routes on (recipient, domain) so a local server's two identities
+    share one mailbox but can be distinguished by handlers.
+    """
+
+    LOCAL = 0
+    GLOBAL = 1
+
+
+@dataclasses.dataclass
+class Message:
+    sender: NodeId = None  # type: ignore[assignment]
+    recipient: NodeId = None  # type: ignore[assignment]
+    control: Control = Control.EMPTY
+    domain: Domain = Domain.LOCAL
+
+    # request/response tracking (ref: message.h Meta
+    # {head, app_id, customer_id, timestamp, request, push, pull})
+    app_id: int = 0
+    customer_id: int = 0
+    timestamp: int = -1          # request id issued by Customer
+    request: bool = False
+    push: bool = False
+    pull: bool = False
+    cmd: int = 0                 # server dispatch word
+    priority: int = 0            # P3 / engine priority; higher = sooner
+    body: Any = None             # control payload (python object)
+
+    # data plane
+    keys: Optional[np.ndarray] = None   # int64 key ids
+    vals: Optional[np.ndarray] = None   # flat payload
+    lens: Optional[np.ndarray] = None   # per-key value lengths
+
+    # DGT chunk fields (ref: message.h:237-251, meta.proto:60-79)
+    first_key: int = -1
+    seq: int = -1
+    seq_begin: int = -1
+    seq_end: int = -1
+    channel: int = 0             # 0 = reliable; >=1 = lossy priority channels
+    total_bytes: int = 0
+    val_bytes: int = 0
+    compr: str = ""              # codec tag applied to vals ("", "fp16", "2bit", "bsc")
+
+    # resender bookkeeping (ref: resender.h)
+    msg_sig: int = -1
+
+    _nbytes_cache: Optional[int] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    @property
+    def nbytes(self) -> int:
+        """Approximate wire size, for WAN-byte accounting (ref: van.h:180-181).
+
+        Cached: accounting calls this on every send/recv/retransmit and the
+        body pickle would otherwise be recomputed each time.
+        """
+        if self._nbytes_cache is None:
+            n = 64  # meta overhead
+            for a in (self.keys, self.vals, self.lens):
+                if a is not None:
+                    n += a.nbytes
+            if self.body is not None:
+                n += len(pickle.dumps(self.body, protocol=4))
+            self._nbytes_cache = n
+        return self._nbytes_cache
+
+    def reply_to(self, **overrides) -> "Message":
+        """Build a response message addressed back to the sender."""
+        kw = dict(
+            sender=self.recipient,
+            recipient=self.sender,
+            control=self.control,
+            domain=self.domain,
+            app_id=self.app_id,
+            customer_id=self.customer_id,
+            timestamp=self.timestamp,
+            request=False,
+            push=self.push,
+            pull=self.pull,
+            cmd=self.cmd,
+        )
+        kw.update(overrides)
+        return Message(**kw)
+
+    # ---- binary serialization (for the TCP van) -----------------------------
+    _HDR = struct.Struct("<B B i i q B B B i i q q q q q B q q")
+
+    def to_bytes(self) -> bytes:
+        buf = io.BytesIO()
+        meta = {
+            "sender": str(self.sender) if self.sender else "",
+            "recipient": str(self.recipient) if self.recipient else "",
+            "body": self.body,
+            "compr": self.compr,
+        }
+        meta_b = pickle.dumps(meta, protocol=4)
+        flags = (self.request << 0) | (self.push << 1) | (self.pull << 2)
+        arrs = []
+        for a in (self.keys, self.vals, self.lens):
+            if a is None:
+                arrs.append(b"")
+            else:
+                with io.BytesIO() as ab:
+                    np.save(ab, a, allow_pickle=False)
+                    arrs.append(ab.getvalue())
+        hdr = self._HDR.pack(
+            self.control.value, self.domain.value, self.app_id, self.customer_id,
+            self.timestamp, flags, 0, 0, self.cmd, self.priority,
+            self.first_key, self.seq, self.seq_begin, self.seq_end,
+            self.total_bytes, self.channel, self.val_bytes, self.msg_sig,
+        )
+        buf.write(struct.pack("<i", len(hdr)))
+        buf.write(hdr)
+        for blob in (meta_b, *arrs):
+            buf.write(struct.pack("<q", len(blob)))
+            buf.write(blob)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Message":
+        off = 0
+        (hlen,) = struct.unpack_from("<i", data, off); off += 4
+        fields = cls._HDR.unpack_from(data, off); off += hlen
+        (control, domain, app_id, customer_id, timestamp, flags, _, _, cmd,
+         priority, first_key, seq, seq_begin, seq_end, total_bytes, channel,
+         val_bytes, msg_sig) = fields
+        blobs = []
+        for _ in range(4):
+            (blen,) = struct.unpack_from("<q", data, off); off += 8
+            blobs.append(data[off:off + blen]); off += blen
+        meta = pickle.loads(blobs[0])
+        arrs = []
+        for blob in blobs[1:]:
+            if not blob:
+                arrs.append(None)
+            else:
+                arrs.append(np.load(io.BytesIO(blob), allow_pickle=False))
+        return cls(
+            sender=NodeId.parse(meta["sender"]) if meta["sender"] else None,
+            recipient=NodeId.parse(meta["recipient"]) if meta["recipient"] else None,
+            control=Control(control), domain=Domain(domain), app_id=app_id,
+            customer_id=customer_id, timestamp=timestamp,
+            request=bool(flags & 1), push=bool(flags & 2), pull=bool(flags & 4),
+            cmd=cmd, priority=priority, body=meta["body"],
+            keys=arrs[0], vals=arrs[1], lens=arrs[2],
+            first_key=first_key, seq=seq, seq_begin=seq_begin, seq_end=seq_end,
+            channel=channel, total_bytes=total_bytes, val_bytes=val_bytes,
+            compr=meta["compr"], msg_sig=msg_sig,
+        )

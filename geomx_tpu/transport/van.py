@@ -1,0 +1,334 @@
+"""The Van: message fabric with fault injection and priority scheduling.
+
+The reference Van (ref: ps-lite/src/van.cc, include/ps/internal/van.h:57-128)
+owns sockets, receiver threads, a priority send queue (P3), DGT channel
+scheduler threads, ACK/resend, and byte accounting.  Here the same
+responsibilities are split:
+
+- ``InProcFabric``  — the "network": mailbox per node, programmable loss /
+  latency / per-channel drop (the PS_DROP_MSG equivalent, ref:
+  van.cc:497-499,871-877), used by tests and single-host simulation of a
+  multi-party deployment (the reference tests the same way via
+  pseudo-distributed scripts, ref: docs/source/pseudo-distributed-deployment.rst).
+- ``TcpFabric`` (transport/tcp.py) — real sockets for multi-host runs.
+- ``Van``           — per-node endpoint: send/recv threads, priority queue
+  drain (ref: van.cc:851-860), ACK/resend (ref: resender.h), byte counters
+  (ref: van.h:180-181 send_bytes_/recv_bytes_).
+"""
+
+from __future__ import annotations
+
+import collections
+import heapq
+import itertools
+import queue
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from geomx_tpu.core.config import Config, NodeId
+from geomx_tpu.transport.message import Control, Domain, Message
+
+
+class FaultPolicy:
+    """Programmable message loss & latency.
+
+    ``drop_rate`` applies to reliable-channel messages (channel 0);
+    ``channel_drop_rate`` to DGT's lossy channels (>=1).  Latency is a
+    fixed delay or a callable ``(msg) -> seconds``; WAN (GLOBAL domain)
+    latency can be set separately to model the DC/WAN asymmetry.
+    """
+
+    def __init__(
+        self,
+        drop_rate: float = 0.0,
+        channel_drop_rate: float = 0.0,
+        latency_s: float = 0.0,
+        wan_latency_s: Optional[float] = None,
+        seed: int = 0,
+    ):
+        self.drop_rate = drop_rate
+        self.channel_drop_rate = channel_drop_rate
+        self.latency_s = latency_s
+        self.wan_latency_s = wan_latency_s if wan_latency_s is not None else latency_s
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def should_drop(self, msg: Message) -> bool:
+        if msg.control is not Control.EMPTY:
+            return False  # never drop control traffic in sim
+        rate = self.channel_drop_rate if msg.channel >= 1 else self.drop_rate
+        if rate <= 0.0:
+            return False
+        with self._lock:
+            return self._rng.random() < rate
+
+    def latency(self, msg: Message) -> float:
+        return self.wan_latency_s if msg.domain is Domain.GLOBAL else self.latency_s
+
+    @classmethod
+    def from_config(cls, config: Config, seed: int = 0) -> "FaultPolicy":
+        """Honor the PS_DROP_MSG-equivalent knob (ref: van.cc:497-499)."""
+        return cls(drop_rate=config.drop_rate, seed=seed)
+
+
+class _Mailbox:
+    def __init__(self):
+        self.q: "queue.Queue[Message]" = queue.Queue()
+
+
+class InProcFabric:
+    """In-process network: one mailbox per node + a delayed-delivery thread."""
+
+    def __init__(
+        self,
+        fault: Optional[FaultPolicy] = None,
+        config: Optional[Config] = None,
+    ):
+        if fault is None:
+            fault = FaultPolicy.from_config(config) if config else FaultPolicy()
+        self.fault = fault
+        self._boxes: Dict[str, _Mailbox] = {}
+        self._lock = threading.Lock()
+        self._heap = []  # (due, tiebreak, msg)
+        self._tie = itertools.count()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._timer: Optional[threading.Thread] = None
+        self.dropped = 0  # observability for loss-injection tests
+
+    def register(self, node: NodeId) -> _Mailbox:
+        with self._lock:
+            box = self._boxes.setdefault(str(node), _Mailbox())
+        return box
+
+    def deliver(self, msg: Message) -> bool:
+        """Route to the recipient mailbox. Returns False if dropped."""
+        if self.fault.should_drop(msg):
+            self.dropped += 1
+            return False
+        delay = self.fault.latency(msg)
+        if delay <= 0.0:
+            self._put(msg)
+        else:
+            with self._cv:
+                if self._timer is None:
+                    self._timer = threading.Thread(
+                        target=self._timer_loop, name="fabric-timer", daemon=True
+                    )
+                    self._timer.start()
+                heapq.heappush(self._heap, (time.monotonic() + delay, next(self._tie), msg))
+                self._cv.notify()
+        return True
+
+    def _put(self, msg: Message):
+        with self._lock:
+            box = self._boxes.get(str(msg.recipient))
+        if box is None:
+            raise KeyError(f"no mailbox for {msg.recipient}")
+        box.q.put(msg)
+
+    def _timer_loop(self):
+        while True:
+            with self._cv:
+                while not self._heap and not self._stop:
+                    self._cv.wait(timeout=0.5)
+                    if self._stop:
+                        return
+                if self._stop:
+                    return
+                due, _, msg = self._heap[0]
+                now = time.monotonic()
+                if due > now:
+                    self._cv.wait(timeout=due - now)
+                    continue
+                heapq.heappop(self._heap)
+            try:
+                self._put(msg)
+            except KeyError:
+                # an unregistered recipient must not kill the shared timer
+                # thread and stall every other delayed delivery
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "dropping delayed message to unknown node %s", msg.recipient
+                )
+
+    def shutdown(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+
+
+class Van:
+    """Per-node transport endpoint.
+
+    ``send`` either delivers directly or routes through the priority send
+    queue (dedicated drain thread, ordered by ``msg.priority`` — ref:
+    threadsafe_queue.h:49-58, van.cc:851-860) so that under P3 shallow
+    layers jump the line.  A background receive thread dispatches every
+    inbound message to the registered receiver callback.
+    """
+
+    def __init__(
+        self,
+        node: NodeId,
+        fabric: InProcFabric,
+        config: Optional[Config] = None,
+        use_priority_queue: bool = False,
+    ):
+        self.node = node
+        self.fabric = fabric
+        self.config = config or Config()
+        self._box = fabric.register(node)
+        self._receiver: Optional[Callable[[Message], None]] = None
+        self._recv_thread: Optional[threading.Thread] = None
+        self._send_thread: Optional[threading.Thread] = None
+        self._pq: "queue.PriorityQueue" = queue.PriorityQueue()
+        self._pq_tie = itertools.count()
+        self.use_priority_queue = use_priority_queue
+        self._running = False
+        # byte accounting (ref: van.h:180-181); wan_* counts GLOBAL-domain only
+        self.send_bytes = 0
+        self.recv_bytes = 0
+        self.wan_send_bytes = 0
+        self.wan_recv_bytes = 0
+        self._stats_lock = threading.Lock()
+        # resender state (ref: resender.h:15-141).  Dedup keys are
+        # (sender, sig) so per-sender counters can't collide; the window is
+        # bounded like the reference's rotating dedup cache.
+        self._resend_timeout = (self.config.resend_timeout_ms or 0) / 1000.0
+        # sig -> [msg, last_send_monotonic, num_retry]; backoff & retry cap
+        # mirror the reference (ref: resender.h Entry{msg, send, num_retry})
+        self._pending_acks: Dict[int, list] = {}
+        self._max_retries = 20
+        self._seen_sigs: set = set()
+        self._seen_order: "collections.deque" = collections.deque()
+        self._seen_cap = 100_000
+        self._sig_counter = itertools.count(1)
+        self._resend_thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ----------------------------------------------------------
+    def start(self, receiver: Callable[[Message], None]):
+        self._receiver = receiver
+        self._running = True
+        self._recv_thread = threading.Thread(
+            target=self._recv_loop, name=f"van-recv-{self.node}", daemon=True
+        )
+        self._recv_thread.start()
+        if self.use_priority_queue:
+            self._send_thread = threading.Thread(
+                target=self._send_loop, name=f"van-send-{self.node}", daemon=True
+            )
+            self._send_thread.start()
+        if self._resend_timeout > 0:
+            self._resend_thread = threading.Thread(
+                target=self._resend_loop, name=f"van-resend-{self.node}", daemon=True
+            )
+            self._resend_thread.start()
+
+    def stop(self):
+        self._running = False
+        stopper = Message(sender=self.node, recipient=self.node, control=Control.TERMINATE)
+        self._box.q.put(stopper)
+        if self.use_priority_queue:
+            self._pq.put((0, next(self._pq_tie), None))
+        if self._recv_thread:
+            self._recv_thread.join(timeout=5)
+
+    # ---- send path ----------------------------------------------------------
+    def send(self, msg: Message, priority: Optional[int] = None):
+        msg.sender = self.node
+        if priority is not None:
+            msg.priority = priority
+        if self.use_priority_queue and msg.control is Control.EMPTY:
+            # negative: PriorityQueue pops smallest first, we want highest first
+            self._pq.put((-msg.priority, next(self._pq_tie), msg))
+        else:
+            self._send_now(msg)
+
+    def _send_now(self, msg: Message):
+        if self._resend_timeout > 0 and msg.control is Control.EMPTY:
+            if msg.msg_sig < 0:
+                msg.msg_sig = next(self._sig_counter)
+            self._pending_acks[msg.msg_sig] = [msg, time.monotonic(), 0]
+        self._account_send(msg)
+        self.fabric.deliver(msg)
+
+    def _account_send(self, msg: Message):
+        n = msg.nbytes
+        with self._stats_lock:
+            self.send_bytes += n
+            if msg.domain is Domain.GLOBAL:
+                self.wan_send_bytes += n
+
+    def _send_loop(self):
+        while self._running:
+            _, _, msg = self._pq.get()
+            if msg is None:
+                return
+            self._send_now(msg)
+
+    # ---- receive path -------------------------------------------------------
+    def _recv_loop(self):
+        while self._running:
+            msg = self._box.q.get()
+            if msg.control is Control.TERMINATE and msg.sender == self.node:
+                return
+            n = msg.nbytes
+            with self._stats_lock:
+                self.recv_bytes += n
+                if msg.domain is Domain.GLOBAL:
+                    self.wan_recv_bytes += n
+            if msg.control is Control.ACK:
+                self._pending_acks.pop(msg.msg_sig, None)
+                continue
+            # ACK + dedup keyed on the *sender's* resender being active (it
+            # stamped msg_sig) — never on this receiver's own config.
+            if msg.msg_sig >= 0 and msg.control is Control.EMPTY:
+                ack = Message(
+                    sender=self.node, recipient=msg.sender, control=Control.ACK,
+                    domain=msg.domain, msg_sig=msg.msg_sig,
+                )
+                self._account_send(ack)
+                self.fabric.deliver(ack)
+                dedup_key = (str(msg.sender), msg.msg_sig)
+                if dedup_key in self._seen_sigs:
+                    continue  # duplicate suppression (ref: resender.h:60-77)
+                self._seen_sigs.add(dedup_key)
+                self._seen_order.append(dedup_key)
+                if len(self._seen_order) > self._seen_cap:
+                    self._seen_sigs.discard(self._seen_order.popleft())
+            try:
+                self._receiver(msg)
+            except Exception:  # pragma: no cover - surfaced by tests via logs
+                import traceback
+
+                traceback.print_exc()
+
+    def _resend_loop(self):
+        while self._running:
+            time.sleep(self._resend_timeout / 2)
+            now = time.monotonic()
+            for sig, entry in list(self._pending_acks.items()):
+                if not self._running:
+                    return
+                msg, last_send, num_retry = entry
+                # exponential-ish backoff like the reference:
+                # timeout * (1 + num_retry)  (ref: resender.h)
+                if now - last_send < self._resend_timeout * (1 + num_retry):
+                    continue
+                if num_retry >= self._max_retries:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "giving up on message sig=%s to %s after %d retries",
+                        sig, msg.recipient, num_retry,
+                    )
+                    self._pending_acks.pop(sig, None)
+                    continue
+                entry[1] = now
+                entry[2] = num_retry + 1
+                self._account_send(msg)  # retransmits are real wire bytes
+                self.fabric.deliver(msg)
