@@ -1,0 +1,1 @@
+from geomx_tpu.data.synthetic import synthetic_classification, ShardedIterator  # noqa: F401

@@ -1,0 +1,4 @@
+from geomx_tpu.kvstore.common import Cmd, Ctrl, APP_PS  # noqa: F401
+from geomx_tpu.kvstore.client import WorkerKVStore  # noqa: F401
+from geomx_tpu.kvstore.server import LocalServer, GlobalServer  # noqa: F401
+from geomx_tpu.kvstore.sim import Simulation  # noqa: F401

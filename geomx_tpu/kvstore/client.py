@@ -1,0 +1,184 @@
+"""Worker-side distributed kvstore client.
+
+Mirrors the worker API of the reference (ref: python/mxnet/kvstore.py:99-661
+KVStore.{init,push,pull,set_optimizer,set_gradient_compression,rank,
+num_workers,_barrier}; C++ side src/kvstore/kvstore_dist.h:460-528 Push_,
+:355-414 PullImpl).  Values are numpy arrays on the host; the JAX training
+step hands gradients off at the slice edge (device→host), and pulls flow
+back host→device — see geomx_tpu.parallel for the on-TPU side.
+
+Tensors are encoded into ps keys with the shared KeyPlan (keys.py) so that
+the same keys shard across global servers (MultiGPS).  Per-tensor
+``priority`` (the reference passes ``priority=-idx``, ref examples/cnn.py:121)
+orders sends under P3's priority queue.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from geomx_tpu.core.config import Config, Group, NodeId
+from geomx_tpu.kvstore.common import APP_PS, Cmd, Ctrl
+from geomx_tpu.kvstore.keys import KeyPlan
+from geomx_tpu.ps import KVPairs, KVWorker, Postoffice
+from geomx_tpu.ps.postoffice import split_range
+from geomx_tpu.transport.message import Domain
+
+
+class WorkerKVStore:
+    def __init__(self, postoffice: Postoffice, config: Optional[Config] = None):
+        self.po = postoffice
+        self.config = config or postoffice.config
+        topo = postoffice.topology
+        assert postoffice.node.is_worker
+        self.rank = postoffice.node.rank
+        self.party = postoffice.node.party
+        self.num_workers = topo.workers_per_party        # in my party
+        self.num_all_workers = topo.num_workers_total    # ref: GetAllWorkerSize
+        slice_elems = 0
+        if self.config.enable_p3:
+            slice_elems = self.config.p3_slice_elems or self.config.bigarray_bound
+        self.plan = KeyPlan(
+            num_shards=topo.num_global_servers,
+            bigarray_bound=self.config.bigarray_bound,
+            slice_elems=slice_elems,
+        )
+        self.worker = KVWorker(
+            APP_PS, 1 + self.rank, postoffice,
+            targets=[topo.server(self.party)],
+            key_ranges=split_range(1),
+            domain=Domain.LOCAL,
+        )
+        self._shapes: Dict[int, tuple] = {}
+        self._dtypes: Dict[int, np.dtype] = {}
+        self._pending: List[int] = []
+        self._last_push_ts: Dict[int, int] = {}
+        self._mu = threading.Lock()
+
+    # ---- helpers ------------------------------------------------------------
+    def _encode(self, tid: int, flat: np.ndarray, priority: int = 0) -> KVPairs:
+        parts = sorted(self.plan.parts(tid, flat.size, priority),
+                       key=lambda p: p.ps_key)
+        keys = np.array([p.ps_key for p in parts], dtype=np.int64)
+        vals = np.concatenate([flat[p.start:p.start + p.length] for p in parts])
+        lens = np.array([p.length for p in parts], dtype=np.int64)
+        return KVPairs(keys, vals, lens)
+
+    def _decode(self, tid: int, kvs: KVPairs) -> np.ndarray:
+        size = int(np.prod(self._shapes[tid])) if self._shapes[tid] else 1
+        parts = {p.ps_key: p for p in self.plan.parts(tid, size)}
+        out = np.empty(size, dtype=np.float32)
+        for k, v in kvs.slices():
+            p = parts[k]
+            out[p.start:p.start + p.length] = v
+        return out.reshape(self._shapes[tid]).astype(self._dtypes[tid])
+
+    def _track(self, ts: int):
+        with self._mu:
+            self._pending.append(ts)
+
+    # ---- public API ---------------------------------------------------------
+    def init(self, tid: int, value: np.ndarray, barrier: bool = False):
+        """Initialize a tensor. Call on every worker; rank-0 of each party
+        does the actual send (ref: kvstore_dist.h:300-330 InitImpl — only
+        rank 0 pushes init, others wait on barrier).
+
+        Unlike the reference (where each worker is an OS process and
+        InitImpl always barriers), the barrier is opt-in: single-threaded
+        simulations drive all workers from one thread and must skip it;
+        threaded/multi-process workers should pass ``barrier=True``."""
+        value = np.asarray(value)
+        self._shapes[tid] = value.shape
+        self._dtypes[tid] = value.dtype
+        if self.rank == 0:
+            flat = value.astype(np.float32).ravel()
+            self.worker.zpush(self._encode(tid, flat), cmd=Cmd.INIT, wait=True)
+        if barrier:
+            self.barrier()
+
+    def push(self, tid: int, grad: np.ndarray, priority: int = 0) -> int:
+        """Async push of a gradient (ref: kvstore_dist.h:460-528)."""
+        flat = np.asarray(grad).astype(np.float32).ravel()
+        ts = self.worker.zpush(self._encode(tid, flat, priority),
+                               cmd=Cmd.DEFAULT, priority=priority)
+        with self._mu:
+            self._last_push_ts[tid] = ts
+        self._track(ts)
+        return ts
+
+    def pull(self, tid: int, cb: Callable[[int, np.ndarray], None],
+             priority: int = 0) -> int:
+        """Async pull; cb(tid, tensor) runs when all shards arrived
+        (ref: kvstore_dist.h:355-414 PullImpl)."""
+        size = int(np.prod(self._shapes[tid])) if self._shapes[tid] else 1
+        keys = [p.ps_key for p in self.plan.parts(tid, size)]
+        with self._mu:
+            after = self._last_push_ts.get(tid)
+        ts = self.worker.zpull(
+            keys, cb=lambda kvs: cb(tid, self._decode(tid, kvs)),
+            cmd=Cmd.DEFAULT, priority=priority, after_ts=after,
+        )
+        self._track(ts)
+        return ts
+
+    def pull_sync(self, tid: int, priority: int = 0) -> np.ndarray:
+        out: Dict[int, np.ndarray] = {}
+        ts = self.pull(tid, lambda t, arr: out.__setitem__(t, arr), priority)
+        self.worker.wait(ts)
+        return out[tid]
+
+    def wait_all(self):
+        """Drain every outstanding push/pull (ref: kvstore.py _wait semantics)."""
+        with self._mu:
+            pending, self._pending = self._pending, []
+        for ts in pending:
+            self.worker.wait(ts)
+
+    def barrier(self, is_global: bool = False):
+        """Party-wide (workers+server) or WAN-wide barrier
+        (ref: kvstore_dist.h:207-210 Barrier(is_global))."""
+        if is_global:
+            self.po.barrier(Group.GLOBAL_SERVERS | Group.GLOBAL_WORKERS)
+        else:
+            self.po.barrier(Group.WORKERS)
+
+    # ---- control plane (master-worker commands) -----------------------------
+    def set_optimizer(self, opt_config: dict):
+        """Ship the optimizer to every global server (ref:
+        kvstore.py:452-499 set_optimizer pickles to the servers)."""
+        for gs in self.po.topology.global_servers():
+            self.worker.send_cmd(gs, Ctrl.SET_OPTIMIZER, body=opt_config,
+                                 domain=Domain.GLOBAL)
+
+    def set_sync_mode(self, local_sync: bool = True, global_sync: bool = True):
+        """ref: kvstore.cc:53-63 — rank-0 worker sends kSyncMode, master
+        worker sends kSyncGlobalMode."""
+        self.worker.send_cmd(self.po.topology.server(self.party),
+                             Ctrl.SET_SYNC_MODE, body={"sync": local_sync})
+        for gs in self.po.topology.global_servers():
+            self.worker.send_cmd(gs, Ctrl.SET_SYNC_GLOBAL_MODE,
+                                 body={"sync": global_sync}, domain=Domain.GLOBAL)
+
+    def set_gradient_compression(self, comp_config: dict):
+        """ref: kvstore.py set_gradient_compression → kSetGradientCompression."""
+        reply = self.worker.send_cmd(self.po.topology.server(self.party),
+                                     Ctrl.SET_COMPRESSION, body=comp_config)
+        if isinstance(reply, dict) and "error" in reply:
+            raise ValueError(reply["error"])
+
+    def set_hfa(self, enabled: bool, k2: int = 1):
+        self.worker.send_cmd(self.po.topology.server(self.party),
+                             Ctrl.SET_HFA, body={"enabled": enabled, "k2": k2})
+
+    def server_stats(self) -> dict:
+        """WAN byte counters from my local server (observability,
+        ref: van.h:180-181 byte counters; kv.get_num_dead_node-style query)."""
+        return self.worker.send_cmd(
+            self.po.topology.server(self.party), Ctrl.QUERY_STATS
+        ) or {}
+
+    def stop(self):
+        self.worker.stop()

@@ -1,0 +1,38 @@
+"""Shared kvstore constants: app ids, data commands, control commands.
+
+The reference multiplexes request types and dtypes into one cmd word via
+Cantor pairing (ref: kvstore_dist_server.h:82-104) and sends runtime
+control through CommandType (ref: kvstore_dist_server.h:49-52,
+kvstore.cc:53-63).  We keep data commands and control heads as two small
+enums; dtype travels with the numpy array itself.
+"""
+
+import enum
+
+APP_PS = 0  # the parameter-server app id
+
+
+class Cmd(enum.IntEnum):
+    """Data-message commands (ref: RequestType kvstore_dist_server.h:54-56)."""
+
+    DEFAULT = 0       # gradient push / weight pull
+    INIT = 1          # initial weight push
+    HFA_DELTA = 2     # HFA milestone-delta push (applied additively, no
+                      # optimizer — ref: HandleHFAAccumulate
+                      # kvstore_dist_server.h:959-972)
+
+
+class Ctrl(enum.IntEnum):
+    """Control heads on the command channel (ref: CommandType
+    kvstore_dist_server.h:49-52 kController/kSetMultiPrecision/
+    kStopServer/kSyncMode/kSetGradientCompression/kSetProfilerParams,
+    kvstore.cc:53-63 kSyncGlobalMode)."""
+
+    SET_OPTIMIZER = 10
+    SET_SYNC_MODE = 11         # body: {"sync": bool}
+    SET_SYNC_GLOBAL_MODE = 12  # body: {"sync": bool}
+    SET_COMPRESSION = 13       # body: {"type": "bsc"|"2bit"|"fp16"|"mpq", ...}
+    SET_HFA = 14               # body: {"enabled": bool, "k2": int}
+    STOP_SERVER = 15
+    PROFILER = 16              # body: {"action": "config"|"state"|"pause"|"dump", ...}
+    QUERY_STATS = 17           # body: None → reply {"wan_send_bytes": ..., ...}

@@ -1,0 +1,476 @@
+"""Hierarchical Parameter Server: local (tier-1) and global (tier-2) servers.
+
+This replaces the reference's single 2000-line handler class
+(ref: src/kvstore/kvstore_dist_server.h) with explicit per-key state
+machines, as SURVEY.md §7 mandates.  The FSA data flow it implements
+(ref call stack: kvstore_dist_server.h:1213-1366, 899-957, 974-1169):
+
+  worker push ──► LocalServer: accumulate; ack worker immediately
+      when all party workers pushed:
+        merged gradient ──► zpush to global shards  [WAN]
+        all global ACKs  ──► zpull updated weights  [WAN]
+        pull response    ──► store; serve parked worker pulls
+  worker pull ──► served from store when no round is in flight,
+                  else parked (the reference spins on initialized_,
+                  ref :1721-1723 — we park event-driven instead)
+
+  GlobalServer: accumulate pushes from local servers; when all
+  num_global_workers arrived → run optimizer → respond the parked
+  pushes (the ACK is the "update done" signal, ref :1302-1319).
+  Async mode (MixedSync): update per push immediately, DCASGD optional
+  (ref :1519-1698).
+
+Compression: configured via Ctrl.SET_COMPRESSION like the reference's
+kSetGradientCompression; until the geomx_tpu.compression codecs are wired
+into the push-up/pull-down paths, non-"none" types are rejected loudly.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from geomx_tpu.core.config import Config, Group, NodeId, Topology
+from geomx_tpu.kvstore.common import APP_PS, Cmd, Ctrl
+from geomx_tpu.optim import DCASGD, ServerOptimizer, Sgd, make_optimizer
+from geomx_tpu.ps import KVPairs, KVServer, KVWorker, Postoffice
+from geomx_tpu.ps.postoffice import split_range
+from geomx_tpu.transport.message import Domain, Message
+
+
+class _KeyState:
+    """Per-ps-key aggregation state on the local server."""
+
+    __slots__ = ("accum", "count", "parked_pulls", "in_flight", "version", "round")
+
+    def __init__(self):
+        self.accum: Optional[np.ndarray] = None
+        self.count = 0
+        self.parked_pulls: List[Message] = []
+        self.in_flight = False   # a round is between first-push and weights-back
+        self.version = 0         # completed rounds (local or global)
+        self.round = 0           # completed aggregation rounds (HFA K2 gate)
+
+
+class LocalServer:
+    """Tier-1 aggregator; dual identity: KVServer to its party's workers
+    (LOCAL domain) + KVWorker toward the global servers (GLOBAL domain)
+    (ref: dual node identity van.h:98, postoffice.cc:40)."""
+
+    def __init__(self, postoffice: Postoffice, config: Optional[Config] = None):
+        self.po = postoffice
+        self.config = config or postoffice.config
+        topo = postoffice.topology
+        self.num_workers = topo.workers_per_party
+        self.store: Dict[int, np.ndarray] = {}
+        self._keys: Dict[int, _KeyState] = {}
+        self._mu = threading.RLock()
+        self.server = KVServer(APP_PS, 0, postoffice, self._handle)
+        self.server.cmd_handler = self._on_cmd
+        # the "global worker" half (ref: kvstore_dist_server.h uses the
+        # server's own KVWorker toward tier 2)
+        self.up = KVWorker(
+            APP_PS, 1, postoffice,
+            targets=topo.global_servers(),
+            key_ranges=split_range(topo.num_global_servers),
+            domain=Domain.GLOBAL,
+        )
+        self.sync_mode = self.config.sync_mode
+        # HFA (ref: kvstore_dist_server.h:185-187,1324-1343).  In HFA mode
+        # workers push *mean weights* (not gradients); every k2-th round the
+        # milestone delta (merged - milestone)/num_global_workers crosses
+        # the WAN and is applied additively at tier 2.
+        self.hfa_enabled = self.config.use_hfa
+        self.hfa_k2 = self.config.hfa_k2
+        self._milestone: Dict[int, np.ndarray] = {}
+        self.compression: dict = {"type": "none"}
+
+    # ---- request handling ---------------------------------------------------
+    def _handle(self, msg: Message, kvs: Optional[KVPairs], server: KVServer):
+        if msg.cmd == Cmd.INIT:
+            self._handle_init(msg, kvs)
+        elif msg.push:
+            self._handle_push(msg, kvs)
+        elif msg.pull:
+            self._handle_pull(msg, kvs)
+
+    def _handle_init(self, msg: Message, kvs: KVPairs):
+        with self._mu:
+            fresh = []
+            for k, v in kvs.slices():
+                if k not in self.store:
+                    self.store[k] = np.array(v, copy=True)
+                    self._milestone[k] = np.array(v, copy=True)
+                    st = self._keys.setdefault(k, _KeyState())
+                    fresh.append((k, v))
+            # pulls that raced ahead of init can be servable now
+            for k, _ in fresh:
+                self._drain_parked_locked(self._keys[k])
+        if fresh:
+            # forward first-seen inits up; ack the worker once tier 2 has them
+            ks = np.array([k for k, _ in fresh], dtype=np.int64)
+            vals = np.concatenate([v for _, v in fresh])
+            lens = np.array([len(v) for _, v in fresh], dtype=np.int64)
+            self.up.zpush(
+                KVPairs(ks, vals, lens), cmd=Cmd.INIT,
+                on_complete=lambda: self.server.response(msg),
+            )
+        else:
+            self.server.response(msg)
+
+    def _handle_push(self, msg: Message, kvs: KVPairs):
+        completed: List[int] = []
+        with self._mu:
+            for k, v in kvs.slices():
+                st = self._keys.setdefault(k, _KeyState())
+                if st.accum is None:
+                    st.accum = v.astype(np.float32, copy=True)
+                else:
+                    st.accum += v
+                st.count += 1
+                st.in_flight = True
+                if st.count >= self.num_workers:
+                    completed.append(k)
+        # ack the push immediately — workers overlap next layers
+        self.server.response(msg)
+        if not self.sync_mode:
+            # async local tier: forward each worker's push up immediately;
+            # pulls always serve the current store (no round parking)
+            with self._mu:
+                for k in kvs.keys:
+                    st = self._keys[int(k)]
+                    st.accum = None
+                    st.count = 0
+                    st.in_flight = False
+            self._push_up(KVPairs(kvs.keys, kvs.vals.astype(np.float32),
+                                  kvs.lens))
+            return
+        if completed:
+            self._round_complete(completed)
+
+    def _round_complete(self, keys: List[int]):
+        """All party workers pushed `keys` — run the WAN push-up.
+
+        HFA: each key counts its own aggregation rounds; only every k2-th
+        round of a key crosses the WAN (ref: kvstore_dist_server.h:1324-1343
+        — the reference gates on local_iters per key likewise)."""
+        local_ks, up_ks = [], []
+        with self._mu:
+            for k in sorted(keys):
+                st = self._keys[k]
+                st.round += 1
+                if self.hfa_enabled and st.round % self.hfa_k2 != 0:
+                    local_ks.append(k)
+                else:
+                    up_ks.append(k)
+
+            def take(ks):
+                vs, ls = [], []
+                for k in ks:
+                    st = self._keys[k]
+                    vs.append(st.accum)
+                    ls.append(len(st.accum))
+                    st.accum = None
+                    st.count = 0
+                return KVPairs(np.array(ks, dtype=np.int64),
+                               np.concatenate(vs), np.array(ls, dtype=np.int64))
+
+            kvs_local = take(local_ks) if local_ks else None
+            kvs_up = take(up_ks) if up_ks else None
+        if kvs_local is not None:
+            self._apply_local(kvs_local)
+        if kvs_up is not None:
+            if self.hfa_enabled:
+                self._push_up_hfa(kvs_up)
+            else:
+                self._push_up(kvs_up)
+
+    def _apply_local(self, kvs: KVPairs):
+        """HFA off-round: the merged push is already the party-mean weight
+        vector (workers push weight/num_workers, ref: examples/cnn_hfa.py) —
+        adopt it and serve pulls without touching the WAN."""
+        with self._mu:
+            for k, v in kvs.slices():
+                self.store[k] = np.array(v, copy=True)
+            self._finish_round(list(kvs.keys))
+
+    def _push_up(self, kvs: KVPairs):
+        keys = [int(k) for k in kvs.keys]
+
+        def on_acked():
+            # all global shards applied the update → pull fresh weights
+            # (ref: DataHandlePushResponseDefault :941-957)
+            self.up.zpull(keys, cb=self._on_pull_down)
+
+        self.up.zpush(kvs, cmd=Cmd.DEFAULT, on_complete=on_acked)
+
+    def _push_up_hfa(self, kvs: KVPairs):
+        """K2 round: ship (mean_weights - milestone)/num_global_workers
+        (ref: milestone delta :1324-1343)."""
+        topo = self.po.topology
+        with self._mu:
+            ks, vs, ls = [], [], []
+            for k, v in kvs.slices():
+                self.store[k] = np.array(v, copy=True)  # adopt party mean
+                delta = (v - self._milestone[k]) / topo.num_global_workers
+                ks.append(k); vs.append(delta.astype(np.float32)); ls.append(len(v))
+            out = KVPairs(np.array(ks, dtype=np.int64), np.concatenate(vs),
+                          np.array(ls, dtype=np.int64))
+        keys = [int(k) for k in out.keys]
+
+        def on_acked():
+            self.up.zpull(keys, cb=self._on_pull_down_hfa)
+
+        self.up.zpush(out, cmd=Cmd.HFA_DELTA, on_complete=on_acked)
+
+    def _on_pull_down_hfa(self, kvs: KVPairs):
+        with self._mu:
+            for k, v in kvs.slices():
+                self.store[k] = np.array(v, copy=True)
+                self._milestone[k] = np.array(v, copy=True)
+            self._finish_round([int(k) for k in kvs.keys])
+
+    def _on_pull_down(self, kvs: KVPairs):
+        """Updated weights arrived from tier 2
+        (ref: DataHandlePullResponseDefault :974-1169)."""
+        with self._mu:
+            for k, v in kvs.slices():
+                self.store[k] = np.array(v, copy=True)
+            self._finish_round([int(k) for k in kvs.keys])
+
+    def _finish_round(self, keys: List[int]):
+        """Unblock keys and retry their parked pulls; must hold self._mu."""
+        to_retry: List[Message] = []
+        for k in keys:
+            st = self._keys[k]
+            st.in_flight = False
+            st.version += 1
+            to_retry.extend(st.parked_pulls)
+            st.parked_pulls.clear()
+        for req in to_retry:
+            self._try_serve_pull_locked(req)
+
+    def _drain_parked_locked(self, st: _KeyState):
+        parked, st.parked_pulls = st.parked_pulls, []
+        for req in parked:
+            self._try_serve_pull_locked(req)
+
+    def _handle_pull(self, msg: Message, kvs: KVPairs):
+        with self._mu:
+            self._try_serve_pull_locked(msg)
+
+    def _try_serve_pull_locked(self, req: Message) -> bool:
+        """Serve a pull if every key is initialized and not mid-round,
+        else re-park it on the first blocking key (the reference spins on
+        initialized_, ref :1721-1723 — we park event-driven).  A multi-key
+        pull is re-validated against ALL its keys each time it is retried."""
+        for k in req.keys:
+            k = int(k)
+            st = self._keys.get(k)
+            if st is None:
+                st = self._keys.setdefault(k, _KeyState())
+            if k not in self.store or st.in_flight:
+                st.parked_pulls.append(req)
+                return False
+        ks, vs, ls = [], [], []
+        for k in req.keys:
+            k = int(k)
+            w = self.store[k]
+            ks.append(k); vs.append(w.astype(np.float32)); ls.append(len(w))
+        self.server.response(req, KVPairs(
+            np.array(ks, dtype=np.int64), np.concatenate(vs),
+            np.array(ls, dtype=np.int64)))
+        return True
+
+    # ---- control ------------------------------------------------------------
+    def _on_cmd(self, msg: Message):
+        body = msg.body or {}
+        if msg.cmd == Ctrl.SET_SYNC_MODE:
+            self.sync_mode = bool(body["sync"])
+        elif msg.cmd == Ctrl.SET_COMPRESSION:
+            typ = body.get("type", "none")
+            if typ != "none":
+                # codecs land with geomx_tpu.compression; refuse loudly
+                # rather than silently training uncompressed
+                self.server.reply_cmd(msg, body={
+                    "error": f"compression '{typ}' not supported yet"})
+                return
+            self.compression = body
+        elif msg.cmd == Ctrl.SET_HFA:
+            self.hfa_enabled = bool(body["enabled"])
+            self.hfa_k2 = int(body.get("k2", 1))
+        elif msg.cmd == Ctrl.QUERY_STATS:
+            van = self.po.van
+            self.server.reply_cmd(msg, body={
+                "wan_send_bytes": van.wan_send_bytes,
+                "wan_recv_bytes": van.wan_recv_bytes,
+                "send_bytes": van.send_bytes,
+                "recv_bytes": van.recv_bytes,
+            })
+            return
+        self.server.reply_cmd(msg)
+
+    def stop(self):
+        self.server.stop()
+        self.up.stop()
+
+
+class _GlobalKeyState:
+    __slots__ = ("accum", "count", "parked_pushes", "parked_pulls")
+
+    def __init__(self):
+        self.accum: Optional[np.ndarray] = None
+        self.count = 0
+        # entries are [msg, set-of-keys-not-yet-updated]; a push is acked
+        # when its remaining-set empties
+        self.parked_pushes: List[list] = []
+        self.parked_pulls: List[Message] = []
+
+
+class GlobalServer:
+    """Tier-2: owns a shard of the key space, runs the optimizer
+    (ref: global-server paths of DataHandleSyncDefault :1302-1319 and the
+    async handlers :1519-1698)."""
+
+    def __init__(self, postoffice: Postoffice, config: Optional[Config] = None):
+        self.po = postoffice
+        self.config = config or postoffice.config
+        topo = postoffice.topology
+        self.num_contributors = topo.num_global_workers
+        self.store: Dict[int, np.ndarray] = {}
+        self._keys: Dict[int, _GlobalKeyState] = {}
+        self._mu = threading.RLock()
+        self.optimizer: ServerOptimizer = Sgd()
+        self.sync_mode = self.config.sync_global_mode
+        self.server = KVServer(APP_PS, 0, postoffice, self._handle)
+        self.server.cmd_handler = self._on_cmd
+
+    def _handle(self, msg: Message, kvs: Optional[KVPairs], server: KVServer):
+        if msg.cmd == Cmd.INIT:
+            with self._mu:
+                for k, v in kvs.slices():
+                    if k not in self.store:
+                        self.store[k] = np.array(v, copy=True)
+                        self._keys[k] = _GlobalKeyState()
+                        # init may race ahead of early pulls
+                        self._serve_parked_pulls_locked(int(k))
+            server.response(msg)
+        elif msg.push:
+            if self.sync_mode:
+                self._push_sync(msg, kvs)
+            else:
+                self._push_async(msg, kvs)
+        elif msg.pull:
+            self._pull(msg, kvs)
+
+    # ---- sync tier ----------------------------------------------------------
+    def _push_sync(self, msg: Message, kvs: KVPairs):
+        """Accumulate; ack each parked push once ALL of its keys have been
+        through an optimizer update (the ACK is the "updated" signal the
+        local server waits for before pulling, ref: :1312-1316).
+
+        Keys complete independently (message-granular tracking), so pushes
+        with asymmetric key batches cannot deadlock or double-apply."""
+        if len(kvs.keys) == 0:
+            self.server.response(msg)
+            return
+        to_ack: List[Message] = []
+        with self._mu:
+            entry = [msg, {int(k) for k in kvs.keys}]
+            completed = []
+            for k, v in kvs.slices():
+                k = int(k)
+                st = self._keys.setdefault(k, _GlobalKeyState())
+                if st.accum is None:
+                    st.accum = v.astype(np.float32, copy=True)
+                else:
+                    st.accum += v
+                st.count += 1
+                st.parked_pushes.append(entry)
+                if st.count >= self.num_contributors:
+                    completed.append(k)
+            for k in completed:
+                st = self._keys[k]
+                if msg.cmd == Cmd.HFA_DELTA:
+                    # milestone deltas come pre-divided by num_global_workers;
+                    # apply additively (ref: HandleHFAAccumulate :959-972)
+                    self.store[k] = self.store[k] + st.accum
+                else:
+                    grad = st.accum / self.num_contributors
+                    self.store[k] = self.optimizer.update(k, self.store[k], grad)
+                st.accum = None
+                st.count = 0
+                for ent in st.parked_pushes:
+                    ent[1].discard(k)
+                    if not ent[1]:
+                        to_ack.append(ent[0])
+                st.parked_pushes.clear()
+                self._serve_parked_pulls_locked(k)
+        for req in to_ack:
+            self.server.response(req)
+
+    # ---- async tier (MixedSync, ref :1519-1698) -----------------------------
+    def _push_async(self, msg: Message, kvs: KVPairs):
+        with self._mu:
+            for k, v in kvs.slices():
+                k = int(k)
+                grad = v.astype(np.float32)
+                if isinstance(self.optimizer, DCASGD):
+                    self.store[k] = self.optimizer.update(
+                        k, self.store[k], grad, sender=str(msg.sender))
+                else:
+                    self.store[k] = self.optimizer.update(k, self.store[k], grad)
+        self.server.response(msg)
+
+    # ---- pulls --------------------------------------------------------------
+    def _pull(self, msg: Message, kvs: KVPairs):
+        with self._mu:
+            for k in kvs.keys:
+                k = int(k)
+                if k not in self.store:
+                    self._keys.setdefault(k, _GlobalKeyState()).parked_pulls.append(msg)
+                    return
+            self._respond_pull(msg)
+
+    def _serve_parked_pulls_locked(self, key: int):
+        st = self._keys.get(key)
+        if not st:
+            return
+        ready = [m for m in st.parked_pulls
+                 if all(int(k) in self.store for k in m.keys)]
+        for m in ready:
+            st.parked_pulls.remove(m)
+            self._respond_pull(m)
+
+    def _respond_pull(self, req: Message):
+        ks, vs, ls = [], [], []
+        for k in req.keys:
+            k = int(k)
+            w = self.store[k]
+            ks.append(k); vs.append(w.astype(np.float32)); ls.append(len(w))
+        self.server.response(req, KVPairs(
+            np.array(ks, dtype=np.int64), np.concatenate(vs),
+            np.array(ls, dtype=np.int64)))
+
+    # ---- control ------------------------------------------------------------
+    def _on_cmd(self, msg: Message):
+        body = msg.body or {}
+        if msg.cmd == Ctrl.SET_OPTIMIZER:
+            # ref: master worker pickles the optimizer, executes on the
+            # global server (kvstore.py:452-499, kvstore_dist_server.h:357-364)
+            self.optimizer = make_optimizer(body)
+        elif msg.cmd == Ctrl.SET_SYNC_GLOBAL_MODE:
+            self.sync_mode = bool(body["sync"])
+        elif msg.cmd == Ctrl.QUERY_STATS:
+            van = self.po.van
+            self.server.reply_cmd(msg, body={
+                "wan_send_bytes": van.wan_send_bytes,
+                "wan_recv_bytes": van.wan_recv_bytes,
+            })
+            return
+        self.server.reply_cmd(msg)
+
+    def stop(self):
+        self.server.stop()

@@ -1,0 +1,68 @@
+"""Single-process simulation of a full HiPS deployment.
+
+The reference tests multi-node behavior by launching 12 OS processes on
+localhost (ref: scripts/cpu/run_vanilla_hips.sh;
+docs/source/pseudo-distributed-deployment.rst:1-16).  We stand the same
+topology up as threads over the in-proc fabric — every role, both
+domains, programmable WAN loss/latency — in one Python process, which is
+what tests and the ``--simulate`` mode of the examples use.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from geomx_tpu.core.config import Config, NodeId, Topology
+from geomx_tpu.kvstore.client import WorkerKVStore
+from geomx_tpu.kvstore.server import GlobalServer, LocalServer
+from geomx_tpu.ps import Postoffice
+from geomx_tpu.transport.van import FaultPolicy, InProcFabric
+
+
+class Simulation:
+    def __init__(self, config: Config, fault: Optional[FaultPolicy] = None):
+        self.config = config
+        self.topology = config.topology
+        self.fabric = InProcFabric(fault=fault, config=config)
+        self.offices: Dict[str, Postoffice] = {}
+        for n in self.topology.all_nodes():
+            po = Postoffice(n, self.topology, self.fabric, config)
+            po.start()
+            self.offices[str(n)] = po
+        self.local_servers: List[LocalServer] = [
+            LocalServer(self.offices[str(self.topology.server(p))], config)
+            for p in range(self.topology.num_parties)
+        ]
+        self.global_servers: List[GlobalServer] = [
+            GlobalServer(self.offices[str(gs)], config)
+            for gs in self.topology.global_servers()
+        ]
+        self.workers: Dict[str, WorkerKVStore] = {}
+        for p in range(self.topology.num_parties):
+            for w in self.topology.workers(p):
+                self.workers[str(w)] = WorkerKVStore(self.offices[str(w)], config)
+
+    def worker(self, party: int, rank: int) -> WorkerKVStore:
+        return self.workers[str(NodeId.parse(f"worker:{rank}@p{party}"))]
+
+    def all_workers(self) -> List[WorkerKVStore]:
+        return [self.workers[str(w)] for w in self.topology.all_workers()]
+
+    def wan_bytes(self) -> dict:
+        """Total WAN traffic (tier-2 links) across the deployment."""
+        send = sum(ls.po.van.wan_send_bytes for ls in self.local_servers)
+        send += sum(gs.po.van.wan_send_bytes for gs in self.global_servers)
+        recv = sum(ls.po.van.wan_recv_bytes for ls in self.local_servers)
+        recv += sum(gs.po.van.wan_recv_bytes for gs in self.global_servers)
+        return {"wan_send_bytes": send, "wan_recv_bytes": recv}
+
+    def shutdown(self):
+        for w in self.workers.values():
+            w.stop()
+        for s in self.local_servers:
+            s.stop()
+        for s in self.global_servers:
+            s.stop()
+        for po in self.offices.values():
+            po.stop()
+        self.fabric.shutdown()

@@ -1,0 +1,1 @@
+from geomx_tpu.models.cnn import CNN, create_cnn_state  # noqa: F401

@@ -1,0 +1,3 @@
+from geomx_tpu.optim.server_opt import (  # noqa: F401
+    ServerOptimizer, Sgd, Adam, DCASGD, make_optimizer,
+)

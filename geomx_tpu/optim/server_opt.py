@@ -1,0 +1,112 @@
+"""Server-side optimizers.
+
+The reference runs the optimizer inside the *global server* as a pickled
+python updater distributed by the master worker (ref:
+python/mxnet/kvstore.py:452-499 set_optimizer → kController command;
+kvstore_dist_server.h:542-545 exec_.Exec(updater_)).  We keep the same
+architecture: optimizers are small host-side state machines applied per
+ps-key slab, constructed from a plain config dict so the master worker can
+ship them over the command channel.
+
+Includes DCASGD (delay-compensated async SGD) which the reference pairs
+with MixedSync (ref: python/mxnet/optimizer/optimizer.py class DCASGD;
+README.md:38).
+
+Numerics run through numpy on the host: these slabs live on the server
+processes, not on TPU — the TPU path is the worker's jit-compiled train
+step.  (Server-side slab math is memory-bandwidth-bound elementwise work;
+numpy is the right tool on a host CPU.)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ServerOptimizer:
+    """Base: per-key state, elementwise update of a flat slab."""
+
+    def __init__(self, lr: float = 0.01, wd: float = 0.0):
+        self.lr = lr
+        self.wd = wd
+        self.state: Dict[int, dict] = {}
+
+    def update(self, key: int, weight: np.ndarray, grad: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def _st(self, key: int, init) -> dict:
+        st = self.state.get(key)
+        if st is None:
+            st = init()
+            self.state[key] = st
+        return st
+
+
+class Sgd(ServerOptimizer):
+    def __init__(self, lr: float = 0.01, momentum: float = 0.0, wd: float = 0.0):
+        super().__init__(lr, wd)
+        self.momentum = momentum
+
+    def update(self, key, weight, grad):
+        g = grad + self.wd * weight
+        if self.momentum > 0.0:
+            st = self._st(key, lambda: {"mom": np.zeros_like(weight)})
+            st["mom"] = self.momentum * st["mom"] - self.lr * g
+            return weight + st["mom"]
+        return weight - self.lr * g
+
+
+class Adam(ServerOptimizer):
+    def __init__(self, lr: float = 0.01, beta1: float = 0.9, beta2: float = 0.999,
+                 eps: float = 1e-8, wd: float = 0.0):
+        super().__init__(lr, wd)
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+
+    def update(self, key, weight, grad):
+        g = grad + self.wd * weight
+        st = self._st(key, lambda: {
+            "m": np.zeros_like(weight), "v": np.zeros_like(weight), "t": 0,
+        })
+        st["t"] += 1
+        st["m"] = self.beta1 * st["m"] + (1 - self.beta1) * g
+        st["v"] = self.beta2 * st["v"] + (1 - self.beta2) * g * g
+        mhat = st["m"] / (1 - self.beta1 ** st["t"])
+        vhat = st["v"] / (1 - self.beta2 ** st["t"])
+        return weight - self.lr * mhat / (np.sqrt(vhat) + self.eps)
+
+
+class DCASGD(ServerOptimizer):
+    """Delay-Compensated ASGD for the async global tier (MixedSync).
+
+    w ← w − lr·(g + λ·g⊙g⊙(w − w_prev_for_this_sender)) where w_prev is the
+    weight snapshot this sender last pulled (per-sender backup, mirroring
+    the reference's per-worker previous-weight bookkeeping).
+    """
+
+    def __init__(self, lr: float = 0.01, lamda: float = 0.04, wd: float = 0.0):
+        super().__init__(lr, wd)
+        self.lamda = lamda
+
+    def update(self, key, weight, grad, sender: Optional[str] = None):
+        g = grad + self.wd * weight
+        st = self._st(key, lambda: {"prev": {}})
+        prev = st["prev"].get(sender)
+        if prev is None:
+            prev = weight.copy()
+        comp = g + self.lamda * g * g * (weight - prev)
+        new_w = weight - self.lr * comp
+        st["prev"][sender] = new_w.copy()
+        return new_w
+
+
+_REGISTRY = {"sgd": Sgd, "adam": Adam, "dcasgd": DCASGD}
+
+
+def make_optimizer(config: dict) -> ServerOptimizer:
+    """Build from a plain dict (shipped over the command channel), e.g.
+    ``{"type": "adam", "lr": 0.01}``."""
+    cfg = dict(config)
+    typ = cfg.pop("type")
+    return _REGISTRY[typ](**cfg)

@@ -1,0 +1,3 @@
+from geomx_tpu.ps.postoffice import Postoffice, KeyRange  # noqa: F401
+from geomx_tpu.ps.customer import Customer  # noqa: F401
+from geomx_tpu.ps.kv_app import KVWorker, KVServer, KVPairs  # noqa: F401

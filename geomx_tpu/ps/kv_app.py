@@ -1,0 +1,346 @@
+"""KV application layer: KVWorker push/pull + KVServer request handling.
+
+Mirrors the reference kv_app (ref: ps-lite/include/ps/kv_app.h:171-336
+KVWorker::{ZPush,ZPull,Wait}; :480-534 KVServer::{Process,Response}) plus
+the SimpleApp command channel (ref: ps-lite/include/ps/simple_app.h) used
+for control commands (sync mode, optimizer distribution, profiler control).
+
+Message discrimination: data messages always have ``push`` or ``pull`` set;
+command messages have neither (the reference uses a separate SimpleApp
+customer instead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from geomx_tpu.core.config import NodeId
+from geomx_tpu.ps.customer import Customer
+from geomx_tpu.ps.postoffice import KeyRange, Postoffice
+from geomx_tpu.transport.message import Control, Domain, Message
+
+
+@dataclasses.dataclass
+class KVPairs:
+    """A batch of key→value-slab pairs (ref: kv_app.h:57 KVPairs)."""
+
+    keys: np.ndarray                      # int64 [n]
+    vals: np.ndarray                      # flat payload
+    lens: Optional[np.ndarray] = None     # int64 [n]; elements of vals per key
+
+    def __post_init__(self):
+        self.keys = np.asarray(self.keys, dtype=np.int64)
+        if self.lens is None:
+            assert len(self.keys) == 1, "lens required for multi-key KVPairs"
+            self.lens = np.array([len(self.vals)], dtype=np.int64)
+        self.lens = np.asarray(self.lens, dtype=np.int64)
+
+    def slices(self):
+        """Iterate (key, val_slice) pairs."""
+        off = 0
+        for k, ln in zip(self.keys, self.lens):
+            yield int(k), self.vals[off:off + ln]
+            off += ln
+
+
+class _App:
+    """Shared base: owns a Customer, provides the command channel."""
+
+    def __init__(
+        self,
+        app_id: int,
+        customer_id: int,
+        postoffice: Postoffice,
+        split_pull_queue: bool = False,
+        owns_app: bool = False,
+    ):
+        self.postoffice = postoffice
+        self.cmd_handler: Optional[Callable[[Message], None]] = None
+        self._cmd_responses: Dict[int, object] = {}
+        self.customer = Customer(
+            app_id, customer_id, self._process, postoffice,
+            split_pull_queue=split_pull_queue, owns_app=owns_app,
+        )
+
+    def send_cmd(
+        self,
+        recipient: NodeId,
+        head: int,
+        body=None,
+        domain: Domain = Domain.LOCAL,
+        wait: bool = True,
+    ):
+        """Send a control command. With ``wait`` returns the response body;
+        otherwise the timestamp (read the body later via cmd_response)."""
+        ts = self.customer.new_request(1)
+        self.postoffice.van.send(Message(
+            recipient=recipient, domain=domain, app_id=self.customer.app_id,
+            customer_id=self.customer.customer_id, timestamp=ts, request=True,
+            cmd=head, body=body,
+        ))
+        if wait:
+            self.customer.wait(ts)
+            return self._cmd_responses.pop(ts, None)
+        return ts
+
+    def cmd_response(self, ts: int):
+        return self._cmd_responses.pop(ts, None)
+
+    def reply_cmd(self, req: Message, body=None):
+        self.postoffice.van.send(req.reply_to(body=body))
+
+    def wait(self, ts: int):
+        self.customer.wait(ts)
+
+    def _process(self, msg: Message):
+        raise NotImplementedError
+
+    def _handle_command(self, msg: Message):
+        if msg.request:
+            if self.cmd_handler is not None:
+                self.cmd_handler(msg)
+            else:
+                self.reply_cmd(msg)  # default: bare ACK
+        else:
+            if msg.body is not None:
+                self._cmd_responses[msg.timestamp] = msg.body
+            self.customer.add_response(msg.timestamp)
+
+    def stop(self):
+        self.customer.stop()
+
+
+class KVWorker(_App):
+    """Client endpoint pushing/pulling key ranges to a server group.
+
+    ``targets`` is the ordered server list (tier-1: the party's local
+    server; tier-2: all global servers) and ``key_ranges`` their owned
+    ranges — requests are sliced per server like the reference slicer
+    (ref: kv_app.h:788-839 DefaultSlicer).
+    """
+
+    def __init__(
+        self,
+        app_id: int,
+        customer_id: int,
+        postoffice: Postoffice,
+        targets: Sequence[NodeId],
+        key_ranges: Sequence[KeyRange],
+        domain: Domain = Domain.LOCAL,
+    ):
+        super().__init__(app_id, customer_id, postoffice)
+        assert len(targets) == len(key_ranges)
+        self.targets = list(targets)
+        self.key_ranges = list(key_ranges)
+        self.domain = domain
+        self._pull_bufs: Dict[int, List[KVPairs]] = {}
+        self._pull_cbs: Dict[int, Callable[[KVPairs], None]] = {}
+        self._pull_expected: Dict[int, int] = {}
+        self._mu = threading.Lock()
+
+    # ---- slicing ------------------------------------------------------------
+    def _slice(self, kvs: KVPairs) -> Dict[int, KVPairs]:
+        """Partition KVPairs by target server. Keys must be sorted."""
+        out: Dict[int, List] = {}
+        off = 0
+        for k, ln in zip(kvs.keys, kvs.lens):
+            k = int(k)
+            sid = None
+            for i, r in enumerate(self.key_ranges):
+                if r.contains(k):
+                    sid = i
+                    break
+            if sid is None:
+                raise KeyError(f"key {k} outside all server ranges")
+            ent = out.setdefault(sid, [[], [], []])
+            ent[0].append(k)
+            ent[1].append(kvs.vals[off:off + ln])
+            ent[2].append(int(ln))
+            off += ln
+        return {
+            sid: KVPairs(
+                keys=np.array(e[0], dtype=np.int64),
+                vals=np.concatenate(e[1]) if e[1] else np.empty(0, kvs.vals.dtype),
+                lens=np.array(e[2], dtype=np.int64),
+            )
+            for sid, e in out.items()
+        }
+
+    # ---- public API ---------------------------------------------------------
+    def zpush(
+        self,
+        kvs: KVPairs,
+        cmd: int = 0,
+        priority: int = 0,
+        wait: bool = False,
+        on_complete=None,
+        **msg_fields,
+    ) -> int:
+        """Push values to their owning servers (ref: kv_app.h:171 ZPush)."""
+        parts = self._slice(kvs)
+        ts = self.customer.new_request(len(parts), on_complete=on_complete)
+        for sid, part in parts.items():
+            self.postoffice.van.send(Message(
+                recipient=self.targets[sid], domain=self.domain,
+                app_id=self.customer.app_id, customer_id=self.customer.customer_id,
+                timestamp=ts, request=True, push=True, cmd=cmd, priority=priority,
+                keys=part.keys, vals=part.vals, lens=part.lens, **msg_fields,
+            ))
+        if wait:
+            self.customer.wait(ts)
+        return ts
+
+    def zpull(
+        self,
+        keys: Sequence[int],
+        cb: Optional[Callable[[KVPairs], None]] = None,
+        cmd: int = 0,
+        priority: int = 0,
+        wait: bool = False,
+        on_complete=None,
+        after_ts: Optional[int] = None,
+        **msg_fields,
+    ) -> int:
+        """Pull values for keys; cb runs with the merged result before
+        wait() unblocks (ref: kv_app.h:277 ZPull).
+
+        ``after_ts`` defers the request send until that earlier request of
+        this customer completes — the pull-after-push-per-key ordering the
+        reference gets from the MXNet dependency engine (push/pull ops share
+        the key's var, ref: kvstore_dist.h:602-624 PushAsync read/write deps).
+        """
+        keys = np.asarray(sorted(int(k) for k in keys), dtype=np.int64)
+        dummy = KVPairs(keys=keys, vals=np.empty(len(keys), np.float32),
+                        lens=np.ones(len(keys), np.int64))
+        parts = self._slice(dummy)
+        ts = self.customer.new_request(len(parts), on_complete=on_complete)
+        with self._mu:
+            self._pull_bufs[ts] = []
+            self._pull_expected[ts] = len(parts)
+            if cb is not None:
+                self._pull_cbs[ts] = cb
+
+        def _send():
+            for sid, part in parts.items():
+                self.postoffice.van.send(Message(
+                    recipient=self.targets[sid], domain=self.domain,
+                    app_id=self.customer.app_id,
+                    customer_id=self.customer.customer_id,
+                    timestamp=ts, request=True, pull=True, cmd=cmd,
+                    priority=priority, keys=part.keys, **msg_fields,
+                ))
+
+        if after_ts is None:
+            _send()
+        else:
+            self.customer.add_completion_listener(after_ts, _send)
+        if wait:
+            self.customer.wait(ts)
+        return ts
+
+    def push_pull(self, kvs: KVPairs, cb=None, cmd: int = 0, priority: int = 0,
+                  wait: bool = False) -> int:
+        """Combined push+pull in one round trip (response carries values)."""
+        parts = self._slice(kvs)
+        ts = self.customer.new_request(len(parts))
+        with self._mu:
+            self._pull_bufs[ts] = []
+            self._pull_expected[ts] = len(parts)
+            if cb is not None:
+                self._pull_cbs[ts] = cb
+        for sid, part in parts.items():
+            self.postoffice.van.send(Message(
+                recipient=self.targets[sid], domain=self.domain,
+                app_id=self.customer.app_id, customer_id=self.customer.customer_id,
+                timestamp=ts, request=True, push=True, pull=True, cmd=cmd,
+                priority=priority, keys=part.keys, vals=part.vals, lens=part.lens,
+            ))
+        if wait:
+            self.customer.wait(ts)
+        return ts
+
+    # ---- response processing ------------------------------------------------
+    def _process(self, msg: Message):
+        if not msg.push and not msg.pull:
+            self._handle_command(msg)
+            return
+        assert not msg.request, f"KVWorker got a request: {msg}"
+        ts = msg.timestamp
+        if msg.keys is not None and msg.vals is not None:
+            # pull (or push_pull) response carrying data
+            with self._mu:
+                buf = self._pull_bufs.get(ts)
+                if buf is not None:
+                    buf.append(KVPairs(msg.keys, msg.vals, msg.lens))
+                    done = len(buf) == self._pull_expected.get(ts, -1)
+                else:
+                    done = False
+            if done:
+                merged = self._merge(self._pull_bufs.pop(ts))
+                self._pull_expected.pop(ts, None)
+                cb = self._pull_cbs.pop(ts, None)
+                if cb is not None:
+                    cb(merged)
+        self.customer.add_response(ts)
+
+    @staticmethod
+    def _merge(parts: List[KVPairs]) -> KVPairs:
+        """Sort-merge per-server responses by key (ref: kv_app.h pull
+        aggregation sorts by key before the user callback)."""
+        ks, vs, ls = [], [], []
+        for p in parts:
+            for k, v in p.slices():
+                ks.append(k); vs.append(v); ls.append(len(v))
+        order = np.argsort(np.asarray(ks, dtype=np.int64), kind="stable")
+        keys = np.asarray(ks, dtype=np.int64)[order]
+        vals = (np.concatenate([vs[i] for i in order])
+                if vs else np.empty(0, np.float32))
+        lens = np.asarray(ls, dtype=np.int64)[order]
+        return KVPairs(keys, vals, lens)
+
+
+class KVServer(_App):
+    """Server endpoint: user handle processes requests, ``response`` replies.
+
+    The handle runs on the customer thread (push queue) or the dedicated
+    pull thread (ref: customer.h:91-101) — handlers must therefore be
+    thread-safe across those two.
+    """
+
+    def __init__(
+        self,
+        app_id: int,
+        customer_id: int,
+        postoffice: Postoffice,
+        handle: Callable[[Message, Optional[KVPairs], "KVServer"], None],
+        split_pull_queue: bool = True,
+    ):
+        super().__init__(app_id, customer_id, postoffice,
+                         split_pull_queue=split_pull_queue, owns_app=True)
+        self.handle = handle
+
+    def _process(self, msg: Message):
+        if not msg.push and not msg.pull:
+            self._handle_command(msg)
+            return
+        if not msg.request:
+            # response to a push/pull this node issued as a *server*
+            # (e.g. ACKs for pushed-down model updates)
+            self.customer.add_response(msg.timestamp)
+            return
+        kvs = None
+        if msg.keys is not None:
+            vals = msg.vals if msg.vals is not None else np.empty(0, np.float32)
+            lens = msg.lens if msg.lens is not None else np.zeros(len(msg.keys), np.int64)
+            kvs = KVPairs(msg.keys, vals, lens)
+        self.handle(msg, kvs, self)
+
+    def response(self, req: Message, kvs: Optional[KVPairs] = None, **overrides):
+        rep = req.reply_to(**overrides)
+        if kvs is not None:
+            rep.keys, rep.vals, rep.lens = kvs.keys, kvs.vals, kvs.lens
+        self.postoffice.van.send(rep)

@@ -1,0 +1,218 @@
+"""Postoffice: per-node runtime hub — node table, dispatch, barriers, key ranges.
+
+Mirrors the responsibilities of the reference Postoffice (ref:
+ps-lite/include/ps/internal/postoffice.h:35-76, src/postoffice.cc) — role
+bookkeeping, node-group membership, scheduler-counted barriers for both the
+local and the global domain (ref: postoffice.cc:202-244,
+van.cc:259-288 ProcessBarrierCommand), and server key ranges
+(ref: postoffice.cc:246-259 GetServerKeyRanges).
+
+Divergence from the reference: node discovery is static (the Topology is
+known up front) rather than via ADD_NODE registration; dynamic
+join/recovery is layered on top for the TCP fabric (see
+transport/heartbeat in the aux subsystem).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from geomx_tpu.core.config import Config, Group, NodeId, Role, Topology
+from geomx_tpu.transport.message import Control, Domain, Message
+from geomx_tpu.transport.van import InProcFabric, Van
+
+# The ps key space. Tensor ids are encoded into this space by the kvstore
+# layer; servers own contiguous ranges of it (ref: ps/base.h kMaxKey).
+MAX_KEY = 1 << 62
+
+
+@dataclasses.dataclass(frozen=True)
+class KeyRange:
+    begin: int  # inclusive
+    end: int    # exclusive
+
+    def contains(self, key: int) -> bool:
+        return self.begin <= key < self.end
+
+
+def split_range(n: int, total: int = MAX_KEY) -> List[KeyRange]:
+    """Equal partition of the key space across n servers
+    (ref: postoffice.cc:246-259)."""
+    step = total // n
+    out = []
+    for i in range(n):
+        end = total if i == n - 1 else (i + 1) * step
+        out.append(KeyRange(i * step, end))
+    return out
+
+
+class Postoffice:
+    """One per node. Owns the Van, routes messages, runs barriers.
+
+    Customers register with (app_id, customer_id); data messages are routed
+    to them. Control messages (BARRIER, HEARTBEAT, TS scheduling) are
+    handled here or forwarded to registered control hooks.
+    """
+
+    def __init__(
+        self,
+        node: NodeId,
+        topology: Topology,
+        fabric: InProcFabric,
+        config: Optional[Config] = None,
+    ):
+        self.node = node
+        self.topology = topology
+        self.config = config or Config()
+        self.van = Van(
+            node,
+            fabric,
+            config=self.config,
+            use_priority_queue=self.config.enable_p3,
+        )
+        self._customers: Dict[Tuple[int, int], "Customer"] = {}
+        self._app_owner: Dict[int, "Customer"] = {}
+        self._control_hooks: List[Callable[[Message], bool]] = []
+        self._lock = threading.Lock()
+        # barrier state
+        self._barrier_cv = threading.Condition()
+        self._barrier_done: Dict[int, bool] = {}
+        self._barrier_seq = 0
+        # scheduler-side barrier counting: (group_token) -> list of waiters
+        self._barrier_waiting: Dict[str, List[Message]] = {}
+        self._started = False
+
+    # ---- lifecycle ----------------------------------------------------------
+    def start(self):
+        if not self._started:
+            self.van.start(self._dispatch)
+            self._started = True
+
+    def stop(self):
+        if self._started:
+            self.van.stop()
+            self._started = False
+
+    # ---- registry -----------------------------------------------------------
+    def register_customer(self, customer: "Customer", owns_app: bool = False):
+        """Register for message routing.
+
+        Responses route by (app_id, customer_id) — back to the exact
+        requester.  Requests route to the app *owner* (the serving
+        customer), since the request carries the sender's customer_id
+        (ref: van.cc ProcessDataMsg routes by app_id on non-worker nodes).
+        """
+        with self._lock:
+            key = (customer.app_id, customer.customer_id)
+            if key in self._customers:
+                raise ValueError(f"duplicate customer {key} on {self.node}")
+            self._customers[key] = customer
+            if owns_app:
+                if customer.app_id in self._app_owner:
+                    raise ValueError(
+                        f"duplicate app owner {customer.app_id} on {self.node}"
+                    )
+                self._app_owner[customer.app_id] = customer
+
+    def add_control_hook(self, hook: Callable[[Message], bool]):
+        """Hook receives control messages; return True to consume."""
+        with self._lock:
+            self._control_hooks.append(hook)
+
+    # ---- dispatch -----------------------------------------------------------
+    def _dispatch(self, msg: Message):
+        if msg.control is Control.BARRIER:
+            self._handle_barrier(msg)
+            return
+        if msg.control is not Control.EMPTY:
+            with self._lock:
+                hooks = list(self._control_hooks)
+            for hook in hooks:
+                if hook(msg):
+                    return
+            return
+        if msg.request:
+            cust = self._app_owner.get(msg.app_id) or self._customers.get(
+                (msg.app_id, msg.customer_id)
+            )
+        else:
+            cust = self._customers.get((msg.app_id, msg.customer_id))
+        if cust is None:
+            raise KeyError(
+                f"{self.node}: no customer ({msg.app_id},{msg.customer_id}) "
+                f"request={msg.request} for message from {msg.sender}"
+            )
+        cust.accept(msg)
+
+    # ---- barriers -----------------------------------------------------------
+    def _scheduler_for(self, group: Group) -> NodeId:
+        if group & (Group.GLOBAL_SERVERS | Group.GLOBAL_WORKERS | Group.GLOBAL_SCHEDULER):
+            return self.topology.global_scheduler()
+        assert self.node.party is not None, f"{self.node} has no party for local barrier"
+        return self.topology.scheduler(self.node.party)
+
+    def barrier(self, group: Group, timeout: Optional[float] = 60.0):
+        """Block until every member of `group` has entered the barrier.
+
+        Counted at the scheduler like the reference (ref: postoffice.cc:202-244).
+        The caller must be a member of `group`.
+        """
+        sched = self._scheduler_for(group)
+        # party only scopes local-domain groups; global groups span parties
+        is_global = sched.role is Role.GLOBAL_SCHEDULER
+        party = None if is_global else self.node.party
+        members = self.topology.members(group, party=self.node.party)
+        assert self.node in members, f"{self.node} not in barrier group {group}"
+        if len(members) <= 1:
+            return
+        with self._barrier_cv:
+            self._barrier_seq += 1
+            seq = self._barrier_seq
+        domain = Domain.GLOBAL if is_global else Domain.LOCAL
+        req = Message(
+            recipient=sched, control=Control.BARRIER, domain=domain, request=True,
+            body={"group": group.value, "party": party, "seq": seq},
+        )
+        self.van.send(req)
+        with self._barrier_cv:
+            ok = self._barrier_cv.wait_for(
+                lambda: self._barrier_done.pop(seq, False), timeout=timeout
+            )
+        if not ok:
+            raise TimeoutError(f"{self.node}: barrier on {group} timed out")
+
+    def _handle_barrier(self, msg: Message):
+        if msg.request:
+            # scheduler side: count entries for this (group, party)
+            assert self.node.role.is_scheduler, f"{self.node} got barrier request"
+            group = Group(msg.body["group"])
+            party = msg.body["party"]
+            token = f"{group.value}@{party}"
+            members = self.topology.members(group, party=party)
+            with self._lock:
+                waiting = self._barrier_waiting.setdefault(token, [])
+                waiting.append(msg)
+                if len(waiting) < len(members):
+                    return
+                released = self._barrier_waiting.pop(token)
+            for req in released:
+                self.van.send(req.reply_to(body={"seq": req.body["seq"]}))
+        else:
+            with self._barrier_cv:
+                self._barrier_done[msg.body["seq"]] = True
+                self._barrier_cv.notify_all()
+
+    # ---- key ranges ---------------------------------------------------------
+    def server_key_ranges(self, is_global: bool = False) -> List[KeyRange]:
+        """Key ranges of tier-1 (one local server) or tier-2 (M global servers)
+        (ref: postoffice.cc:246-259; GetServerKeyRanges(is_global))."""
+        if is_global:
+            return split_range(self.topology.num_global_servers)
+        return split_range(1)
+
+    def server_for_key(self, key: int, is_global: bool = False) -> int:
+        ranges = self.server_key_ranges(is_global)
+        step = MAX_KEY // len(ranges)
+        return min(key // step, len(ranges) - 1)

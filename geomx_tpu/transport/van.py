@@ -254,7 +254,19 @@ class Van:
                 msg.msg_sig = next(self._sig_counter)
             self._pending_acks[msg.msg_sig] = [msg, time.monotonic(), 0]
         self._account_send(msg)
-        self.fabric.deliver(msg)
+        self._deliver_guarded(msg)
+
+    def _deliver_guarded(self, msg: Message):
+        """An unknown recipient must not kill sender threads (resend loop,
+        priority drain); surface it as a log + drop instead."""
+        try:
+            self.fabric.deliver(msg)
+        except KeyError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s: dropping message to unknown node %s", self.node, msg.recipient
+            )
 
     def _account_send(self, msg: Message):
         n = msg.nbytes
@@ -331,4 +343,4 @@ class Van:
                 entry[1] = now
                 entry[2] = num_retry + 1
                 self._account_send(msg)  # retransmits are real wire bytes
-                self.fabric.deliver(msg)
+                self._deliver_guarded(msg)
