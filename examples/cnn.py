@@ -78,8 +78,11 @@ def main():
 
     def worker_main(party, rank, widx):
         kv = sim.worker(party, rank)
-        if party == 0 and rank == 0:
-            kv.set_optimizer({"type": args.optimizer, "lr": args.lr})
+        if rank == 0:
+            # rank-0 of each party configures its party's server; only one
+            # worker needs to ship the optimizer to the global tier
+            if party == 0:
+                kv.set_optimizer({"type": args.optimizer, "lr": args.lr})
             if args.compression != "none":
                 kv.set_gradient_compression(
                     {"type": args.compression, "ratio": args.bsc_ratio})

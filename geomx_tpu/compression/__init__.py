@@ -1,0 +1,4 @@
+from geomx_tpu.compression.codecs import (  # noqa: F401
+    Codec, Fp16Codec, TwoBitCodec, BscCodec, MpqSelector,
+    BroadcastCompressor, make_push_codec, decompress_payload,
+)

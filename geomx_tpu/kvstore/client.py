@@ -163,11 +163,32 @@ class WorkerKVStore:
                                  body={"sync": global_sync}, domain=Domain.GLOBAL)
 
     def set_gradient_compression(self, comp_config: dict):
-        """ref: kvstore.py set_gradient_compression → kSetGradientCompression."""
-        reply = self.worker.send_cmd(self.po.topology.server(self.party),
-                                     Ctrl.SET_COMPRESSION, body=comp_config)
-        if isinstance(reply, dict) and "error" in reply:
-            raise ValueError(reply["error"])
+        """Configure WAN compression on my party's local server and on
+        every global server (push decode + pull-direction sparsifier).
+
+        Like the reference, this configures the *caller's* party — every
+        party's rank-0 worker must call it (the reference has every worker
+        run the same script, so every server hears it; ref: kvstore.py
+        set_gradient_compression → kSetGradientCompression).
+
+        Fields missing from ``comp_config`` fall back to this client's
+        Config knobs (twobit_threshold / bsc_* / mpq_size_bound), keeping
+        one source of truth for the tuning surface."""
+        defaults = {
+            "ratio": self.config.bsc_ratio,
+            "momentum": self.config.bsc_momentum,
+            "sample_rate": self.config.bsc_sample_rate,
+            "threshold": self.config.twobit_threshold,
+            "size_bound": self.config.mpq_size_bound,
+        }
+        comp_config = {**defaults, **comp_config}
+        targets = [(self.po.topology.server(self.party), Domain.LOCAL)]
+        targets += [(gs, Domain.GLOBAL) for gs in self.po.topology.global_servers()]
+        for node, domain in targets:
+            reply = self.worker.send_cmd(node, Ctrl.SET_COMPRESSION,
+                                         body=comp_config, domain=domain)
+            if isinstance(reply, dict) and "error" in reply:
+                raise ValueError(reply["error"])
 
     def set_hfa(self, enabled: bool, k2: int = 1):
         self.worker.send_cmd(self.po.topology.server(self.party),

@@ -86,6 +86,7 @@ class LocalServer:
         self.hfa_k2 = self.config.hfa_k2
         self._milestone: Dict[int, np.ndarray] = {}
         self.compression: dict = {"type": "none"}
+        self.push_codec = None  # set by Ctrl.SET_COMPRESSION
 
     # ---- request handling ---------------------------------------------------
     def _handle(self, msg: Message, kvs: Optional[KVPairs], server: KVServer):
@@ -199,12 +200,41 @@ class LocalServer:
     def _push_up(self, kvs: KVPairs):
         keys = [int(k) for k in kvs.keys]
 
-        def on_acked():
+        def pull_down():
             # all global shards applied the update → pull fresh weights
             # (ref: DataHandlePushResponseDefault :941-957)
             self.up.zpull(keys, cb=self._on_pull_down)
 
-        self.up.zpush(kvs, cmd=Cmd.DEFAULT, on_complete=on_acked)
+        if self.push_codec is None:
+            self.up.zpush(kvs, cmd=Cmd.DEFAULT, on_complete=pull_down)
+            return
+        # compress per key; group by codec so each wire message has a
+        # uniform payload dtype + compr tag (ref: PushCompressed
+        # kvstore_dist.h:530-563, DataPushToGlobalServersCompressed)
+        from geomx_tpu.compression import MpqSelector
+
+        groups: Dict[str, list] = {}
+        for k, v in kvs.slices():
+            codec = (self.push_codec.select(len(v))
+                     if isinstance(self.push_codec, MpqSelector)
+                     else self.push_codec)
+            groups.setdefault(codec.name, []).append((k, codec.compress(k, v)))
+        remaining = [len(groups)]
+        lock = threading.Lock()
+
+        def one_group_acked():
+            with lock:
+                remaining[0] -= 1
+                done = remaining[0] == 0
+            if done:
+                pull_down()
+
+        for tag, pairs in groups.items():
+            ks = np.array([k for k, _ in pairs], dtype=np.int64)
+            vals = np.concatenate([p for _, p in pairs])
+            lens = np.array([len(p) for _, p in pairs], dtype=np.int64)
+            self.up.zpush(KVPairs(ks, vals, lens), cmd=Cmd.DEFAULT,
+                          on_complete=one_group_acked, compr=tag)
 
     def _push_up_hfa(self, kvs: KVPairs):
         """K2 round: ship (mean_weights - milestone)/num_global_workers
@@ -226,18 +256,36 @@ class LocalServer:
         self.up.zpush(out, cmd=Cmd.HFA_DELTA, on_complete=on_acked)
 
     def _on_pull_down_hfa(self, kvs: KVPairs):
+        tags = kvs.tags or {}
         with self._mu:
             for k, v in kvs.slices():
-                self.store[k] = np.array(v, copy=True)
-                self._milestone[k] = np.array(v, copy=True)
+                new_w = self._decode_pull_value(k, v, tags.get(k, ""))
+                self.store[k] = new_w
+                self._milestone[k] = np.array(new_w, copy=True)
             self._finish_round([int(k) for k in kvs.keys])
 
+    def _decode_pull_value(self, k: int, v: np.ndarray, tag: str) -> np.ndarray:
+        """Decode one pull-down slab into the new full weight vector.
+        Caller holds self._mu.  "bsc" payloads are sparse deltas against
+        the current replica (ref: BSC decode :310-336)."""
+        from geomx_tpu.compression.codecs import unpack_sparse
+
+        if tag == "bsc":
+            vals, idx = unpack_sparse(np.ascontiguousarray(v).view(np.float32))
+            w = self.store[k]
+            w[idx] += vals
+            return w
+        if tag == "fp16":
+            return np.ascontiguousarray(v).view(np.float16).astype(np.float32)
+        return np.array(v, copy=True)
+
     def _on_pull_down(self, kvs: KVPairs):
-        """Updated weights arrived from tier 2
+        """Updated weights arrived from tier 2 — possibly compressed
         (ref: DataHandlePullResponseDefault :974-1169)."""
+        tags = kvs.tags or {}
         with self._mu:
             for k, v in kvs.slices():
-                self.store[k] = np.array(v, copy=True)
+                self.store[k] = self._decode_pull_value(k, v, tags.get(k, ""))
             self._finish_round([int(k) for k in kvs.keys])
 
     def _finish_round(self, keys: List[int]):
@@ -290,14 +338,14 @@ class LocalServer:
         if msg.cmd == Ctrl.SET_SYNC_MODE:
             self.sync_mode = bool(body["sync"])
         elif msg.cmd == Ctrl.SET_COMPRESSION:
-            typ = body.get("type", "none")
-            if typ != "none":
-                # codecs land with geomx_tpu.compression; refuse loudly
-                # rather than silently training uncompressed
-                self.server.reply_cmd(msg, body={
-                    "error": f"compression '{typ}' not supported yet"})
+            from geomx_tpu.compression import make_push_codec
+
+            try:
+                self.push_codec = make_push_codec(body)
+                self.compression = body
+            except ValueError as e:
+                self.server.reply_cmd(msg, body={"error": str(e)})
                 return
-            self.compression = body
         elif msg.cmd == Ctrl.SET_HFA:
             self.hfa_enabled = bool(body["enabled"])
             self.hfa_k2 = int(body.get("k2", 1))
@@ -344,6 +392,8 @@ class GlobalServer:
         self._mu = threading.RLock()
         self.optimizer: ServerOptimizer = Sgd()
         self.sync_mode = self.config.sync_global_mode
+        self.compression: dict = {"type": "none"}
+        self.pull_comp = None  # BroadcastCompressor under bsc/mpq
         self.server = KVServer(APP_PS, 0, postoffice, self._handle)
         self.server.cmd_handler = self._on_cmd
 
@@ -354,16 +404,37 @@ class GlobalServer:
                     if k not in self.store:
                         self.store[k] = np.array(v, copy=True)
                         self._keys[k] = _GlobalKeyState()
+                        if self.pull_comp is not None:
+                            self.pull_comp.ensure_base(int(k), v)
                         # init may race ahead of early pulls
                         self._serve_parked_pulls_locked(int(k))
             server.response(msg)
-        elif msg.push:
+            return
+        if msg.push and msg.compr and kvs is not None:
+            kvs = self._decompress_push(msg, kvs)
+        if msg.push:
             if self.sync_mode:
                 self._push_sync(msg, kvs)
             else:
                 self._push_async(msg, kvs)
         elif msg.pull:
             self._pull(msg, kvs)
+
+    def _decompress_push(self, msg: Message, kvs: KVPairs) -> KVPairs:
+        """Decode a compressed gradient push to dense before aggregation
+        (ref: BSCDecompress gradient_compression.cc:310-336; fp16/2bit
+        decode in the server push handlers)."""
+        from geomx_tpu.compression import decompress_payload
+
+        thr = float(self.compression.get("threshold", 0.5))
+        ks, vs, ls = [], [], []
+        with self._mu:
+            for k, payload in kvs.slices():
+                orig = len(self.store[k])
+                dense = decompress_payload(msg.compr, k, payload, orig, thr)
+                ks.append(k); vs.append(dense); ls.append(orig)
+        return KVPairs(np.array(ks, dtype=np.int64), np.concatenate(vs),
+                       np.array(ls, dtype=np.int64))
 
     # ---- sync tier ----------------------------------------------------------
     def _push_sync(self, msg: Message, kvs: KVPairs):
@@ -445,6 +516,9 @@ class GlobalServer:
             self._respond_pull(m)
 
     def _respond_pull(self, req: Message):
+        if self.pull_comp is not None or self.compression.get("type") == "fp16":
+            self._respond_pull_compressed(req)
+            return
         ks, vs, ls = [], [], []
         for k in req.keys:
             k = int(k)
@@ -454,6 +528,40 @@ class GlobalServer:
             np.array(ks, dtype=np.int64), np.concatenate(vs),
             np.array(ls, dtype=np.int64)))
 
+    def _respond_pull_compressed(self, req: Message):
+        """Pull-direction compression (the second half of Bi-Sparse,
+        ref: BSCPullCompress/DefaultStorageResponse :1171-1211).
+
+        One wire format for all compressed pulls: byte-packed payload with
+        per-key tags in the response body.  "bsc" keys carry a top-k
+        weight-delta against this subscriber's tracked view; "fp16" keys
+        (small tensors under MPQ, or everything under plain fp16 —
+        ref: README.md:22 fp16 halves both directions) carry half-precision
+        weights.
+        """
+        typ = self.compression.get("type")
+        size_bound = (int(self.compression.get("size_bound", 200_000))
+                      if typ == "mpq" else 0)
+        sender = str(req.sender)
+        ks, chunks, ls, tags = [], [], [], {}
+        for k in req.keys:
+            k = int(k)
+            w = self.store[k]
+            if typ == "fp16" or (size_bound and len(w) < size_bound):
+                payload = w.astype(np.float16)
+                tags[str(k)] = "fp16"
+            else:
+                payload = self.pull_comp.compress(sender, k, w)
+                tags[str(k)] = "bsc"
+            b = np.ascontiguousarray(payload).view(np.uint8)
+            ks.append(k); chunks.append(b); ls.append(len(b))
+        self.server.response(
+            req,
+            KVPairs(np.array(ks, dtype=np.int64), np.concatenate(chunks),
+                    np.array(ls, dtype=np.int64)),
+            body={"compr": tags},
+        )
+
     # ---- control ------------------------------------------------------------
     def _on_cmd(self, msg: Message):
         body = msg.body or {}
@@ -461,6 +569,31 @@ class GlobalServer:
             # ref: master worker pickles the optimizer, executes on the
             # global server (kvstore.py:452-499, kvstore_dist_server.h:357-364)
             self.optimizer = make_optimizer(body)
+        elif msg.cmd == Ctrl.SET_COMPRESSION:
+            from geomx_tpu.compression import BroadcastCompressor, make_push_codec
+
+            try:
+                make_push_codec(body)  # validate
+            except ValueError as e:
+                self.server.reply_cmd(msg, body={"error": str(e)})
+                return
+            with self._mu:
+                if body == self.compression:
+                    # idempotent: every party's rank-0 sends this; a
+                    # recreation mid-training would wipe other parties'
+                    # tracked subscriber views
+                    self.server.reply_cmd(msg)
+                    return
+                self.compression = body
+                if body.get("type") in ("bsc", "mpq"):
+                    pc = BroadcastCompressor(ratio=body.get("ratio", 0.01))
+                    for k, v in self.store.items():
+                        pc.ensure_base(k, v)
+                    # publish only after bases are seeded (pulls run on a
+                    # separate thread under this same lock)
+                    self.pull_comp = pc
+                else:
+                    self.pull_comp = None
         elif msg.cmd == Ctrl.SET_SYNC_GLOBAL_MODE:
             self.sync_mode = bool(body["sync"])
         elif msg.cmd == Ctrl.QUERY_STATS:

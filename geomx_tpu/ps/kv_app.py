@@ -26,11 +26,16 @@ from geomx_tpu.transport.message import Control, Domain, Message
 
 @dataclasses.dataclass
 class KVPairs:
-    """A batch of key→value-slab pairs (ref: kv_app.h:57 KVPairs)."""
+    """A batch of key→value-slab pairs (ref: kv_app.h:57 KVPairs).
+
+    ``tags`` optionally carries a per-key codec tag (for compressed pull
+    responses, where different keys of one message may use different
+    codecs — the MPQ case)."""
 
     keys: np.ndarray                      # int64 [n]
     vals: np.ndarray                      # flat payload
     lens: Optional[np.ndarray] = None     # int64 [n]; elements of vals per key
+    tags: Optional[dict] = None           # int key -> compr tag
 
     def __post_init__(self):
         self.keys = np.asarray(self.keys, dtype=np.int64)
@@ -272,10 +277,13 @@ class KVWorker(_App):
         ts = msg.timestamp
         if msg.keys is not None and msg.vals is not None:
             # pull (or push_pull) response carrying data
+            tags = None
+            if isinstance(msg.body, dict) and "compr" in msg.body:
+                tags = {int(k): t for k, t in msg.body["compr"].items()}
             with self._mu:
                 buf = self._pull_bufs.get(ts)
                 if buf is not None:
-                    buf.append(KVPairs(msg.keys, msg.vals, msg.lens))
+                    buf.append(KVPairs(msg.keys, msg.vals, msg.lens, tags=tags))
                     done = len(buf) == self._pull_expected.get(ts, -1)
                 else:
                     done = False
@@ -292,7 +300,10 @@ class KVWorker(_App):
         """Sort-merge per-server responses by key (ref: kv_app.h pull
         aggregation sorts by key before the user callback)."""
         ks, vs, ls = [], [], []
+        tags: dict = {}
         for p in parts:
+            if p.tags:
+                tags.update(p.tags)
             for k, v in p.slices():
                 ks.append(k); vs.append(v); ls.append(len(v))
         order = np.argsort(np.asarray(ks, dtype=np.int64), kind="stable")
@@ -300,7 +311,7 @@ class KVWorker(_App):
         vals = (np.concatenate([vs[i] for i in order])
                 if vs else np.empty(0, np.float32))
         lens = np.asarray(ls, dtype=np.int64)[order]
-        return KVPairs(keys, vals, lens)
+        return KVPairs(keys, vals, lens, tags=tags or None)
 
 
 class KVServer(_App):

@@ -1,0 +1,168 @@
+"""Codec numerics (unit) + compressed end-to-end flows (integration).
+
+Mirrors the reference test strategy: codecs must round-trip within
+quantization error, and compressed training must converge like vanilla
+(ref: SURVEY.md §4; BSC numerics gradient_compression.cc:191-336)."""
+
+import numpy as np
+import pytest
+
+from geomx_tpu.compression import (
+    BroadcastCompressor, BscCodec, Fp16Codec, MpqSelector, TwoBitCodec,
+    decompress_payload, make_push_codec,
+)
+from geomx_tpu.compression.codecs import pack_sparse, unpack_sparse
+from geomx_tpu.core.config import Config, Topology
+from geomx_tpu.kvstore import Simulation
+
+
+# ---------- unit: pure codec numerics ----------------------------------------
+
+def test_fp16_roundtrip():
+    c = Fp16Codec()
+    x = np.random.default_rng(0).standard_normal(1000).astype(np.float32)
+    y = c.decompress(0, c.compress(0, x), 1000)
+    np.testing.assert_allclose(y, x, atol=2e-3)
+    assert c.compress(0, x).nbytes == x.nbytes // 2  # the 2x claim
+
+
+def test_sparse_pack_unpack_preserves_large_indices():
+    vals = np.array([1.5, -2.5], np.float32)
+    idx = np.array([7, 2**30 + 3], np.int64)  # > 2^24: float32 would corrupt
+    v2, i2 = unpack_sparse(pack_sparse(vals, idx))
+    np.testing.assert_array_equal(i2, idx)
+    np.testing.assert_array_equal(v2, vals)
+
+
+def test_2bit_packing_ratio_and_residual_feedback():
+    c = TwoBitCodec(threshold=0.5)
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal(4096).astype(np.float32)
+    payload = c.compress(0, x)
+    assert payload.nbytes == 1024  # 16x vs float32
+    # residual feedback: repeatedly sending the same gradient must
+    # asymptotically transmit its full mass (ref: residual scheme
+    # gradient_compression-inl.h:40-139)
+    g = np.full(512, 0.2, np.float32)  # below threshold: only residual
+    total = np.zeros_like(g)
+    c2 = TwoBitCodec(threshold=0.5)
+    n = 50
+    for _ in range(n):
+        total += c2.decompress(1, c2.compress(1, g), 512)
+    # mass conservation: emitted = pushed - residual, residual < thr + step
+    pushed = 0.2 * n
+    assert pushed - 0.71 <= total.mean() <= pushed + 1e-5, total.mean()
+
+
+def test_bsc_sends_top_entries_and_preserves_mass():
+    c = BscCodec(ratio=0.05, momentum=0.0, sample_rate=0.5, seed=0)
+    x = np.zeros(1000, np.float32)
+    x[::100] = np.arange(1, 11, dtype=np.float32)  # 10 spikes
+    payload = c.compress(0, x)
+    dense = c.decompress(0, payload, 1000)
+    # the largest spikes must be transmitted
+    assert dense[900] == 10.0
+    assert np.count_nonzero(dense) <= 120
+    # unsent mass stays in the accumulator and eventually drains
+    total = dense.copy()
+    for _ in range(30):
+        total += c.decompress(0, c.compress(0, np.zeros(1000, np.float32)), 1000)
+    np.testing.assert_allclose(total, x, atol=1e-5)
+
+
+def test_mpq_selector_splits_by_size():
+    m = MpqSelector(size_bound=100)
+    assert m.select(50) is m.fp16
+    assert m.select(100) is m.bsc
+
+
+def test_broadcast_compressor_view_tracking():
+    """Subscriber's reconstructed view converges to the true weights."""
+    bc = BroadcastCompressor(ratio=0.2)
+    w0 = np.zeros(100, np.float32)
+    bc.ensure_base(0, w0)
+    true_w = w0.copy()
+    sub_view = w0.copy()
+    rng = np.random.default_rng(3)
+    for step in range(30):
+        true_w = true_w + rng.standard_normal(100).astype(np.float32) * 0.1
+        payload = bc.compress("sub", 0, true_w)
+        sub_view = BroadcastCompressor.decompress_into(sub_view, payload)
+    # after enough rounds the tracked view is close to the truth
+    assert np.abs(sub_view - true_w).mean() < 0.2
+
+
+def test_make_push_codec_rejects_unknown():
+    with pytest.raises(ValueError):
+        make_push_codec({"type": "zstd"})
+
+
+# ---------- integration: compressed HiPS flows --------------------------------
+
+def _set_compression(sim, cfg):
+    """Rank-0 of each party configures its party server (ref semantics)."""
+    for p in range(sim.topology.num_parties):
+        sim.worker(p, 0).set_gradient_compression(cfg)
+
+
+def _train(sim, steps=4, tensor_size=4000, lr=0.05):
+    ws = sim.all_workers()
+    for w in ws:
+        w.init(0, np.zeros(tensor_size, np.float32))
+    ws[0].set_optimizer({"type": "sgd", "lr": lr})
+    rng = np.random.default_rng(0)
+    for _ in range(steps):
+        g = np.ones(tensor_size, np.float32) + 0.01 * rng.standard_normal(tensor_size).astype(np.float32)
+        for w in ws:
+            w.push(0, g)
+        outs = [w.pull_sync(0) for w in ws]
+    return outs
+
+
+@pytest.mark.parametrize("ctype", ["fp16", "2bit", "bsc", "mpq"])
+def test_compressed_training_moves_downhill(ctype):
+    cfg = Config(topology=Topology(num_parties=2, workers_per_party=1))
+    sim = Simulation(cfg)
+    try:
+        _set_compression(sim, {"type": ctype, "ratio": 0.05, "size_bound": 1000})
+        outs = _train(sim, steps=6)
+        for out in outs:
+            assert out.mean() < -0.05, f"{ctype}: no descent ({out.mean()})"
+        # all replicas agree
+        np.testing.assert_allclose(outs[0], outs[1], atol=1e-5)
+    finally:
+        sim.shutdown()
+
+
+def test_bsc_cuts_wan_bytes():
+    def run(compression):
+        cfg = Config(topology=Topology(num_parties=2, workers_per_party=1))
+        sim = Simulation(cfg)
+        try:
+            if compression:
+                _set_compression(sim, {"type": "bsc", "ratio": 0.01})
+            _train(sim, steps=4, tensor_size=100_000)
+            return sim.wan_bytes()["wan_send_bytes"]
+        finally:
+            sim.shutdown()
+
+    plain = run(None)
+    bsc = run("bsc")
+    assert bsc < plain * 0.2, (plain, bsc)
+
+
+def test_fp16_halves_wan_bytes():
+    def run(compression):
+        cfg = Config(topology=Topology(num_parties=2, workers_per_party=1))
+        sim = Simulation(cfg)
+        try:
+            if compression:
+                _set_compression(sim, {"type": "fp16"})
+            _train(sim, steps=4, tensor_size=100_000)
+            return sim.wan_bytes()["wan_send_bytes"]
+        finally:
+            sim.shutdown()
+
+    plain = run(None)
+    fp16 = run("fp16")
+    assert fp16 < plain * 0.65, (plain, fp16)
