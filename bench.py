@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Benchmark: CIFAR-10-shape CNN training throughput (images/sec/chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The north-star target (BASELINE.md) is >=0.9x the per-chip throughput of an
+A100 running the reference CUDA build on the same CNN.  No A100 is
+reachable from this environment, so ``A100_REF_IMAGES_PER_SEC`` is a
+provisional estimate for the reference 2-conv/3-dense CNN at batch 1024
+(small CNNs are input/launch-bound on big accelerators; revise when a
+measured number lands in BASELINE.json's `published`).  vs_baseline =
+value / (0.9 * A100_REF) so 1.0 means "met the >=0.9x target".
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from geomx_tpu.models import create_cnn_state
+
+# Provisional A100 reference for this tiny CNN at batch 1024: the workload
+# is input/launch-bound, so an A100 (312 bf16 TFLOPs) and a v5e chip land
+# in the same range; assume parity (~400k img/s) until BASELINE.json gains
+# a measured number.  vs_baseline ~1.0 therefore means "at the 0.9x-A100
+# target".
+A100_REF_IMAGES_PER_SEC = 400_000.0
+BATCH = 1024
+STEPS = 50
+
+
+def main():
+    rng = jax.random.PRNGKey(0)
+    model, params, _ = create_cnn_state(
+        rng, input_shape=(BATCH, 32, 32, 3), num_classes=10)
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, x, y):
+        logits = model.apply(p, x)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    @jax.jit
+    def train_step(p, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+        updates, opt_state = tx.update(grads, opt_state, p)
+        return optax.apply_updates(p, updates), opt_state, loss
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (BATCH, 32, 32, 3), dtype=np.float32))
+    y = jnp.asarray(np.random.default_rng(1).integers(0, 10, BATCH, dtype=np.int32))
+
+    # compile + warmup.  NOTE: a scalar readback (float(loss)) is the sync
+    # point — on remote-execution backends block_until_ready can return
+    # before the computation actually ran, inflating throughput ~100x.
+    params, opt_state, loss = train_step(params, opt_state, x, y)
+    _ = float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        params, opt_state, loss = train_step(params, opt_state, x, y)
+    _ = float(loss)  # chained deps: forces all STEPS steps to completion
+    dt = time.perf_counter() - t0
+
+    ips = BATCH * STEPS / dt
+    print(json.dumps({
+        "metric": "cifar10_cnn_images_per_sec_per_chip",
+        "value": round(ips, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips / (0.9 * A100_REF_IMAGES_PER_SEC), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

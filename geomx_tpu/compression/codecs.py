@@ -129,6 +129,14 @@ def unpack_sparse(payload: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return values, indices
 
 
+def scatter_sparse(payload: np.ndarray, orig_len: int) -> np.ndarray:
+    """Densify a [values ‖ indices] payload (shared by all bsc decoders)."""
+    vals, idx = unpack_sparse(payload)
+    out = np.zeros(orig_len, dtype=np.float32)
+    out[idx] = vals
+    return out
+
+
 class BscCodec(Codec):
     """Bi-Sparse push-direction compressor (DGC-style).
 
@@ -186,10 +194,7 @@ class BscCodec(Codec):
         return pack_sparse(vals, idx)
 
     def decompress(self, key, payload, orig_len):
-        vals, idx = unpack_sparse(payload)
-        out = np.zeros(orig_len, dtype=np.float32)
-        out[idx] = vals
-        return out
+        return scatter_sparse(payload, orig_len)
 
     @property
     def dense_delta(self) -> bool:
@@ -271,8 +276,13 @@ def make_push_codec(config: dict):
                         sample_rate=config.get("sample_rate", 0.005))
     if typ == "mpq":
         return MpqSelector(size_bound=config.get("size_bound", 200_000),
-                           ratio=config.get("ratio", 0.01))
+                           ratio=config.get("ratio", 0.01),
+                           momentum=config.get("momentum", 0.9),
+                           sample_rate=config.get("sample_rate", 0.005))
     raise ValueError(f"unknown compression type '{typ}'")
+
+
+_TWOBIT_DECODERS: Dict[float, TwoBitCodec] = {}
 
 
 def decompress_payload(compr: str, key: int, payload: np.ndarray,
@@ -281,10 +291,10 @@ def decompress_payload(compr: str, key: int, payload: np.ndarray,
     if compr == "fp16":
         return payload.astype(np.float32)
     if compr == "bsc":
-        vals, idx = unpack_sparse(payload)
-        out = np.zeros(orig_len, dtype=np.float32)
-        out[idx] = vals
-        return out
+        return scatter_sparse(payload, orig_len)
     if compr == "2bit":
-        return TwoBitCodec(threshold).decompress(key, payload, orig_len)
+        dec = _TWOBIT_DECODERS.get(threshold)
+        if dec is None:
+            dec = _TWOBIT_DECODERS[threshold] = TwoBitCodec(threshold)
+        return dec.decompress(key, payload, orig_len)
     raise ValueError(f"unknown compr tag '{compr}'")

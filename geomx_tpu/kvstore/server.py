@@ -238,7 +238,12 @@ class LocalServer:
 
     def _push_up_hfa(self, kvs: KVPairs):
         """K2 round: ship (mean_weights - milestone)/num_global_workers
-        (ref: milestone delta :1324-1343)."""
+        (ref: milestone delta :1324-1343).
+
+        The matching pull-down requests full (dense) weights — the local
+        store was just replaced by the party mean, so it has diverged from
+        any pull-compressor's tracked subscriber view; a sparse delta
+        against that view would corrupt the replica."""
         topo = self.po.topology
         with self._mu:
             ks, vs, ls = [], [], []
@@ -251,7 +256,7 @@ class LocalServer:
         keys = [int(k) for k in out.keys]
 
         def on_acked():
-            self.up.zpull(keys, cb=self._on_pull_down_hfa)
+            self.up.zpull(keys, cb=self._on_pull_down_hfa, cmd=Cmd.HFA_DELTA)
 
         self.up.zpush(out, cmd=Cmd.HFA_DELTA, on_complete=on_acked)
 
@@ -340,6 +345,11 @@ class LocalServer:
         elif msg.cmd == Ctrl.SET_COMPRESSION:
             from geomx_tpu.compression import make_push_codec
 
+            if body == self.compression:
+                # idempotent: a mid-training recreation would drop the
+                # unsent residual/velocity mass held in the old codec
+                self.server.reply_cmd(msg)
+                return
             try:
                 self.push_codec = make_push_codec(body)
                 self.compression = body
@@ -516,7 +526,12 @@ class GlobalServer:
             self._respond_pull(m)
 
     def _respond_pull(self, req: Message):
-        if self.pull_comp is not None or self.compression.get("type") == "fp16":
+        # HFA K2 pulls must come back dense: the subscriber's replica just
+        # adopted its party mean, so sparse deltas against the tracked
+        # view would desync it
+        hfa_pull = req.cmd == Cmd.HFA_DELTA
+        if not hfa_pull and (self.pull_comp is not None
+                             or self.compression.get("type") == "fp16"):
             self._respond_pull_compressed(req)
             return
         ks, vs, ls = [], [], []

@@ -1,0 +1,42 @@
+"""Device-mesh construction + sharding helpers.
+
+The reference scales with processes (workers × parties over ps-lite);
+the TPU build scales with a `jax.sharding.Mesh` — one party = one slice,
+and intra-party data parallelism is an XLA AllReduce over ICI instead of
+worker→local-server ZMQ pushes (SURVEY.md §7 design stance).
+
+Axis conventions used across the framework:
+- ``dp`` — data parallel (batch dim; gradient psum over ICI)
+- ``tp`` — tensor parallel (Megatron-style sharded matmuls)
+- ``sp`` — sequence/context parallel (ring attention over ICI neighbors)
+- ``ep`` — expert parallel (MoE experts; may alias tp on small meshes)
+- ``pp`` — pipeline stages (layer sharding)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh with the given axis sizes, e.g. {"dp": 2, "sp": 2, "tp": 2}.
+
+    Axis order follows dict order; prefer putting the most
+    communication-hungry axis (tp, then sp) innermost so its collectives
+    ride the fastest ICI neighbor links.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = int(np.prod(list(axes.values())))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    dev_array = np.asarray(devices[:n]).reshape(tuple(axes.values()))
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))

@@ -3,19 +3,27 @@
 Multi-chip hardware isn't available in CI; all sharding tests run on a
 virtual 8-device CPU platform (the driver separately dry-run-compiles the
 multi-chip path via __graft_entry__.dryrun_multichip).
+
+The sandbox's sitecustomize imports jax at interpreter start with
+JAX_PLATFORMS=axon (the real TPU tunnel), so env mutation alone is too
+late — switch the platform through jax.config before any backend is
+created, and set XLA_FLAGS (read lazily at first backend init) for the
+virtual device count.
 """
 
 import os
 import sys
 
-# hard-set: the sandbox exports JAX_PLATFORMS=axon (the real TPU tunnel),
-# which must not be used for unit tests
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["JAX_PLATFORM_NAME"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

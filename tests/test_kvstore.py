@@ -221,6 +221,28 @@ def test_unknown_compression_rejected():
         sim.shutdown()
 
 
+def test_hfa_with_bsc_pull_stays_dense_and_synced():
+    """HFA K2 pulls must come back dense even under bsc compression —
+    a sparse delta against the adopted party-mean would desync replicas."""
+    sim = make_sim(parties=2, workers=1, use_hfa=True, hfa_k2=1)
+    try:
+        ws = sim.all_workers()
+        for p in range(2):
+            sim.worker(p, 0).set_gradient_compression({"type": "bsc", "ratio": 0.01})
+        for w in ws:
+            w.init(0, np.zeros(1000, np.float32))
+        # HFA pushes are party-mean WEIGHTS; party p pushes p+1
+        for p, w in enumerate(ws):
+            w.push(0, np.full(1000, float(p + 1), np.float32))
+        outs = [w.pull_sync(0) for w in ws]
+        # global: 0 + ((1-0)+(2-0))/2 = 1.5, everywhere, exactly
+        for out in outs:
+            np.testing.assert_allclose(out, 1.5, rtol=1e-6)
+        np.testing.assert_allclose(sim.local_servers[0].store[list(sim.local_servers[0].store)[0]], 1.5)
+    finally:
+        sim.shutdown()
+
+
 def test_hfa_gating_reduces_wan_traffic():
     """HFA with k2=2: only every 2nd local round crosses the WAN
     (ref: kvstore_dist_server.h:1324-1343 K2 gate)."""

@@ -1,0 +1,98 @@
+"""Parallelism tests on the 8-device virtual CPU mesh: ring attention
+matches dense attention exactly; the flagship transformer's full train
+step compiles and runs under dp/sp/tp(+ep) shardings."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from geomx_tpu.models.transformer import (
+    TransformerConfig, init_params, lm_loss, make_apply, param_specs,
+)
+from geomx_tpu.parallel import make_mesh, ring_attention
+from geomx_tpu.parallel.ring_attention import dense_attention
+
+
+def test_has_8_devices():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(causal):
+    mesh = make_mesh({"sp": 4})
+    B, T, H, D = 2, 32, 2, 16  # global T = 32, 8 per device
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+
+    ref = dense_attention(q, k, v, causal=causal)
+
+    spec = P(None, "sp", None, None)
+    f = shard_map(
+        lambda a, b, c: ring_attention(a, b, c, axis_name="sp", axis_size=4,
+                                       causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    out = jax.jit(f)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_transformer_dense_forward_and_loss():
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                            d_ff=64, max_seq=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    apply_fn = make_apply(cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, (2, 16)), jnp.int32)
+    logits = jax.jit(apply_fn)(params, tokens)
+    assert logits.shape == (2, 16, 64)
+    loss = lm_loss(apply_fn, params, tokens)
+    assert np.isfinite(float(loss)) and float(loss) < 10
+
+
+def test_transformer_sharded_train_step_dp_sp_tp_ep():
+    """The dryrun_multichip path: full train step (fwd+bwd+adam) jitted
+    over a dp×sp×tp mesh with a MoE (ep) layer, on 8 virtual devices."""
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                            d_ff=64, max_seq=64, moe_every=2, n_experts=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    apply_fn = make_apply(cfg, mesh)
+    tx = optax.adam(1e-3)
+
+    specs = param_specs(cfg)
+    pshard = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    params = jax.device_put(params, pshard)
+    opt_state = tx.init(params)
+    tok_shard = NamedSharding(mesh, P("dp", "sp"))
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(apply_fn, p, tokens))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    tokens = jax.device_put(
+        jnp.asarray(np.random.default_rng(1).integers(0, 64, (4, 32)),
+                    jnp.int32), tok_shard)
+    p1, opt_state, loss1 = train_step(params, opt_state, tokens)
+    p2, _, loss2 = train_step(p1, opt_state, tokens)
+    assert np.isfinite(float(loss1))
+    assert float(loss2) < float(loss1)  # learns on the repeated batch
+
+    # sharded-vs-dense numerical agreement of the forward pass
+    dense_apply = make_apply(cfg)
+    dense_logits = dense_apply(jax.device_get(params), np.asarray(tokens))
+    shard_logits = jax.jit(apply_fn)(params, tokens)
+    np.testing.assert_allclose(np.asarray(shard_logits),
+                               np.asarray(dense_logits), rtol=3e-2, atol=3e-2)
