@@ -49,6 +49,13 @@ def main():
     ap.add_argument("--compression", default="none",
                     choices=["none", "fp16", "2bit", "bsc", "mpq"])
     ap.add_argument("--bsc-ratio", type=float, default=0.01)
+    ap.add_argument("--p3", action="store_true",
+                    help="priority-based parameter propagation (sliced "
+                         "sends + piggybacked pulls)")
+    ap.add_argument("--tsengine", action="store_true",
+                    help="TSEngine overlay dissemination (intra-party)")
+    ap.add_argument("--dgt", type=int, default=0, choices=[0, 1, 2],
+                    help="DGT transport mode (1=lossy channels, 2=reliable)")
     ap.add_argument("--hfa", action="store_true")
     ap.add_argument("--hfa-k1", type=int, default=2,
                     help="local steps between party syncs")
@@ -66,6 +73,10 @@ def main():
         bsc_ratio=args.bsc_ratio,
         use_hfa=args.hfa,
         hfa_k2=args.hfa_k2,
+        enable_p3=args.p3,
+        p3_slice_elems=50_000,
+        enable_intra_ts=args.tsengine,
+        enable_dgt=args.dgt,
     )
     sim = Simulation(cfg)
     x, y = synthetic_classification(n=4096, seed=args.seed)

@@ -51,7 +51,21 @@ class WorkerKVStore:
             targets=[topo.server(self.party)],
             key_ranges=split_range(1),
             domain=Domain.LOCAL,
+            owns_app=True,  # inbound TS relays route to this customer
         )
+        # TSEngine intra-party overlay: pulls are served from the relay
+        # buffer instead of the server (ref: KVWorker::AutoPull blocks on
+        # auto_pull_kvs_ kv_app.h:1408-1455)
+        self.ts_client = None
+        if self.config.enable_intra_ts:
+            from geomx_tpu.sched.tsengine import TsClient
+
+            self.ts_client = TsClient(postoffice, topo.scheduler(self.party))
+            self._ts_cv = threading.Condition()
+            self._ts_buf: Dict[int, np.ndarray] = {}
+            self._ts_count: Dict[int, int] = {}
+            self._push_rounds: Dict[int, int] = {}
+            self.worker.ts_handler = self._on_ts_relay
         self._shapes: Dict[int, tuple] = {}
         self._dtypes: Dict[int, np.dtype] = {}
         self._pending: List[int] = []
@@ -99,6 +113,24 @@ class WorkerKVStore:
         if barrier:
             self.barrier()
 
+    def _on_ts_relay(self, msg):
+        """Receive an overlay relay: buffer the model, confirm delivery,
+        relay onward per the scheduler (ref: TS_Process kv_app.h:1111-1179).
+        The relay loop runs on the TsClient's dissemination thread — never
+        on this customer thread, which must stay free to receive replies."""
+        from geomx_tpu.ps import KVPairs as _KVPairs
+
+        it = int(msg.body["iter"])
+        kvs = _KVPairs(msg.keys, msg.vals, msg.lens)
+        with self._ts_cv:
+            for k, v in kvs.slices():
+                self._ts_buf[k] = np.array(v, copy=True)
+                self._ts_count[k] = self._ts_count.get(k, 0) + 1
+            self._ts_cv.notify_all()
+        self.ts_client.send_reply(msg.sender, it)
+        self.ts_client.disseminate_async(msg.keys, msg.vals, msg.lens, it,
+                                         Cmd.TS_AUTOPULL)
+
     def push(self, tid: int, grad: np.ndarray, priority: int = 0) -> int:
         """Async push of a gradient (ref: kvstore_dist.h:460-528)."""
         flat = np.asarray(grad).astype(np.float32).ravel()
@@ -106,14 +138,38 @@ class WorkerKVStore:
                                cmd=Cmd.DEFAULT, priority=priority)
         with self._mu:
             self._last_push_ts[tid] = ts
+            if self.ts_client is not None:
+                self._push_rounds[tid] = self._push_rounds.get(tid, 0) + 1
         self._track(ts)
         return ts
 
     def pull(self, tid: int, cb: Callable[[int, np.ndarray], None],
              priority: int = 0) -> int:
         """Async pull; cb(tid, tensor) runs when all shards arrived
-        (ref: kvstore_dist.h:355-414 PullImpl)."""
+        (ref: kvstore_dist.h:355-414 PullImpl).
+
+        Under intra-TS the overlay delivers the model instead — block on
+        the relay buffer, no server round-trip (ref: AutoPull
+        kvstore_dist.h:393-398, kv_app.h:1408-1455)."""
         size = int(np.prod(self._shapes[tid])) if self._shapes[tid] else 1
+        # before any push the overlay has never relayed this tensor —
+        # fall through to a normal server pull (want == 0)
+        if self.ts_client is not None and self._push_rounds.get(tid, 0) > 0:
+            parts = {p.ps_key: p for p in self.plan.parts(tid, size)}
+            want = self._push_rounds.get(tid, 0)
+            with self._ts_cv:
+                ok = self._ts_cv.wait_for(
+                    lambda: all(self._ts_count.get(k, 0) >= want
+                                for k in parts),
+                    timeout=120.0)
+                if not ok:
+                    raise TimeoutError(
+                        f"{self.po.node}: TS overlay never delivered t{tid}")
+                out = np.empty(size, dtype=np.float32)
+                for k, p in parts.items():
+                    out[p.start:p.start + p.length] = self._ts_buf[k]
+            cb(tid, out.reshape(self._shapes[tid]).astype(self._dtypes[tid]))
+            return self.worker.customer.new_request(0)  # already complete
         keys = [p.ps_key for p in self.plan.parts(tid, size)]
         with self._mu:
             after = self._last_push_ts.get(tid)
@@ -123,6 +179,46 @@ class WorkerKVStore:
         )
         self._track(ts)
         return ts
+
+    def push_pull(self, tid: int, grad: np.ndarray,
+                  cb: Callable[[int, np.ndarray], None],
+                  priority: int = 0) -> List[int]:
+        """P3-style combined push+pull: one request PER SLICE so slices
+        are independently schedulable in the priority send queue, and the
+        push response carries the updated values when the round completes
+        (ref: P3_ZPush per slice kv_app.h:204-259 + fake-pull
+        kvstore_dist.h:355-363 — data arrives as push response)."""
+        from geomx_tpu.ps import KVPairs
+
+        flat = np.asarray(grad).astype(np.float32).ravel()
+        parts = self.plan.parts(tid, flat.size, priority)
+        out = np.empty(flat.size, dtype=np.float32)
+        remaining = [len(parts)]
+        shape, dtype = self._shapes[tid], self._dtypes[tid]
+
+        def make_cb(part):
+            def on_data(kvs):
+                for _, v in kvs.slices():
+                    out[part.start:part.start + part.length] = v
+                with self._mu:
+                    remaining[0] -= 1
+                    done = remaining[0] == 0
+                if done:
+                    cb(tid, out.reshape(shape).astype(dtype))
+            return on_data
+
+        tss = []
+        for p in parts:
+            kvs = KVPairs(np.array([p.ps_key], dtype=np.int64),
+                          flat[p.start:p.start + p.length],
+                          np.array([p.length], dtype=np.int64))
+            ts = self.worker.push_pull(kvs, cb=make_cb(p),
+                                       cmd=Cmd.DEFAULT, priority=priority)
+            tss.append(ts)
+            self._track(ts)
+        with self._mu:
+            self._last_push_ts[tid] = tss[-1]
+        return tss
 
     def pull_sync(self, tid: int, priority: int = 0) -> np.ndarray:
         out: Dict[int, np.ndarray] = {}

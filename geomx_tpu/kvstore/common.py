@@ -20,6 +20,8 @@ class Cmd(enum.IntEnum):
     HFA_DELTA = 2     # HFA milestone-delta push (applied additively, no
                       # optimizer — ref: HandleHFAAccumulate
                       # kvstore_dist_server.h:959-972)
+    TS_AUTOPULL = 3   # TSEngine overlay model relay (ref: AutoPullUpdate
+                      # kv_app.h:1040-1224)
 
 
 class Ctrl(enum.IntEnum):

@@ -87,6 +87,15 @@ class LocalServer:
         self._milestone: Dict[int, np.ndarray] = {}
         self.compression: dict = {"type": "none"}
         self.push_codec = None  # set by Ctrl.SET_COMPRESSION
+        # TSEngine intra-party dissemination (ref: DefaultAutoPull
+        # kvstore_dist_server.h:1368-1384)
+        self.ts_client = None
+        self._ts_iter = 0
+        if self.config.enable_intra_ts:
+            from geomx_tpu.sched.tsengine import TsClient
+
+            self.ts_client = TsClient(
+                postoffice, topo.scheduler(postoffice.node.party))
 
     # ---- request handling ---------------------------------------------------
     def _handle(self, msg: Message, kvs: Optional[KVPairs], server: KVServer):
@@ -134,20 +143,34 @@ class LocalServer:
                 st.in_flight = True
                 if st.count >= self.num_workers:
                     completed.append(k)
-        # ack the push immediately — workers overlap next layers
-        self.server.response(msg)
         if not self.sync_mode:
-            # async local tier: forward each worker's push up immediately;
-            # pulls always serve the current store (no round parking)
+            # async local tier: no rounds — clear the aggregation state
+            # FIRST (the accumulate loop above set in_flight), then serve
+            # any piggybacked pull from the current store and forward the
+            # push upward immediately
             with self._mu:
                 for k in kvs.keys:
                     st = self._keys[int(k)]
                     st.accum = None
                     st.count = 0
                     st.in_flight = False
+                if msg.pull:
+                    self._try_serve_pull_locked(msg)
+            if not msg.pull:
+                self.server.response(msg)
             self._push_up(KVPairs(kvs.keys, kvs.vals.astype(np.float32),
                                   kvs.lens))
             return
+        if msg.pull:
+            # P3 piggyback: the push response carries the updated values
+            # once the round completes (ref: server replies with values in
+            # the push-response when enable_p3, kvstore_dist_server.h:
+            # 1149-1165,1255-1267) — park it like a pull
+            with self._mu:
+                self._keys[int(msg.keys[0])].parked_pulls.append(msg)
+        else:
+            # ack the push immediately — workers overlap next layers
+            self.server.response(msg)
         if completed:
             self._round_complete(completed)
 
@@ -304,6 +327,15 @@ class LocalServer:
             st.parked_pulls.clear()
         for req in to_retry:
             self._try_serve_pull_locked(req)
+        if self.ts_client is not None:
+            # hand fresh weights to the overlay dissemination thread
+            ks = sorted(keys)
+            self._ts_iter += 1
+            self.ts_client.disseminate_async(
+                np.array(ks, dtype=np.int64),
+                np.concatenate([self.store[k].astype(np.float32) for k in ks]),
+                np.array([len(self.store[k]) for k in ks], dtype=np.int64),
+                self._ts_iter, Cmd.TS_AUTOPULL)
 
     def _drain_parked_locked(self, st: _KeyState):
         parked, st.parked_pulls = st.parked_pulls, []
@@ -371,6 +403,8 @@ class LocalServer:
         self.server.reply_cmd(msg)
 
     def stop(self):
+        if self.ts_client is not None:
+            self.ts_client.stop()
         self.server.stop()
         self.up.stop()
 

@@ -66,10 +66,23 @@ class _App:
         self.postoffice = postoffice
         self.cmd_handler: Optional[Callable[[Message], None]] = None
         self._cmd_responses: Dict[int, object] = {}
+        from geomx_tpu.transport.dgt import DgtReassembler
+
+        self._dgt_reasm = DgtReassembler()
         self.customer = Customer(
-            app_id, customer_id, self._process, postoffice,
+            app_id, customer_id, self._process_outer, postoffice,
             split_pull_queue=split_pull_queue, owns_app=owns_app,
         )
+
+    def _process_outer(self, msg: Message):
+        """DGT chunk reassembly in front of normal processing
+        (ref: Van::ProcessDataMsg reassembly before Customer::Accept)."""
+        if msg.seq >= 0:
+            whole = self._dgt_reasm.accept(msg)
+            if whole is None:
+                return
+            msg = whole
+        self._process(msg)
 
     def send_cmd(
         self,
@@ -136,12 +149,23 @@ class KVWorker(_App):
         targets: Sequence[NodeId],
         key_ranges: Sequence[KeyRange],
         domain: Domain = Domain.LOCAL,
+        owns_app: bool = False,
     ):
-        super().__init__(app_id, customer_id, postoffice)
+        super().__init__(app_id, customer_id, postoffice, owns_app=owns_app)
         assert len(targets) == len(key_ranges)
         self.targets = list(targets)
         self.key_ranges = list(key_ranges)
         self.domain = domain
+        # inbound-request hook (TSEngine overlay relays arrive at workers
+        # as data requests, ref: TS_Process kv_app.h:1111-1179)
+        self.ts_handler: Optional[Callable[[Message], None]] = None
+        # DGT chunking applies on the WAN domain when enabled
+        # (ref: KVServer::Send DGT branch kv_app.h:917-995)
+        self.dgt_sender = None
+        if postoffice.config.enable_dgt and domain is Domain.GLOBAL:
+            from geomx_tpu.transport.dgt import DgtSender
+
+            self.dgt_sender = DgtSender(postoffice.config)
         self._pull_bufs: Dict[int, List[KVPairs]] = {}
         self._pull_cbs: Dict[int, Callable[[KVPairs], None]] = {}
         self._pull_expected: Dict[int, int] = {}
@@ -189,12 +213,23 @@ class KVWorker(_App):
         parts = self._slice(kvs)
         ts = self.customer.new_request(len(parts), on_complete=on_complete)
         for sid, part in parts.items():
-            self.postoffice.van.send(Message(
+            m = Message(
                 recipient=self.targets[sid], domain=self.domain,
                 app_id=self.customer.app_id, customer_id=self.customer.customer_id,
                 timestamp=ts, request=True, push=True, cmd=cmd, priority=priority,
                 keys=part.keys, vals=part.vals, lens=part.lens, **msg_fields,
-            ))
+            )
+            # DGT applies only to recurring gradient pushes: INIT and HFA
+            # milestone deltas are one-shot — a dropped chunk would be
+            # permanent corruption, not a delayed update
+            if (self.dgt_sender is not None and cmd == 0
+                    and m.compr in ("", "fp16") and m.vals is not None
+                    and len(m.vals) > self.dgt_sender.block_size):
+                m.sender = self.postoffice.node  # split() copies sender
+                for chunk in self.dgt_sender.split(m):
+                    self.postoffice.van.send(chunk)
+            else:
+                self.postoffice.van.send(m)
         if wait:
             self.customer.wait(ts)
         return ts
@@ -273,7 +308,11 @@ class KVWorker(_App):
         if not msg.push and not msg.pull:
             self._handle_command(msg)
             return
-        assert not msg.request, f"KVWorker got a request: {msg}"
+        if msg.request:
+            if self.ts_handler is not None:
+                self.ts_handler(msg)
+                return
+            raise AssertionError(f"KVWorker got a request: {msg}")
         ts = msg.timestamp
         if msg.keys is not None and msg.vals is not None:
             # pull (or push_pull) response carrying data

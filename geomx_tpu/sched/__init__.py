@@ -1,0 +1,1 @@
+from geomx_tpu.sched.tsengine import TsScheduler, TsClient  # noqa: F401

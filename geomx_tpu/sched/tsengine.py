@@ -1,0 +1,210 @@
+"""TSEngine: adaptive overlay scheduling for model dissemination.
+
+Reimplements the reference's TSEngine pull direction (ref: van.cc:1312-1458
+ProcessAskPullCommand, kv_app.h:1040-1224 AutoPullUpdate relay,
+kvstore_dist_server.h:1368-1384 DefaultAutoPull): instead of every worker
+pulling from the server (star topology), the server sends the updated
+model to ONE node chosen by the scheduler; each receiver relays it onward
+to the next scheduler-chosen node, forming a dissemination chain/tree
+tuned by *observed throughput* — senders report the throughput of their
+last transfer, the scheduler keeps a matrix ``A[from][to]`` and picks the
+next receiver greedily with probability ``min(known_fraction,
+MAX_GREED_RATE_TS)``, else uniformly (ε-exploration, ref: van.cc:1312-1386).
+
+Scope: the intra-party tier (server → workers) is wired into the kvstore;
+the same scheduler serves any member set, so the inter-party tier (global
+server → local servers over DCN) reuses this machinery when enabled in a
+later round (Config.enable_inter_ts currently rejects loudly).
+
+Control plane: Control.ASK_PULL / Control.REPLY / Control.AUTOPULL_REPLY
+messages through Postoffice control hooks (ref: new control cmds
+message.h:135-136).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from geomx_tpu.core.config import Config, NodeId
+from geomx_tpu.ps.postoffice import Postoffice
+from geomx_tpu.transport.message import Control, Domain, Message
+
+
+class TsScheduler:
+    """Runs on a scheduler node; answers ASK_PULL with the next receiver.
+
+    Round state: a dissemination round (one model broadcast) is identified
+    by ``iter``; each member is served at most once per round (the busy
+    vector B1 of the reference, ref: van.h:198-204).
+    """
+
+    def __init__(self, postoffice: Postoffice, members: Sequence[NodeId],
+                 greed_rate: float = 0.9, seed: int = 0):
+        self.po = postoffice
+        self.members = [str(m) for m in members]
+        self.greed = greed_rate
+        self.A: Dict[str, Dict[str, float]] = {}  # A[from][to] = throughput
+        self._served: Dict[int, set] = {}
+        self._mu = threading.Lock()
+        self._rng = random.Random(seed)
+        postoffice.add_control_hook(self._on_control)
+
+    def _on_control(self, msg: Message) -> bool:
+        if msg.control is not Control.ASK_PULL:
+            return False
+        body = msg.body or {}
+        it = int(body.get("iter", 0))
+        sender = str(msg.sender)
+        # learn the reported throughput of the asker's last transfer
+        last, thr = body.get("last"), body.get("throughput")
+        if last is not None and thr is not None:
+            self.A.setdefault(sender, {})[last] = float(thr)
+        with self._mu:
+            served = self._served.setdefault(it, set())
+            candidates = [m for m in self.members
+                          if m not in served and m != sender]
+            if not candidates:
+                receiver = None
+                # round fully served: garbage-collect old rounds
+                for old in [k for k in self._served if k < it - 2]:
+                    del self._served[old]
+            else:
+                receiver = self._choose(sender, candidates)
+                served.add(receiver)
+        self.po.van.send(msg.reply_to(
+            control=Control.REPLY, body={"receiver": receiver, "iter": it}))
+        return True
+
+    def _choose(self, sender: str, candidates: List[str]) -> str:
+        known = self.A.get(sender, {})
+        known_frac = len([c for c in candidates if c in known]) / len(candidates)
+        if known and self._rng.random() < min(known_frac, self.greed):
+            best = max(candidates, key=lambda c: known.get(c, 0.0))
+            if known.get(best, 0.0) > 0.0:
+                return best
+        return self._rng.choice(candidates)
+
+
+class TsClient:
+    """Ask-the-scheduler helper + relay bookkeeping for one node
+    (ref: GetReceiver blocking ask van.cc:1474-1504)."""
+
+    def __init__(self, postoffice: Postoffice, scheduler: NodeId,
+                 domain: Domain = Domain.LOCAL):
+        import queue as _queue
+
+        self.po = postoffice
+        self.scheduler = scheduler
+        self.domain = domain
+        self._cv = threading.Condition()
+        self._replies: Dict[int, Optional[str]] = {}
+        self._acks: set = set()
+        self._seq = 0
+        postoffice.add_control_hook(self._on_control)
+        # dissemination runs on a dedicated thread: the ask/send loop
+        # blocks on round-trips, and blocking a customer/handler thread
+        # deadlocks when two nodes relay to each other concurrently
+        self._dq: "_queue.Queue" = _queue.Queue()
+        self._dissem_thread = threading.Thread(
+            target=self._dissem_loop, daemon=True,
+            name=f"ts-dissem-{postoffice.node}")
+        self._dissem_thread.start()
+
+    def disseminate_async(self, keys, vals, lens, it: int, cmd: int):
+        """Queue a relay round: ask the scheduler for receivers and send
+        until the round is fully served (ref: AutoPullUpdate loop
+        kv_app.h:1181-1224). Returns immediately."""
+        self._dq.put((keys, vals, lens, it, cmd))
+
+    def _dissem_loop(self):
+        while True:
+            job = self._dq.get()
+            if job is None:
+                return
+            keys, vals, lens, it, cmd = job
+            last, thr = None, None
+            try:
+                while True:
+                    recv = self.ask_receiver(it, last, thr)
+                    if recv is None:
+                        break
+                    thr = self.send_model(recv, keys, vals, lens, it, cmd)
+                    last = str(recv)
+            except TimeoutError:  # pragma: no cover - surfaced in logs
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "%s: TS dissemination round %d aborted", self.po.node, it)
+
+    def stop(self):
+        self._dq.put(None)
+
+    def _on_control(self, msg: Message) -> bool:
+        if msg.control is Control.REPLY and isinstance(msg.body, dict) \
+                and "receiver" in msg.body:
+            with self._cv:
+                self._replies[msg.timestamp] = msg.body["receiver"]
+                self._cv.notify_all()
+            return True
+        if msg.control is Control.AUTOPULL_REPLY:
+            # delivery confirmation from a relay receiver
+            # (ref: WaitForFinish van.cc:1142-1165)
+            with self._cv:
+                self._acks.add((str(msg.sender), int(msg.body["iter"])))
+                self._cv.notify_all()
+            return True
+        return False
+
+    def send_model(self, recipient: NodeId, keys, vals, lens, it: int,
+                   cmd: int, app_id: int = 0,
+                   timeout: float = 30.0) -> float:
+        """Send a model relay message; block for the receiver's
+        AUTOPULL_REPLY; return the observed throughput (bytes/sec)."""
+        ack_key = (str(recipient), it)
+        with self._cv:
+            self._acks.discard(ack_key)
+        msg = Message(
+            recipient=recipient, domain=self.domain, app_id=app_id,
+            customer_id=0, timestamp=-1, request=True, push=True, cmd=cmd,
+            keys=keys, vals=vals, lens=lens, body={"iter": it},
+        )
+        nbytes = msg.nbytes
+        t0 = time.monotonic()
+        self.po.van.send(msg)
+        with self._cv:
+            ok = self._cv.wait_for(lambda: ack_key in self._acks,
+                                   timeout=timeout)
+            if not ok:
+                raise TimeoutError(f"{self.po.node}: TS relay to "
+                                   f"{recipient} unacked")
+            self._acks.discard(ack_key)
+        elapsed = max(time.monotonic() - t0, 1e-9)
+        return nbytes / elapsed
+
+    def send_reply(self, to: NodeId, it: int):
+        self.po.van.send(Message(
+            recipient=to, control=Control.AUTOPULL_REPLY,
+            domain=self.domain, body={"iter": it},
+        ))
+
+    def ask_receiver(self, it: int, last: Optional[str] = None,
+                     throughput: Optional[float] = None,
+                     timeout: float = 30.0) -> Optional[NodeId]:
+        """Blocking: who should I send the round-``it`` model to next?"""
+        with self._cv:
+            self._seq += 1
+            seq = self._seq
+        self.po.van.send(Message(
+            recipient=self.scheduler, control=Control.ASK_PULL,
+            domain=self.domain, timestamp=seq,
+            body={"iter": it, "last": last, "throughput": throughput},
+        ))
+        with self._cv:
+            ok = self._cv.wait_for(lambda: seq in self._replies, timeout=timeout)
+            if not ok:
+                raise TimeoutError(f"{self.po.node}: TS ask_receiver timed out")
+            r = self._replies.pop(seq)
+        return NodeId.parse(r) if r else None

@@ -110,10 +110,18 @@ def run_worker(
             break
         loss, acc, grads = grad_fn(params, x, y)
         g_leaves, _ = jax.tree_util.tree_flatten(grads)
-        for tid, g in enumerate(g_leaves):
-            kv.push(tid, np.asarray(g) * scale, priority=-tid)
-        for tid in range(len(leaves)):
-            kv.pull(tid, lambda t, arr: buf.__setitem__(t, arr), priority=-tid)
+        if kv.config.enable_p3:
+            # P3: sliced combined push+pull, values ride the push response
+            for tid, g in enumerate(g_leaves):
+                kv.push_pull(tid, np.asarray(g) * scale,
+                             lambda t, arr: buf.__setitem__(t, arr),
+                             priority=-tid)
+        else:
+            for tid, g in enumerate(g_leaves):
+                kv.push(tid, np.asarray(g) * scale, priority=-tid)
+            for tid in range(len(leaves)):
+                kv.pull(tid, lambda t, arr: buf.__setitem__(t, arr),
+                        priority=-tid)
         kv.wait_all()
         params = unflatten_params(treedef, buf)  # type: ignore[arg-type]
         history.append((float(loss), float(acc)))

@@ -1,0 +1,189 @@
+"""DGT: Differential Gradient Transmission.
+
+Reimplements the reference's DGT data plane (ref: kv_app.h:841-995,
+van.cc:707-824, message.h:237-251): a large dense push is chunked into
+``block_size``-element blocks; each chunk's *contribution* (EWMA of its
+mean |gradient|, α = DGT_CONTRIBUTION_ALPHA) ranks it; the top ``k``
+fraction rides the reliable channel 0, the rest spread over N lossy
+priority channels.  The receiver reassembles on the reliable final chunk
+(which always travels channel 0, ref: kv_app.h:989-991) and fills chunks
+lost on the lossy channels with zeros — loss-tolerant best-effort for the
+unimportant mass.
+
+Transport mapping: the reference uses raw UDP sockets with DSCP marks;
+in-proc the lossy channels are fabric channels with a configurable drop
+rate, and on real DCN they map to secondary QUIC/UDP streams.  Modes
+(ref: ENABLE_DGT∈{1,2,3}, van.cc:750-824): 1 = lossy channels; 2 = all
+chunks reliable (chunking + prioritization only).  Mode 3's 4-bit
+re-quantization of unimportant chunks is not yet implemented — configure
+compression=fp16/bsc for bandwidth instead.
+
+Sparse payloads (bsc) are never chunked — dropping a chunk of a
+[values ‖ indices] payload would corrupt it; DGT applies to dense and
+fp16 pushes like the reference (MergeMsg/MergeMsg_HALF, van.cc:290-328).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomx_tpu.core.config import Config
+from geomx_tpu.transport.message import Message
+
+
+class DgtSender:
+    """Chunk + rank + assign channels.  One instance per sending endpoint
+    (holds the per-chunk contribution EWMA state)."""
+
+    def __init__(self, config: Config):
+        self.block_size = config.dgt_block_size
+        self.k = config.dgt_k
+        self.k_min = config.dgt_k_min
+        self.adaptive = config.dgt_adaptive_k
+        self.channels = max(1, config.dgt_udp_channels)
+        self.alpha = config.dgt_contrib_alpha
+        self.mode = config.enable_dgt
+        self._contrib: Dict[Tuple[int, int], float] = {}
+        self._steps = 0
+
+    def current_k(self) -> float:
+        """Adaptive k decays from k to k_min over training
+        (ref: ADAPTIVE_K_FLAG; the reference anneals with iteration)."""
+        if not self.adaptive:
+            return self.k
+        t = min(1.0, self._steps / 1000.0)
+        return self.k + (self.k_min - self.k) * t
+
+    def split(self, msg: Message) -> List[Message]:
+        """Split one data message into chunk messages. The final chunk
+        (seq == seq_end) carries the full meta (keys/lens/body) and always
+        rides channel 0 so completion always triggers."""
+        vals = msg.vals
+        assert vals is not None and vals.dtype in (np.float32, np.float16)
+        self._steps += 1
+        n = len(vals)
+        bs = self.block_size
+        nchunks = (n + bs - 1) // bs
+        first_key = int(msg.keys[0]) if msg.keys is not None and len(msg.keys) else -1
+
+        # contribution EWMA per (first_key, chunk index)
+        contribs = []
+        for c in range(nchunks):
+            blk = vals[c * bs:(c + 1) * bs]
+            mean_mag = float(np.mean(np.abs(blk.astype(np.float32))))
+            key = (first_key, c)
+            old = self._contrib.get(key)
+            ewma = mean_mag if old is None else (
+                self.alpha * mean_mag + (1 - self.alpha) * old)
+            self._contrib[key] = ewma
+            contribs.append(ewma)
+
+        order = np.argsort(-np.asarray(contribs), kind="stable")
+        k_cnt = max(1, int(np.ceil(self.current_k() * nchunks)))
+        channel_of = {}
+        for rank, c in enumerate(order):
+            if self.mode != 1 or rank < k_cnt:
+                channel_of[int(c)] = 0
+            else:
+                channel_of[int(c)] = 1 + (rank - k_cnt) % self.channels
+
+        out = []
+        for c in range(nchunks):
+            blk = vals[c * bs:(c + 1) * bs]
+            chunk = Message(
+                sender=msg.sender, recipient=msg.recipient, domain=msg.domain,
+                app_id=msg.app_id, customer_id=msg.customer_id,
+                timestamp=msg.timestamp, request=msg.request, push=msg.push,
+                pull=msg.pull, cmd=msg.cmd, priority=msg.priority,
+                compr=msg.compr, vals=blk,
+                first_key=first_key, seq=c, seq_begin=0, seq_end=nchunks - 1,
+                channel=channel_of[c],
+                total_bytes=n,            # total elements of the payload
+                val_bytes=c * bs,         # element offset of this chunk
+            )
+            if c == nchunks - 1:
+                # meta rides the completion chunk, always reliable; it also
+                # lists the reliable seqs so the receiver can wait for any
+                # channel-0 chunk lost to generic drop injection (they are
+                # retransmitted by the resender; lossy chunks are not)
+                chunk.keys = msg.keys
+                chunk.lens = msg.lens
+                chunk.channel = 0
+                channel_of[c] = 0
+                chunk.body = {
+                    "_dgt_reliable": [int(s) for s, ch in channel_of.items()
+                                      if ch == 0],
+                    "orig": msg.body,
+                }
+            out.append(chunk)
+        # send lossy/low-rank chunks first, completion chunk last
+        out.sort(key=lambda m: (m.seq == m.seq_end, -m.channel))
+        return out
+
+
+class DgtReassembler:
+    """Receiver side: merge chunks; finalize on the completion chunk,
+    zero-filling chunks lost on the lossy channels
+    (ref: ProcessDataMsg msg_map merge, van.cc:330-370)."""
+
+    def __init__(self):
+        import collections
+
+        self._buf: Dict[tuple, dict] = {}
+        self._mu = threading.Lock()
+        # finalized-round tombstones: stragglers (late retransmits of
+        # reliable chunks) must not recreate buffer entries
+        self._done = set()
+        self._done_order = collections.deque()
+        self._done_cap = 10_000
+
+    @staticmethod
+    def _key(msg: Message) -> tuple:
+        return (str(msg.sender), msg.app_id, msg.customer_id,
+                msg.timestamp, msg.first_key)
+
+    def accept(self, msg: Message) -> Optional[Message]:
+        """Returns the reassembled logical message when complete."""
+        key = self._key(msg)
+        with self._mu:
+            if key in self._done:
+                return None  # straggler retransmit of a finalized round
+            ent = self._buf.setdefault(key, {"chunks": {}, "final": None})
+            ent["chunks"][msg.seq] = msg
+            if msg.seq == msg.seq_end:
+                ent["final"] = msg
+            final = ent["final"]
+            if final is None:
+                return None
+            have = ent["chunks"]
+            # wait for every RELIABLE chunk (channel 0): those are either
+            # in-order before the final chunk or retransmitted by the
+            # resender; chunks lost on lossy channels are gone forever and
+            # get zero-filled
+            reliable = (final.body or {}).get("_dgt_reliable", [])
+            if any(s not in have for s in reliable):
+                return None
+            del self._buf[key]
+            self._done.add(key)
+            self._done_order.append(key)
+            if len(self._done_order) > self._done_cap:
+                self._done.discard(self._done_order.popleft())
+        total = final.total_bytes
+        vals = np.zeros(total, dtype=final.vals.dtype)
+        for s, chunk in have.items():
+            off = chunk.val_bytes
+            vals[off:off + len(chunk.vals)] = chunk.vals
+        out = Message(
+            sender=final.sender, recipient=final.recipient,
+            domain=final.domain, app_id=final.app_id,
+            customer_id=final.customer_id, timestamp=final.timestamp,
+            request=final.request, push=final.push, pull=final.pull,
+            cmd=final.cmd, priority=final.priority, compr=final.compr,
+            keys=final.keys, vals=vals, lens=final.lens,
+            body=(final.body or {}).get("orig"),
+        )
+        return out

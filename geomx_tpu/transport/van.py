@@ -249,7 +249,11 @@ class Van:
             self._send_now(msg)
 
     def _send_now(self, msg: Message):
-        if self._resend_timeout > 0 and msg.control is Control.EMPTY:
+        # lossy-by-design channels (DGT chunks, channel >= 1) are never
+        # resent — retransmitting "unimportant" chunks would defeat the
+        # best-effort design and leak reassembly buffers
+        if (self._resend_timeout > 0 and msg.control is Control.EMPTY
+                and msg.channel == 0):
             if msg.msg_sig < 0:
                 msg.msg_sig = next(self._sig_counter)
             self._pending_acks[msg.msg_sig] = [msg, time.monotonic(), 0]

@@ -120,15 +120,22 @@ def test_mixed_sync_async_global():
             w.push(0, np.ones(4, np.float32))
         for w in workers:
             w.wait_all()
-        # async tier: updates apply per party-push in arrival order, so a
-        # pull may observe an intermediate state — poll until both applied
+        # async tier: a party's replica refreshes only on its own push-up
+        # rounds, so after a single push it may legitimately hold a stale
+        # intermediate (-0.1).  Real async workers keep stepping — push
+        # zero-gradients (no-op updates) to refresh until both original
+        # updates are visible.
         import time
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline:
+            for w in workers:
+                w.push(0, np.zeros(4, np.float32))
+            for w in workers:
+                w.wait_all()
             arrs = [w.pull_sync(0) for w in workers]
             if all(np.allclose(a, -0.2, rtol=1e-5) for a in arrs):
                 break
-            time.sleep(0.05)
+            time.sleep(0.02)
         for arr in arrs:
             np.testing.assert_allclose(arr, -0.2, rtol=1e-5)
     finally:

@@ -1,0 +1,188 @@
+"""Scheduler tests: P3 priority propagation, DGT transport, TSEngine overlay."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomx_tpu.core.config import Config, Topology
+from geomx_tpu.kvstore import Simulation
+
+
+def make_sim(parties=1, workers=2, **cfg_kw):
+    cfg = Config(
+        topology=Topology(num_parties=parties, workers_per_party=workers),
+        **cfg_kw,
+    )
+    return Simulation(cfg)
+
+
+# ---------------- P3 ----------------------------------------------------------
+
+def test_p3_push_pull_trains_and_slices():
+    """P3 mode: big tensors slice into independent keyed requests; values
+    return on the push response; result matches plain FSA."""
+    sim = make_sim(parties=2, workers=1, enable_p3=True, p3_slice_elems=100)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(350, np.float32))  # → 4 slices of ≤100
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        got = {}
+        for i, w in enumerate(ws):
+            w.push_pull(0, np.ones(350, np.float32),
+                        lambda t, arr, i=i: got.__setitem__(i, arr))
+        for w in ws:
+            w.wait_all()
+        # avg grad over 2 parties = 1; lr 0.1 → -0.1 everywhere
+        for i in range(2):
+            np.testing.assert_allclose(got[i], -0.1, rtol=1e-5)
+        # slicing actually happened: local server holds 4 keys
+        assert len(sim.local_servers[0].store) == 4
+    finally:
+        sim.shutdown()
+
+
+# ---------------- DGT ---------------------------------------------------------
+
+def _mk_push_msg(vals, key=7):
+    from geomx_tpu.core.config import NodeId, Role
+    from geomx_tpu.transport.message import Domain, Message
+    return Message(
+        sender=NodeId(Role.SERVER, 0, 0), recipient=NodeId(Role.GLOBAL_SERVER, 0),
+        domain=Domain.GLOBAL, app_id=0, customer_id=1, timestamp=5,
+        request=True, push=True, cmd=0,
+        keys=np.array([key], np.int64), vals=vals,
+        lens=np.array([len(vals)], np.int64),
+    )
+
+
+def test_dgt_split_reassemble_lossless():
+    from geomx_tpu.transport.dgt import DgtReassembler, DgtSender
+    cfg = Config(enable_dgt=1, dgt_block_size=100, dgt_k=0.3,
+                 dgt_udp_channels=3)
+    snd = DgtSender(cfg)
+    vals = np.random.default_rng(0).standard_normal(950).astype(np.float32)
+    chunks = snd.split(_mk_push_msg(vals))
+    assert len(chunks) == 10
+    assert chunks[-1].seq == chunks[-1].seq_end and chunks[-1].channel == 0
+    # top-30% contribution chunks ride channel 0
+    assert sum(1 for c in chunks if c.channel == 0) >= 3
+    rs = DgtReassembler()
+    out = None
+    for c in chunks:
+        out = rs.accept(c) or out
+    assert out is not None
+    np.testing.assert_array_equal(out.vals, vals)
+    np.testing.assert_array_equal(out.keys, [7])
+    assert out.timestamp == 5 and out.push and out.request
+
+
+def test_dgt_drops_zero_fill_unimportant_only():
+    from geomx_tpu.transport.dgt import DgtReassembler, DgtSender
+    cfg = Config(enable_dgt=1, dgt_block_size=100, dgt_k=0.2,
+                 dgt_udp_channels=2)
+    snd = DgtSender(cfg)
+    vals = np.zeros(1000, np.float32)
+    vals[:200] = 10.0   # two high-contribution blocks
+    vals[200:] = 0.01   # low-contribution tail
+    chunks = snd.split(_mk_push_msg(vals))
+    rs = DgtReassembler()
+    out = None
+    for c in chunks:
+        if c.channel >= 1:
+            continue  # the "network" drops every lossy chunk
+        out = rs.accept(c) or out
+    assert out is not None
+    np.testing.assert_array_equal(out.vals[:200], 10.0)  # important survived
+    # the completion chunk (last block) is always reliable; everything
+    # else in the low-contribution tail was dropped and zero-filled
+    assert np.count_nonzero(out.vals[200:900]) == 0
+    np.testing.assert_allclose(out.vals[900:], 0.01, rtol=1e-6)
+
+
+def test_dgt_training_descends_under_loss():
+    """enable_dgt=1 with 60% loss on lossy channels: flow completes and
+    the model still moves downhill (important chunks always arrive)."""
+    from geomx_tpu.transport.van import FaultPolicy
+    cfg = Config(
+        topology=Topology(num_parties=2, workers_per_party=1),
+        enable_dgt=1, dgt_block_size=256, dgt_k=0.3, dgt_udp_channels=2,
+    )
+    sim = Simulation(cfg, fault=FaultPolicy(channel_drop_rate=0.6, seed=5))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(4096, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            g = np.abs(rng.standard_normal(4096)).astype(np.float32)
+            for w in ws:
+                w.push(0, g)
+            outs = [w.pull_sync(0) for w in ws]
+        for out in outs:
+            assert out.mean() < -0.01, out.mean()
+        np.testing.assert_allclose(outs[0], outs[1], atol=1e-5)
+    finally:
+        sim.shutdown()
+
+
+# ---------------- TSEngine ----------------------------------------------------
+
+def test_tsengine_overlay_delivers_updates():
+    """Intra-TS: workers never pull from the server; the scheduler-driven
+    relay chain delivers every round's model to every worker."""
+    sim = make_sim(parties=1, workers=3, enable_intra_ts=True)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(64, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        got = {}
+        for step in range(3):
+            for w in ws:
+                w.push(0, np.ones(64, np.float32))
+            for i, w in enumerate(ws):
+                w.pull(0, lambda t, arr, i=i: got.__setitem__(i, arr))
+            for w in ws:
+                w.wait_all()
+        # grads: party sum = 3, /num_workers scale not applied here →
+        # global grad 3 per step, lr .1 → -0.3/step × 3 steps
+        for i in range(3):
+            np.testing.assert_allclose(got[i], -0.9, rtol=1e-5)
+        # the scheduler's throughput matrix learned something
+        A = sim.ts_schedulers[0].A
+        assert len(A) > 0
+    finally:
+        sim.shutdown()
+
+
+def test_tsengine_scheduler_greedy_prefers_fast_links():
+    """With a fully-known throughput row, greed picks the argmax."""
+    from geomx_tpu.sched.tsengine import TsScheduler
+
+    class FakePO:
+        class van:
+            @staticmethod
+            def send(msg):
+                pass
+        @staticmethod
+        def add_control_hook(h):
+            pass
+
+    s = TsScheduler(FakePO, ["w0", "w1", "w2"], greed_rate=1.0, seed=0)
+    s.A["server"] = {"w0": 1.0, "w1": 100.0, "w2": 2.0}
+    picks = [s._choose("server", ["w0", "w1", "w2"]) for _ in range(10)]
+    assert all(p == "w1" for p in picks)
+
+
+def test_p3_priority_queue_on_van():
+    """enable_p3 switches worker vans to priority send queues."""
+    sim = make_sim(parties=1, workers=1, enable_p3=True)
+    try:
+        w = sim.topology.workers(0)[0]
+        assert sim.offices[str(w)].van.use_priority_queue
+    finally:
+        sim.shutdown()
