@@ -1,0 +1,53 @@
+"""Server-state checkpoint/restore.
+
+The reference keeps server model state only in RAM and supports
+client-side optimizer-state saves that are explicitly unsupported for
+distributed updaters (ref: python/mxnet/kvstore.py:566-591;
+kvstore_dist_server.h:1923 store_ map) — SURVEY.md §7 flags server-side
+checkpointing as an improvement to build.  Format: a single .npz holding
+the weight slabs keyed by ps-key plus pickled optimizer state, written
+atomically (tmp + rename) so a crash mid-save never corrupts the last
+good checkpoint.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import tempfile
+from typing import Dict
+
+import numpy as np
+
+
+def save_server_state(path: str, store: Dict[int, np.ndarray],
+                      optimizer_state: dict, meta: dict) -> None:
+    payload: Dict[str, np.ndarray] = {
+        f"k{k}": v for k, v in store.items()
+    }
+    payload["__opt__"] = np.frombuffer(
+        pickle.dumps(optimizer_state, protocol=4), dtype=np.uint8)
+    payload["__meta__"] = np.frombuffer(
+        pickle.dumps(meta, protocol=4), dtype=np.uint8)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_server_state(path: str):
+    """Returns (store, optimizer_state, meta)."""
+    with np.load(path, allow_pickle=False) as z:
+        store = {int(name[1:]): z[name] for name in z.files
+                 if name.startswith("k")}
+        opt = pickle.loads(z["__opt__"].tobytes())
+        meta = pickle.loads(z["__meta__"].tobytes())
+    return store, opt, meta

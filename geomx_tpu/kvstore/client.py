@@ -290,6 +290,57 @@ class WorkerKVStore:
         self.worker.send_cmd(self.po.topology.server(self.party),
                              Ctrl.SET_HFA, body={"enabled": enabled, "k2": k2})
 
+    def num_dead_nodes(self) -> int:
+        """Dead nodes known to my party scheduler (heartbeat timeouts,
+        ref: kv.get_num_dead_node kvstore_dist.h:225-234)."""
+        return len(self.po.query_dead_nodes())
+
+    def set_server_profiler(self, action: str, include_global: bool = True,
+                            **kw) -> List[dict]:
+        """Remote profiler control on servers (ref: SetServerProfilerCommand
+        include/mxnet/kvstore.h:442).  Returns each server's stats reply."""
+        body = {"action": action, **kw}
+        targets = [(self.po.topology.server(self.party), Domain.LOCAL)]
+        if include_global:
+            targets += [(gs, Domain.GLOBAL)
+                        for gs in self.po.topology.global_servers()]
+        # overlap the round-trips: send all, then collect
+        tss = [self.worker.send_cmd(n, Ctrl.PROFILER, body=body,
+                                    domain=d, wait=False)
+               for n, d in targets]
+        out = []
+        for ts in tss:
+            self.worker.wait(ts)
+            out.append(self.worker.cmd_response(ts))
+        return out
+
+    def save_server_checkpoints(self, directory: str) -> List[str]:
+        """Checkpoint every global server's state (weights + optimizer) to
+        ``directory`` (an improvement over the reference, which keeps
+        server state only in RAM — SURVEY.md §5)."""
+        return self._checkpoint_cmd("save", directory)
+
+    def load_server_checkpoints(self, directory: str):
+        self._checkpoint_cmd("load", directory)
+
+    def _checkpoint_cmd(self, action: str, directory: str) -> List[str]:
+        """One overlapped round-trip to every global server."""
+        jobs = []
+        for gs in self.po.topology.global_servers():
+            path = f"{directory}/global_server_{gs.rank}.npz"
+            ts = self.worker.send_cmd(
+                gs, Ctrl.CHECKPOINT, body={"action": action, "path": path},
+                domain=Domain.GLOBAL, wait=False)
+            jobs.append((ts, path))
+        paths = []
+        for ts, path in jobs:
+            self.worker.wait(ts)
+            reply = self.worker.cmd_response(ts)
+            if isinstance(reply, dict) and "error" in reply:
+                raise RuntimeError(reply["error"])
+            paths.append(path)
+        return paths
+
     def server_stats(self) -> dict:
         """WAN byte counters from my local server (observability,
         ref: van.h:180-181 byte counters; kv.get_num_dead_node-style query)."""

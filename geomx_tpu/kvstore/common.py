@@ -38,3 +38,5 @@ class Ctrl(enum.IntEnum):
     STOP_SERVER = 15
     PROFILER = 16              # body: {"action": "config"|"state"|"pause"|"dump", ...}
     QUERY_STATS = 17           # body: None → reply {"wan_send_bytes": ..., ...}
+    CHECKPOINT = 18            # body: {"action": "save"|"load", "path": ...}
+    DEAD_NODES = 19            # scheduler query → reply {"dead": [...]}

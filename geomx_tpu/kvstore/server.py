@@ -21,8 +21,10 @@ machines, as SURVEY.md §7 mandates.  The FSA data flow it implements
   (ref :1519-1698).
 
 Compression: configured via Ctrl.SET_COMPRESSION like the reference's
-kSetGradientCompression; until the geomx_tpu.compression codecs are wired
-into the push-up/pull-down paths, non-"none" types are rejected loudly.
+kSetGradientCompression; the geomx_tpu.compression codecs apply on the
+push-up path (per-key, grouped by codec) and on pull responses
+(per-subscriber sparsified deltas / fp16), with unknown types rejected
+loudly.
 """
 
 from __future__ import annotations
@@ -38,6 +40,31 @@ from geomx_tpu.optim import DCASGD, ServerOptimizer, Sgd, make_optimizer
 from geomx_tpu.ps import KVPairs, KVServer, KVWorker, Postoffice
 from geomx_tpu.ps.postoffice import split_range
 from geomx_tpu.transport.message import Domain, Message
+
+
+def _handle_profiler_cmd(po: Postoffice, msg: Message, server: KVServer):
+    """Remote profiler control on a server (ref: GeoMX's
+    ProcessServerProfilerCommands kvstore_dist_server.h:409-456 — workers
+    configure/start/pause/dump server profilers; dumps are node-prefixed
+    like the reference's rank-prefixed filenames)."""
+    from geomx_tpu.utils import get_profiler
+
+    p = get_profiler(str(po.node))
+    body = msg.body or {}
+    action = body.get("action")
+    if action == "config":
+        p.configure(process_name=body.get("process_name"))
+    elif action == "state":
+        p.start() if body.get("run") else p.pause()
+    elif action == "pause":
+        p.pause()
+    elif action == "reset":
+        p.reset()
+    elif action == "dump":
+        prefix = body.get("path", "profile")
+        safe = str(po.node).replace(":", "_").replace("@", "_")
+        p.dump(f"{prefix}.{safe}.json")
+    server.reply_cmd(msg, body=p.stats())
 
 
 class _KeyState:
@@ -99,12 +126,19 @@ class LocalServer:
 
     # ---- request handling ---------------------------------------------------
     def _handle(self, msg: Message, kvs: Optional[KVPairs], server: KVServer):
+        from geomx_tpu.utils import get_profiler
+
+        prof = get_profiler(str(self.po.node))
         if msg.cmd == Cmd.INIT:
-            self._handle_init(msg, kvs)
+            with prof.span("local.init"):
+                self._handle_init(msg, kvs)
         elif msg.push:
-            self._handle_push(msg, kvs)
+            with prof.span("local.push"):
+                self._handle_push(msg, kvs)
+            prof.count("push_bytes", float(msg.nbytes))
         elif msg.pull:
-            self._handle_pull(msg, kvs)
+            with prof.span("local.pull"):
+                self._handle_pull(msg, kvs)
 
     def _handle_init(self, msg: Message, kvs: KVPairs):
         with self._mu:
@@ -221,6 +255,10 @@ class LocalServer:
             self._finish_round(list(kvs.keys))
 
     def _push_up(self, kvs: KVPairs):
+        from geomx_tpu.utils import get_profiler
+
+        prof = get_profiler(str(self.po.node))
+        prof.count("wan_rounds", 1.0)
         keys = [int(k) for k in kvs.keys]
 
         def pull_down():
@@ -400,6 +438,9 @@ class LocalServer:
                 "recv_bytes": van.recv_bytes,
             })
             return
+        elif msg.cmd == Ctrl.PROFILER:
+            _handle_profiler_cmd(self.po, msg, self.server)
+            return
         self.server.reply_cmd(msg)
 
     def stop(self):
@@ -442,6 +483,18 @@ class GlobalServer:
         self.server.cmd_handler = self._on_cmd
 
     def _handle(self, msg: Message, kvs: Optional[KVPairs], server: KVServer):
+        from geomx_tpu.utils import get_profiler
+
+        prof = get_profiler(str(self.po.node))
+        if msg.push and msg.cmd != Cmd.INIT:
+            prof.count("push_bytes", float(msg.nbytes))
+        span_name = ("global.init" if msg.cmd == Cmd.INIT
+                     else "global.push" if msg.push else "global.pull")
+        with prof.span(span_name):
+            self._handle_inner(msg, kvs, server)
+
+    def _handle_inner(self, msg: Message, kvs: Optional[KVPairs],
+                      server: KVServer):
         if msg.cmd == Cmd.INIT:
             with self._mu:
                 for k, v in kvs.slices():
@@ -611,6 +664,21 @@ class GlobalServer:
             body={"compr": tags},
         )
 
+    def _apply_compression_locked(self, body: dict):
+        """Install a compression config (caller holds self._mu)."""
+        from geomx_tpu.compression import BroadcastCompressor
+
+        self.compression = body
+        if body.get("type") in ("bsc", "mpq"):
+            pc = BroadcastCompressor(ratio=body.get("ratio", 0.01))
+            for k, v in self.store.items():
+                pc.ensure_base(k, v)
+            # publish only after bases are seeded (pulls run on a
+            # separate thread under this same lock)
+            self.pull_comp = pc
+        else:
+            self.pull_comp = None
+
     # ---- control ------------------------------------------------------------
     def _on_cmd(self, msg: Message):
         body = msg.body or {}
@@ -619,7 +687,7 @@ class GlobalServer:
             # global server (kvstore.py:452-499, kvstore_dist_server.h:357-364)
             self.optimizer = make_optimizer(body)
         elif msg.cmd == Ctrl.SET_COMPRESSION:
-            from geomx_tpu.compression import BroadcastCompressor, make_push_codec
+            from geomx_tpu.compression import make_push_codec
 
             try:
                 make_push_codec(body)  # validate
@@ -633,16 +701,7 @@ class GlobalServer:
                     # tracked subscriber views
                     self.server.reply_cmd(msg)
                     return
-                self.compression = body
-                if body.get("type") in ("bsc", "mpq"):
-                    pc = BroadcastCompressor(ratio=body.get("ratio", 0.01))
-                    for k, v in self.store.items():
-                        pc.ensure_base(k, v)
-                    # publish only after bases are seeded (pulls run on a
-                    # separate thread under this same lock)
-                    self.pull_comp = pc
-                else:
-                    self.pull_comp = None
+                self._apply_compression_locked(body)
         elif msg.cmd == Ctrl.SET_SYNC_GLOBAL_MODE:
             self.sync_mode = bool(body["sync"])
         elif msg.cmd == Ctrl.QUERY_STATS:
@@ -651,6 +710,38 @@ class GlobalServer:
                 "wan_send_bytes": van.wan_send_bytes,
                 "wan_recv_bytes": van.wan_recv_bytes,
             })
+            return
+        elif msg.cmd == Ctrl.PROFILER:
+            _handle_profiler_cmd(self.po, msg, self.server)
+            return
+        elif msg.cmd == Ctrl.CHECKPOINT:
+            from geomx_tpu.kvstore import checkpoint as ckpt
+
+            try:
+                if body["action"] == "save":
+                    with self._mu:
+                        ckpt.save_server_state(
+                            body["path"], self.store,
+                            {"optimizer": self.optimizer},
+                            {"sync_mode": self.sync_mode,
+                             "compression": self.compression})
+                elif body["action"] == "load":
+                    store, opt, meta = ckpt.load_server_state(body["path"])
+                    with self._mu:
+                        self.store = {k: np.array(v) for k, v in store.items()}
+                        for k in self.store:
+                            self._keys.setdefault(k, _GlobalKeyState())
+                        self.optimizer = opt["optimizer"]
+                        # resume under the checkpointed config, not
+                        # whatever this fresh process happened to default to
+                        self.sync_mode = meta.get("sync_mode", self.sync_mode)
+                        self._apply_compression_locked(
+                            meta.get("compression", self.compression))
+                        for k in list(self.store):
+                            self._serve_parked_pulls_locked(k)
+                self.server.reply_cmd(msg, body={"ok": True})
+            except Exception as e:  # surface failures to the caller
+                self.server.reply_cmd(msg, body={"error": repr(e)})
             return
         self.server.reply_cmd(msg)
 

@@ -1,0 +1,169 @@
+"""Single-role process entry point for real (multi-process) deployments.
+
+The reference launches one OS process per role with env-var role
+injection (ref: 3rdparty/ps-lite/tracker/dmlc_local.py,
+scripts/cpu/run_vanilla_hips.sh — 12 processes for 2 parties + central).
+This module is the equivalent:
+
+    python -m geomx_tpu.launch --role scheduler:0@p0 --parties 2 --workers 2
+    python -m geomx_tpu.launch --role server:0@p0    ...
+    python -m geomx_tpu.launch --role worker:0@p0    ...
+    python -m geomx_tpu.launch --role global_scheduler:0 ...
+    python -m geomx_tpu.launch --role global_server:0 ...
+
+Role/topology can also come from env (GEOMX_ROLE, GEOMX_NUM_PARTIES,
+GEOMX_WORKERS_PER_PARTY, GEOMX_NUM_GLOBAL_SERVERS, GEOMX_BASE_PORT,
+GEOMX_NODE_HOSTS), mirroring the reference's DMLC_* env surface.
+Workers run the demo CNN training; non-worker roles serve until a
+TERMINATE control message arrives (sent by worker rank-0 of party 0 when
+training finishes), like the reference's kStopServer flow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+from geomx_tpu.core.config import Config, NodeId, Role, Topology
+from geomx_tpu.ps import Postoffice
+from geomx_tpu.transport.message import Control, Domain, Message
+from geomx_tpu.transport.tcp import TcpFabric, default_address_plan
+
+
+def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
+                  hosts=None):
+    """Construct the postoffice + role object for one node."""
+    plan = default_address_plan(config.topology, base_port, hosts)
+    fabric = TcpFabric(plan, config=config)
+    po = Postoffice(node, config.topology, fabric, config)
+    stop_ev = threading.Event()
+
+    def on_control(msg: Message) -> bool:
+        if msg.control is Control.TERMINATE:
+            stop_ev.set()
+            return True
+        return False
+
+    po.add_control_hook(on_control)
+    po.start()
+
+    role_obj = None
+    if node.role is Role.SERVER:
+        from geomx_tpu.kvstore.server import LocalServer
+
+        role_obj = LocalServer(po, config)
+    elif node.role is Role.GLOBAL_SERVER:
+        from geomx_tpu.kvstore.server import GlobalServer
+
+        role_obj = GlobalServer(po, config)
+    elif node.role is Role.SCHEDULER and config.enable_intra_ts:
+        from geomx_tpu.sched.tsengine import TsScheduler
+
+        role_obj = TsScheduler(po, config.topology.workers(node.party),
+                               greed_rate=config.ts_max_greed_rate)
+    elif node.role is Role.WORKER:
+        from geomx_tpu.kvstore.client import WorkerKVStore
+
+        role_obj = WorkerKVStore(po, config)
+    return po, role_obj, stop_ev
+
+
+def shutdown_cluster(po: Postoffice):
+    """Broadcast TERMINATE to every non-worker node (worker rank-0 of
+    party 0 calls this after training, ref: kStopServer)."""
+    topo = po.topology
+    targets = []
+    for p in range(topo.num_parties):
+        targets.append((topo.server(p), Domain.LOCAL))
+        targets.append((topo.scheduler(p), Domain.LOCAL))
+    for gs in topo.global_servers():
+        targets.append((gs, Domain.GLOBAL))
+    targets.append((topo.global_scheduler(), Domain.GLOBAL))
+    for node, domain in targets:
+        try:
+            po.van.send(Message(recipient=node, control=Control.TERMINATE,
+                                domain=domain))
+        except (KeyError, OSError):
+            pass
+
+
+def _worker_demo(po, kv, args):
+    """The reference demo workload (examples/cnn.py) for launcher smoke
+    runs: tiny CNN on synthetic data."""
+    import jax
+    import numpy as np
+
+    from geomx_tpu.data import ShardedIterator, synthetic_classification
+    from geomx_tpu.models import create_cnn_state
+    from geomx_tpu.training import run_worker
+
+    x, y = synthetic_classification(n=512, shape=(12, 12, 1), seed=0)
+    _, params, grad_fn = create_cnn_state(
+        jax.random.PRNGKey(0), input_shape=(1, 12, 12, 1))
+    widx = kv.party * kv.num_workers + kv.rank
+    if kv.party == 0 and kv.rank == 0:
+        kv.set_optimizer({"type": "adam", "lr": 0.01})
+    if kv.rank == 0 and args.compression != "none":
+        kv.set_gradient_compression({"type": args.compression})
+    kv.barrier()
+    it = ShardedIterator(x, y, args.batch, widx, kv.num_all_workers)
+    hist = run_worker(kv, params, grad_fn, it, args.steps, barrier_init=True)
+    print(f"{po.node}: steps={len(hist)} first_loss={hist[0][0]:.4f} "
+          f"last_loss={hist[-1][0]:.4f}", flush=True)
+    kv.barrier()
+    if kv.party == 0 and kv.rank == 0:
+        time.sleep(0.5)  # let sibling parties drain their last rounds
+        shutdown_cluster(po)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", default=os.environ.get("GEOMX_ROLE"))
+    ap.add_argument("--parties", type=int,
+                    default=int(os.environ.get("GEOMX_NUM_PARTIES", "1")))
+    ap.add_argument("--workers", type=int,
+                    default=int(os.environ.get("GEOMX_WORKERS_PER_PARTY", "1")))
+    ap.add_argument("--global-servers", type=int,
+                    default=int(os.environ.get("GEOMX_NUM_GLOBAL_SERVERS", "1")))
+    ap.add_argument("--base-port", type=int,
+                    default=int(os.environ.get("GEOMX_BASE_PORT", "9200")))
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--compression", default="none")
+    ap.add_argument("--hfa", action="store_true")
+    ap.add_argument("--p3", action="store_true")
+    ap.add_argument("--tsengine", action="store_true")
+    ap.add_argument("--sync", default="fsa", choices=["fsa", "mixed"])
+    ap.add_argument("--dgt", type=int, default=0, choices=[0, 1, 2])
+    args = ap.parse_args(argv)
+    if not args.role:
+        ap.error("--role or GEOMX_ROLE required")
+
+    node = NodeId.parse(args.role)
+    cfg = Config(
+        topology=Topology(num_parties=args.parties,
+                          workers_per_party=args.workers,
+                          num_global_servers=args.global_servers),
+        compression=args.compression,
+        use_hfa=args.hfa,
+        enable_p3=args.p3,
+        enable_intra_ts=args.tsengine,
+        sync_global_mode=(args.sync == "fsa"),
+        enable_dgt=args.dgt,
+    )
+    po, role_obj, stop_ev = build_runtime(node, cfg, args.base_port)
+    print(f"{node}: up", flush=True)
+    if node.role is Role.WORKER:
+        _worker_demo(po, role_obj, args)
+    else:
+        stop_ev.wait()
+        print(f"{node}: terminating", flush=True)
+    po.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

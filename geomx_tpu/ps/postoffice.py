@@ -82,6 +82,13 @@ class Postoffice:
         self._barrier_seq = 0
         # scheduler-side barrier counting: (group_token) -> list of waiters
         self._barrier_waiting: Dict[str, List[Message]] = {}
+        # heartbeat bookkeeping (scheduler side: last-seen per node,
+        # ref: Van::ProcessHeartbeat van.cc:242-257, UpdateHeartbeat)
+        self._heartbeats: Dict[str, float] = {}
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        self._hb_epoch = 0.0
+        self._dead_replies: Dict[int, List[str]] = {}
         self._started = False
 
     # ---- lifecycle ----------------------------------------------------------
@@ -89,9 +96,23 @@ class Postoffice:
         if not self._started:
             self.van.start(self._dispatch)
             self._started = True
+            import time as _time
+
+            self._hb_epoch = _time.monotonic()
+            if (self.config.heartbeat_interval_s > 0
+                    and not self.node.role.is_scheduler):
+                self._hb_stop = threading.Event()
+                self._hb_thread = threading.Thread(
+                    target=self._heartbeat_loop, args=(self._hb_stop,),
+                    daemon=True, name=f"heartbeat-{self.node}")
+                self._hb_thread.start()
 
     def stop(self):
         if self._started:
+            if self._hb_thread is not None:
+                self._hb_stop.set()
+                self._hb_thread.join(timeout=2)
+                self._hb_thread = None
             self.van.stop()
             self._started = False
 
@@ -122,7 +143,96 @@ class Postoffice:
             self._control_hooks.append(hook)
 
     # ---- dispatch -----------------------------------------------------------
+    def _heartbeat_loop(self, stop_ev: threading.Event):
+        """Periodic HEARTBEAT to my scheduler(s) (ref: van.cc:1128-1140).
+        Local servers are dual-identity and ping BOTH their party scheduler
+        and the global scheduler (whose dead-node table covers them);
+        workers ping the party scheduler; global servers ping the global
+        scheduler."""
+        targets = []
+        if self.node.role is Role.GLOBAL_SERVER:
+            targets.append((self.topology.global_scheduler(), Domain.GLOBAL))
+        else:
+            targets.append(
+                (self.topology.scheduler(self.node.party), Domain.LOCAL))
+            if self.node.role is Role.SERVER:
+                targets.append(
+                    (self.topology.global_scheduler(), Domain.GLOBAL))
+        while not stop_ev.is_set():
+            for sched, domain in targets:
+                try:
+                    self.van.send(Message(
+                        recipient=sched, control=Control.HEARTBEAT,
+                        domain=domain))
+                except (KeyError, OSError):
+                    # scheduler not up yet (startup race on TCP) — a
+                    # transient failure must not kill the heartbeat thread
+                    pass
+            stop_ev.wait(self.config.heartbeat_interval_s)
+
+    def dead_nodes(self, timeout_s: Optional[float] = None) -> List[str]:
+        """Scheduler-side: nodes whose heartbeat is older than the timeout
+        (ref: Postoffice::GetDeadNodes postoffice.cc:284-303)."""
+        import time as _time
+
+        assert self.node.role.is_scheduler
+        if self.config.heartbeat_interval_s <= 0:
+            return []  # feature off: nobody pings, so nobody is "dead"
+        timeout_s = timeout_s or self.config.heartbeat_timeout_s
+        now = _time.monotonic()
+        with self._lock:
+            expected = [
+                str(n) for n in (
+                    self.topology.members(
+                        Group.WORKERS | Group.SERVERS, party=self.node.party)
+                    if self.node.role is Role.SCHEDULER
+                    else self.topology.global_servers() + self.topology.servers()
+                )
+            ]
+            # nodes never heard from count from this scheduler's start
+            return [n for n in expected
+                    if now - self._heartbeats.get(n, self._hb_epoch) > timeout_s]
+
+    def query_dead_nodes(self, timeout: float = 10.0) -> List[str]:
+        """Ask my scheduler for its dead-node list
+        (ref: kv.get_num_dead_node kvstore_dist.h:225-234)."""
+        if self.node.role.is_scheduler:
+            return self.dead_nodes()
+        sched = (self.topology.global_scheduler()
+                 if self.node.role is Role.GLOBAL_SERVER
+                 else self.topology.scheduler(self.node.party))
+        domain = (Domain.GLOBAL if sched.role is Role.GLOBAL_SCHEDULER
+                  else Domain.LOCAL)
+        with self._barrier_cv:
+            self._barrier_seq += 1
+            seq = self._barrier_seq
+        self.van.send(Message(
+            recipient=sched, control=Control.DEAD_NODES, domain=domain,
+            request=True, timestamp=seq))
+        with self._barrier_cv:
+            ok = self._barrier_cv.wait_for(
+                lambda: seq in self._dead_replies, timeout=timeout)
+            if not ok:
+                raise TimeoutError(f"{self.node}: dead-node query timed out")
+            return self._dead_replies.pop(seq)
+
     def _dispatch(self, msg: Message):
+        if msg.control is Control.DEAD_NODES:
+            if msg.request:
+                self.van.send(msg.reply_to(
+                    control=Control.DEAD_NODES,
+                    body={"dead": self.dead_nodes()}))
+            else:
+                with self._barrier_cv:
+                    self._dead_replies[msg.timestamp] = msg.body["dead"]
+                    self._barrier_cv.notify_all()
+            return
+        if msg.control is Control.HEARTBEAT:
+            import time as _time
+
+            with self._lock:
+                self._heartbeats[str(msg.sender)] = _time.monotonic()
+            return
         if msg.control is Control.BARRIER:
             self._handle_barrier(msg)
             return

@@ -36,6 +36,7 @@ class Control(enum.Enum):
     ASK_PUSH = 7       # node asks scheduler for a push-merge pairing
     REPLY = 8          # scheduler's answer
     AUTOPULL_REPLY = 9 # receiver confirms overlay delivery
+    DEAD_NODES = 10    # query the scheduler's heartbeat table
 
 
 class Domain(enum.Enum):

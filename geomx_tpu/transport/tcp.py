@@ -1,0 +1,166 @@
+"""TCP fabric: real sockets for multi-process / multi-host deployment.
+
+The reference's transport is ZeroMQ ROUTER/DEALER TCP plus raw UDP
+(ref: 3rdparty/ps-lite/src/zmq_van.h:41-193); this fabric provides the
+same role with plain sockets and the framework's binary message format
+(Message.to_bytes / from_bytes — length-prefixed frames).  It implements
+the InProcFabric interface (register → mailbox, deliver), so the Van and
+everything above it is transport-agnostic.
+
+Addressing is static: every node gets ``base_port + index`` within the
+deterministic ``Topology.all_nodes()`` order on its host (127.0.0.1 for
+pseudo-distributed runs, per-node hosts via GEOMX_NODE_HOSTS JSON for
+multi-host).  The reference's dynamic ADD_NODE registration is replaced
+by this static plan; elastic join/recovery rides the heartbeat layer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+from geomx_tpu.core.config import Config, NodeId, Topology
+from geomx_tpu.transport.message import Message
+from geomx_tpu.transport.van import FaultPolicy, _Mailbox
+
+
+def default_address_plan(topology: Topology, base_port: int = 9200,
+                         hosts: Optional[Dict[str, str]] = None
+                         ) -> Dict[str, Tuple[str, int]]:
+    """node-str → (host, port).  Hosts default to loopback (the reference's
+    pseudo-distributed mode, ref: docs/source/pseudo-distributed-deployment.rst);
+    ``hosts`` overrides per node for multi-host."""
+    hosts = hosts or {}
+    plan = {}
+    for i, n in enumerate(topology.all_nodes()):
+        s = str(n)
+        plan[s] = (hosts.get(s, "127.0.0.1"), base_port + i)
+    return plan
+
+
+def plan_from_env(topology: Topology) -> Dict[str, Tuple[str, int]]:
+    base = int(os.environ.get("GEOMX_BASE_PORT", "9200"))
+    hosts = json.loads(os.environ.get("GEOMX_NODE_HOSTS", "{}"))
+    return default_address_plan(topology, base, hosts)
+
+
+class TcpFabric:
+    """One per process. Only the local node(s) register; deliver() dials
+    the static plan."""
+
+    def __init__(self, plan: Dict[str, Tuple[str, int]],
+                 fault: Optional[FaultPolicy] = None,
+                 config: Optional[Config] = None):
+        if fault is None:
+            fault = FaultPolicy.from_config(config) if config else FaultPolicy()
+        self.fault = fault
+        self.plan = plan
+        self._boxes: Dict[str, _Mailbox] = {}
+        self._listeners = []
+        self._conns: Dict[str, socket.socket] = {}
+        self._conn_mu = threading.Lock()
+        self._stop = False
+        self.dropped = 0
+
+    # ---- local side ---------------------------------------------------------
+    def register(self, node: NodeId) -> _Mailbox:
+        s = str(node)
+        if s in self._boxes:
+            return self._boxes[s]
+        box = _Mailbox()
+        self._boxes[s] = box
+        host, port = self.plan[s]
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("0.0.0.0", port))
+        srv.listen(64)
+        self._listeners.append(srv)
+        threading.Thread(target=self._accept_loop, args=(srv, box),
+                         name=f"tcp-accept-{s}", daemon=True).start()
+        return box
+
+    def _accept_loop(self, srv: socket.socket, box: _Mailbox):
+        while not self._stop:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._recv_loop, args=(conn, box),
+                             daemon=True).start()
+
+    def _recv_loop(self, conn: socket.socket, box: _Mailbox):
+        try:
+            while not self._stop:
+                hdr = self._recv_exact(conn, 8)
+                if hdr is None:
+                    return
+                (n,) = struct.unpack("<q", hdr)
+                data = self._recv_exact(conn, n)
+                if data is None:
+                    return
+                box.q.put(Message.from_bytes(data))
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    # ---- send side ----------------------------------------------------------
+    def deliver(self, msg: Message) -> bool:
+        if self.fault.should_drop(msg):
+            self.dropped += 1
+            return False
+        dest = str(msg.recipient)
+        box = self._boxes.get(dest)
+        if box is not None:  # local shortcut (several roles per process)
+            box.q.put(msg)
+            return True
+        if dest not in self.plan:
+            raise KeyError(f"no mailbox for {msg.recipient}")
+        data = msg.to_bytes()
+        frame = struct.pack("<q", len(data)) + data
+        with self._conn_mu:
+            conn = self._conns.get(dest)
+            if conn is None:
+                host, port = self.plan[dest]
+                conn = socket.create_connection((host, port), timeout=30)
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conns[dest] = conn
+            try:
+                conn.sendall(frame)
+            except OSError:
+                # peer restarted: redial once
+                conn.close()
+                host, port = self.plan[dest]
+                conn = socket.create_connection((host, port), timeout=30)
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conns[dest] = conn
+                conn.sendall(frame)
+        return True
+
+    def shutdown(self):
+        self._stop = True
+        for srv in self._listeners:
+            try:
+                srv.close()
+            except OSError:
+                pass
+        with self._conn_mu:
+            for c in self._conns.values():
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            self._conns.clear()

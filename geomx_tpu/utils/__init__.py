@@ -1,0 +1,1 @@
+from geomx_tpu.utils.profiler import Profiler, get_profiler  # noqa: F401

@@ -1,0 +1,106 @@
+"""Span profiler with Chrome-trace export and remote control.
+
+Mirrors the reference profiler capabilities used by the distributed layer
+(ref: src/profiler/profiler.h:256-304 Chrome-trace JSON dump;
+python/mxnet/profiler.py), including GeoMX's remote-control feature: a
+worker can configure / start / pause / dump the profiler **on servers**
+via command messages (ref: KVStore::SetServerProfilerCommand
+include/mxnet/kvstore.h:442, kvstore_dist.h:200-205; server side
+ProcessServerProfilerCommands kvstore_dist_server.h:409-456, dumping to
+rank-prefixed filenames).
+
+On TPU the op-level timeline belongs to XLA's own profiler
+(jax.profiler.trace); this one covers the host-side runtime — kvstore
+handlers, codec time, WAN round-trips — which is what the reference's
+server profiles showed.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+
+class Profiler:
+    def __init__(self, process_name: str = "geomx"):
+        self.process_name = process_name
+        self._events: List[dict] = []
+        self._counters: Dict[str, float] = {}
+        self._mu = threading.Lock()
+        self.running = False
+        self._t0 = time.perf_counter()
+
+    # ---- control (ref: MXSetProfilerState / MXProfilePause) -----------------
+    def configure(self, process_name: Optional[str] = None):
+        if process_name:
+            self.process_name = process_name
+
+    def start(self):
+        self.running = True
+
+    def pause(self):
+        self.running = False
+
+    def reset(self):
+        with self._mu:
+            self._events.clear()
+            self._counters.clear()
+
+    # ---- recording ----------------------------------------------------------
+    @contextmanager
+    def span(self, name: str, category: str = "runtime"):
+        if not self.running:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            t1 = time.perf_counter()
+            with self._mu:
+                self._events.append({
+                    "name": name, "cat": category, "ph": "X",
+                    "ts": (t0 - self._t0) * 1e6,
+                    "dur": (t1 - t0) * 1e6,
+                    "pid": self.process_name,
+                    "tid": threading.current_thread().name,
+                })
+
+    def count(self, name: str, value: float = 1.0):
+        with self._mu:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    # ---- export (Chrome trace JSON, ref: profiler.h DumpProfile) ------------
+    def dump(self, path: str):
+        with self._mu:
+            events = list(self._events)
+            counters = dict(self._counters)
+        for name, v in counters.items():
+            events.append({
+                "name": name, "ph": "C", "ts": (time.perf_counter() - self._t0) * 1e6,
+                "pid": self.process_name, "args": {"value": v},
+            })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "num_events": len(self._events),
+                "counters": dict(self._counters),
+            }
+
+
+_profilers: Dict[str, Profiler] = {}
+_mu = threading.Lock()
+
+
+def get_profiler(name: str = "geomx") -> Profiler:
+    with _mu:
+        p = _profilers.get(name)
+        if p is None:
+            p = _profilers[name] = Profiler(name)
+        return p

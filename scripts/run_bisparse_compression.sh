@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Acceptance config: bisparse_compression (mirrors the reference scripts/cpu/run_bisparse_compression.sh)
+exec "$(dirname "$0")/run_cluster.sh" --compression bsc

@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Acceptance config: dgt (mirrors the reference scripts/cpu/run_dgt.sh)
+exec "$(dirname "$0")/run_cluster.sh" --dgt 1

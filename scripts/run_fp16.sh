@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Acceptance config: fp16 (mirrors the reference scripts/cpu/run_fp16.sh)
+exec "$(dirname "$0")/run_cluster.sh" --compression fp16

@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Acceptance config: hfa_sync (mirrors the reference scripts/cpu/run_hfa_sync.sh)
+exec "$(dirname "$0")/run_cluster.sh" --hfa

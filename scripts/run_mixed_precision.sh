@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Acceptance config: mixed_precision (mirrors the reference scripts/cpu/run_mixed_precision.sh)
+exec "$(dirname "$0")/run_cluster.sh" --compression mpq

@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Acceptance config: mixed_sync (mirrors the reference scripts/cpu/run_mixed_sync.sh)
+exec "$(dirname "$0")/run_cluster.sh" --sync mixed

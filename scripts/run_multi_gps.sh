@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Acceptance config: multi_gps (mirrors the reference scripts/cpu/run_multi_gps.sh)
+GSERVERS="${GSERVERS:-2}" exec "$(dirname "$0")/run_cluster.sh" 

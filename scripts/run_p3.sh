@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Acceptance config: p3 (mirrors the reference scripts/cpu/run_p3.sh)
+exec "$(dirname "$0")/run_cluster.sh" --p3

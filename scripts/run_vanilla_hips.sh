@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Acceptance config: vanilla_hips (mirrors the reference scripts/cpu/run_vanilla_hips.sh)
+exec "$(dirname "$0")/run_cluster.sh" 

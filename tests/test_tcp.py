@@ -1,0 +1,87 @@
+"""TCP fabric + multi-process launcher tests (the reference's
+pseudo-distributed acceptance style, ref: tests/local.sh launching
+role-tagged local processes)."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomx_tpu.core.config import Config, NodeId, Role, Topology
+from geomx_tpu.transport import Domain, Message, Van
+from geomx_tpu.transport.tcp import TcpFabric, default_address_plan
+
+
+def free_base_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_tcp_fabric_roundtrip():
+    topo = Topology(num_parties=1, workers_per_party=1)
+    plan = default_address_plan(topo, base_port=free_base_port())
+    fab = TcpFabric(plan)
+    a, b = topo.workers(0)[0], topo.server(0)
+    van_a, van_b = Van(a, fab), Van(b, fab)
+    got = []
+    ev = threading.Event()
+    van_a.start(lambda m: None)
+    van_b.start(lambda m: (got.append(m), ev.set()))
+    van_a.send(Message(recipient=b, timestamp=3,
+                       keys=np.array([1], np.int64),
+                       vals=np.arange(5, dtype=np.float32),
+                       lens=np.array([5], np.int64)))
+    assert ev.wait(5)
+    np.testing.assert_array_equal(got[0].vals, np.arange(5, dtype=np.float32))
+    assert got[0].sender == a and got[0].timestamp == 3
+    van_a.stop(); van_b.stop(); fab.shutdown()
+
+
+@pytest.mark.slow
+def test_launcher_full_topology_subprocess():
+    """Stand up 1 party (scheduler+server+worker) + global tier as real
+    OS processes over TCP; the worker trains and shuts the cluster down."""
+    topo = Topology(num_parties=1, workers_per_party=1)
+    base = free_base_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    roles = [str(n) for n in topo.all_nodes()]
+    procs = {}
+    try:
+        for r in roles:
+            procs[r] = subprocess.Popen(
+                [sys.executable, "-m", "geomx_tpu.launch", "--role", r,
+                 "--parties", "1", "--workers", "1",
+                 "--base-port", str(base), "--steps", "3"],
+                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            )
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if all(p.poll() is not None for p in procs.values()):
+                break
+            time.sleep(0.5)
+        outputs = {}
+        for r, p in procs.items():
+            if p.poll() is None:
+                p.kill()
+            outputs[r] = p.communicate()[0]
+        worker_out = outputs[str(topo.workers(0)[0])]
+        assert "steps=3" in worker_out, worker_out
+        for r, p in procs.items():
+            assert p.returncode == 0, f"{r} rc={p.returncode}: {outputs[r][-800:]}"
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
