@@ -39,6 +39,17 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 
+def _native():
+    """The C++ hot-loop library (geomx_tpu/native), or None — numpy
+    remains the fallback and the semantic reference."""
+    try:
+        from geomx_tpu.native import bindings
+
+        return bindings.lib()
+    except Exception:  # pragma: no cover - missing toolchain
+        return None
+
+
 class Codec:
     name = "none"
 
@@ -79,11 +90,20 @@ class TwoBitCodec(Codec):
         self._residual: Dict[int, np.ndarray] = {}
 
     def compress(self, key, arr):
+        n = len(arr)
         r = self._residual.get(key)
-        if r is None or len(r) != len(arr):
-            r = np.zeros_like(arr, dtype=np.float32)
+        if r is None or len(r) != n:
+            r = np.zeros(n, dtype=np.float32)
+        nlib = _native()
+        if nlib is not None:
+            g = np.ascontiguousarray(arr, dtype=np.float32)
+            r = np.ascontiguousarray(r)
+            out = np.zeros((n + 3) // 4, dtype=np.uint8)
+            nlib.geo_pack2bit(g, r, out, n, self.threshold)
+            self._residual[key] = r  # updated in place
+            return out
         r = r + arr.astype(np.float32)
-        q = np.zeros(len(arr), dtype=np.uint8)  # 0 = zero, 1 = +t, 2 = −t
+        q = np.zeros(n, dtype=np.uint8)  # 0 = zero, 1 = +t, 2 = −t
         q[r > self.threshold] = 1
         q[r < -self.threshold] = 2
         # in-place float32 updates (a `(q==1)*threshold` expression would
@@ -98,7 +118,12 @@ class TwoBitCodec(Codec):
         return packed.astype(np.uint8)
 
     def decompress(self, key, payload, orig_len):
-        b = payload.astype(np.uint8)
+        b = np.ascontiguousarray(payload, dtype=np.uint8)
+        nlib = _native()
+        if nlib is not None:
+            out = np.empty(orig_len, dtype=np.float32)
+            nlib.geo_unpack2bit(b, out, orig_len, self.threshold)
+            return out
         q = np.empty((len(b), 4), dtype=np.uint8)
         q[:, 0] = b & 3
         q[:, 1] = (b >> 2) & 3
@@ -165,27 +190,36 @@ class BscCodec(Codec):
         return float(np.quantile(sample, max(0.0, 1.0 - self.ratio)))
 
     def compress(self, key, arr):
-        g = arr.astype(np.float32)
+        g = np.ascontiguousarray(arr, dtype=np.float32)
+        n = len(g)
         v = self._velocity.get(key)
         u = self._accum.get(key)
-        if v is None or len(v) != len(g):
+        if v is None or len(v) != n:
             v = np.zeros_like(g)
             u = np.zeros_like(g)
-        v = self.momentum * v + g
-        u = u + v
-        mag = np.abs(u)
-        thr = self._threshold(mag)
-        mask = mag >= thr
-        if not mask.any():
-            mask[np.argmax(mag)] = True  # always send at least one entry
-        idx = np.nonzero(mask)[0]
-        # the sampled threshold is unstable on narrow magnitude
-        # distributions (all-equal gradients would select 100%); hard-cap
-        # the payload at 2x the target ratio via exact top-k
-        cap = max(1, int(2 * self.ratio * len(g)))
-        if len(idx) > cap:
-            top = np.argpartition(mag[idx], -cap)[-cap:]
-            idx = idx[top]
+        cap = max(1, int(2 * self.ratio * n))
+        nlib = _native()
+        if nlib is not None:
+            nlib.geo_dgc_update(v, u, g, n, self.momentum)  # in place
+            thr = self._threshold(np.abs(u))
+            idx = np.empty(cap, dtype=np.int64)
+            cnt = nlib.geo_select_threshold(u, n, thr, cap, idx)
+            idx = idx[:cnt]
+        else:
+            v = self.momentum * v + g
+            u = u + v
+            mag = np.abs(u)
+            thr = self._threshold(mag)
+            mask = mag >= thr
+            if not mask.any():
+                mask[np.argmax(mag)] = True  # always send at least one entry
+            idx = np.nonzero(mask)[0]
+            # the sampled threshold is unstable on narrow magnitude
+            # distributions (all-equal gradients would select 100%);
+            # hard-cap the payload at 2x the target ratio via exact top-k
+            if len(idx) > cap:
+                top = np.argpartition(mag[idx], -cap)[-cap:]
+                idx = idx[top]
         vals = u[idx]
         v[idx] = 0.0  # momentum factor masking (ref: DGC)
         u[idx] = 0.0
@@ -244,9 +278,15 @@ class BroadcastCompressor:
             if base is None:
                 base = np.zeros_like(weights)
             base = base.copy()
-        delta = weights.astype(np.float32) - base
+        delta = np.ascontiguousarray(weights.astype(np.float32) - base)
         k = max(1, int(len(delta) * self.ratio))
-        idx = np.argpartition(np.abs(delta), -k)[-k:]
+        nlib = _native()
+        if nlib is not None:
+            idx = np.empty(k, dtype=np.int64)
+            cnt = nlib.geo_topk_abs(delta, len(delta), k, idx)
+            idx = idx[:cnt]
+        else:
+            idx = np.argpartition(np.abs(delta), -k)[-k:]
         vals = delta[idx]
         base[idx] += vals
         self._view[(subscriber, key)] = base
@@ -255,8 +295,13 @@ class BroadcastCompressor:
     @staticmethod
     def decompress_into(store_val: np.ndarray, payload: np.ndarray) -> np.ndarray:
         vals, idx = unpack_sparse(payload)
-        out = store_val.astype(np.float32, copy=True)
-        out[idx] += vals
+        out = np.ascontiguousarray(store_val, dtype=np.float32).copy()
+        nlib = _native()
+        if nlib is not None:
+            nlib.geo_sparse_add(out, np.ascontiguousarray(vals),
+                                np.ascontiguousarray(idx), len(idx))
+        else:
+            out[idx] += vals
         return out
 
 

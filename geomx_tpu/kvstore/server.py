@@ -94,6 +94,9 @@ class LocalServer:
         self.store: Dict[int, np.ndarray] = {}
         self._keys: Dict[int, _KeyState] = {}
         self._mu = threading.RLock()
+        from geomx_tpu.utils import get_profiler
+
+        self._prof = get_profiler(str(postoffice.node))
         self.server = KVServer(APP_PS, 0, postoffice, self._handle)
         self.server.cmd_handler = self._on_cmd
         # the "global worker" half (ref: kvstore_dist_server.h uses the
@@ -126,16 +129,15 @@ class LocalServer:
 
     # ---- request handling ---------------------------------------------------
     def _handle(self, msg: Message, kvs: Optional[KVPairs], server: KVServer):
-        from geomx_tpu.utils import get_profiler
-
-        prof = get_profiler(str(self.po.node))
+        prof = self._prof
         if msg.cmd == Cmd.INIT:
             with prof.span("local.init"):
                 self._handle_init(msg, kvs)
         elif msg.push:
             with prof.span("local.push"):
                 self._handle_push(msg, kvs)
-            prof.count("push_bytes", float(msg.nbytes))
+            if prof.running:
+                prof.count("push_bytes", float(msg.nbytes))
         elif msg.pull:
             with prof.span("local.pull"):
                 self._handle_pull(msg, kvs)
@@ -255,10 +257,8 @@ class LocalServer:
             self._finish_round(list(kvs.keys))
 
     def _push_up(self, kvs: KVPairs):
-        from geomx_tpu.utils import get_profiler
-
-        prof = get_profiler(str(self.po.node))
-        prof.count("wan_rounds", 1.0)
+        if self._prof.running:
+            self._prof.count("wan_rounds", 1.0)
         keys = [int(k) for k in kvs.keys]
 
         def pull_down():
@@ -479,14 +479,15 @@ class GlobalServer:
         self.sync_mode = self.config.sync_global_mode
         self.compression: dict = {"type": "none"}
         self.pull_comp = None  # BroadcastCompressor under bsc/mpq
+        from geomx_tpu.utils import get_profiler
+
+        self._prof = get_profiler(str(postoffice.node))
         self.server = KVServer(APP_PS, 0, postoffice, self._handle)
         self.server.cmd_handler = self._on_cmd
 
     def _handle(self, msg: Message, kvs: Optional[KVPairs], server: KVServer):
-        from geomx_tpu.utils import get_profiler
-
-        prof = get_profiler(str(self.po.node))
-        if msg.push and msg.cmd != Cmd.INIT:
+        prof = self._prof
+        if prof.running and msg.push and msg.cmd != Cmd.INIT:
             prof.count("push_bytes", float(msg.nbytes))
         span_name = ("global.init" if msg.cmd == Cmd.INIT
                      else "global.push" if msg.push else "global.pull")
@@ -719,12 +720,18 @@ class GlobalServer:
 
             try:
                 if body["action"] == "save":
+                    # snapshot under the lock, serialize/write outside it —
+                    # a multi-GB savez must not stall every party's round
+                    import copy
+
                     with self._mu:
-                        ckpt.save_server_state(
-                            body["path"], self.store,
-                            {"optimizer": self.optimizer},
-                            {"sync_mode": self.sync_mode,
-                             "compression": self.compression})
+                        store_snap = {k: v.copy() for k, v in self.store.items()}
+                        opt_snap = copy.deepcopy(self.optimizer)
+                        meta = {"sync_mode": self.sync_mode,
+                                "compression": dict(self.compression)}
+                    ckpt.save_server_state(
+                        body["path"], store_snap,
+                        {"optimizer": opt_snap}, meta)
                 elif body["action"] == "load":
                     store, opt, meta = ckpt.load_server_state(body["path"])
                     with self._mu:

@@ -36,6 +36,10 @@ from geomx_tpu.transport.tcp import TcpFabric, default_address_plan
 def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
                   hosts=None):
     """Construct the postoffice + role object for one node."""
+    if hosts is None:
+        import json
+
+        hosts = json.loads(os.environ.get("GEOMX_NODE_HOSTS", "{}"))
     plan = default_address_plan(config.topology, base_port, hosts)
     fabric = TcpFabric(plan, config=config)
     po = Postoffice(node, config.topology, fabric, config)
@@ -143,17 +147,18 @@ def main(argv=None):
         ap.error("--role or GEOMX_ROLE required")
 
     node = NodeId.parse(args.role)
-    cfg = Config(
-        topology=Topology(num_parties=args.parties,
-                          workers_per_party=args.workers,
-                          num_global_servers=args.global_servers),
-        compression=args.compression,
-        use_hfa=args.hfa,
-        enable_p3=args.p3,
-        enable_intra_ts=args.tsengine,
-        sync_global_mode=(args.sync == "fsa"),
-        enable_dgt=args.dgt,
-    )
+    # env supplies the full documented knob surface (drop injection,
+    # resend, heartbeats, tuning — docs/env-vars.md); CLI flags override
+    cfg = Config.from_env()
+    cfg.topology = Topology(num_parties=args.parties,
+                            workers_per_party=args.workers,
+                            num_global_servers=args.global_servers)
+    cfg.compression = args.compression
+    cfg.use_hfa = args.hfa or cfg.use_hfa
+    cfg.enable_p3 = args.p3 or cfg.enable_p3
+    cfg.enable_intra_ts = args.tsengine or cfg.enable_intra_ts
+    cfg.sync_global_mode = (args.sync == "fsa") and cfg.sync_global_mode
+    cfg.enable_dgt = args.dgt or cfg.enable_dgt
     po, role_obj, stop_ev = build_runtime(node, cfg, args.base_port)
     print(f"{node}: up", flush=True)
     if node.role is Role.WORKER:

@@ -1,0 +1,1 @@
+from geomx_tpu.native.bindings import lib, available  # noqa: F401

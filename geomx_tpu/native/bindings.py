@@ -1,0 +1,73 @@
+"""ctypes bindings for the native codec library.
+
+Build is on-demand: first import compiles ``libgeocodecs.so`` with the
+Makefile (g++; pybind11 isn't available in this environment, so the C ABI
++ ctypes is the binding layer).  If no toolchain is present the import
+degrades gracefully — ``available() == False`` and callers fall back to
+the numpy implementations, which remain the semantic reference.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libgeocodecs.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_i64 = ctypes.c_int64
+_f32 = ctypes.c_float
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-s", "-C", _DIR, "libgeocodecs.so"],
+            check=True, capture_output=True, timeout=120,
+        )
+        return os.path.exists(_SO)
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.geo_pack2bit.argtypes = [_f32p, _f32p, _u8p, _i64, _f32]
+        lib.geo_unpack2bit.argtypes = [_u8p, _f32p, _i64, _f32]
+        lib.geo_dgc_update.argtypes = [_f32p, _f32p, _f32p, _i64, _f32]
+        lib.geo_topk_abs.argtypes = [_f32p, _i64, _i64, _i64p]
+        lib.geo_topk_abs.restype = _i64
+        lib.geo_select_threshold.argtypes = [_f32p, _i64, _f32, _i64, _i64p]
+        lib.geo_select_threshold.restype = _i64
+        lib.geo_sparse_add.argtypes = [_f32p, _f32p, _i64p, _i64]
+        _lib = lib
+        return _lib
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    return _load()
+
+
+def available() -> bool:
+    return _load() is not None

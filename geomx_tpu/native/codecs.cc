@@ -1,0 +1,116 @@
+// Native hot loops for the wire codecs.
+//
+// The reference implements its codec kernels natively too
+// (ref: src/kvstore/gradient_compression.{cc,-inl.h} — C++/CUDA 2-bit
+// pack/unpack with residual feedback, BSC top-k scan).  These are the
+// host-side equivalents for the TPU build's server processes: the slab
+// math that runs per push/pull on local/global servers.  Exposed C ABI,
+// bound from Python via ctypes (geomx_tpu/native/bindings.py); the numpy
+// implementations remain as the fallback and as the reference semantics
+// for the equivalence tests.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// 2-bit quantization with residual feedback
+// (ref: gradient_compression-inl.h:40-139).
+// residual += grad; codes: 0 = zero, 1 = +t, 2 = -t; residual -= emitted.
+// out must hold (n + 3) / 4 bytes.
+void geo_pack2bit(const float* grad, float* residual, uint8_t* out,
+                  int64_t n, float threshold) {
+  const int64_t nbytes = (n + 3) / 4;
+  std::memset(out, 0, nbytes);
+  for (int64_t i = 0; i < n; ++i) {
+    float r = residual[i] + grad[i];
+    uint8_t q = 0;
+    if (r > threshold) {
+      q = 1;
+      r -= threshold;
+    } else if (r < -threshold) {
+      q = 2;
+      r += threshold;
+    }
+    residual[i] = r;
+    out[i >> 2] |= static_cast<uint8_t>(q << ((i & 3) << 1));
+  }
+}
+
+void geo_unpack2bit(const uint8_t* in, float* out, int64_t n,
+                    float threshold) {
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t q = (in[i >> 2] >> ((i & 3) << 1)) & 3;
+    out[i] = q == 1 ? threshold : (q == 2 ? -threshold : 0.0f);
+  }
+}
+
+// DGC-style momentum-correction update for BSC
+// (ref: gradient_compression.cc:191-269):
+//   v = m*v + g;  u += v
+void geo_dgc_update(float* v, float* u, const float* g, int64_t n, float m) {
+  for (int64_t i = 0; i < n; ++i) {
+    v[i] = m * v[i] + g[i];
+    u[i] += v[i];
+  }
+}
+
+// Exact top-k |u| selection (the cap path of BscCodec / the
+// BroadcastCompressor pull sparsifier).  idx_out must hold k entries.
+// Returns the number of selected indices (== k, clamped to n).
+int64_t geo_topk_abs(const float* u, int64_t n, int64_t k, int64_t* idx_out) {
+  if (k <= 0 || n <= 0) return 0;
+  if (k > n) k = n;
+  std::vector<int64_t> idx(n);
+  std::iota(idx.begin(), idx.end(), 0);
+  std::nth_element(idx.begin(), idx.begin() + (k - 1), idx.end(),
+                   [&](int64_t a, int64_t b) {
+                     return std::fabs(u[a]) > std::fabs(u[b]);
+                   });
+  std::copy(idx.begin(), idx.begin() + k, idx_out);
+  return k;
+}
+
+// Threshold selection with hard cap: gather indices with |u| >= thr; if
+// more than cap, keep the cap largest.  Returns count.
+int64_t geo_select_threshold(const float* u, int64_t n, float thr,
+                             int64_t cap, int64_t* idx_out) {
+  std::vector<int64_t> hits;
+  hits.reserve(static_cast<size_t>(cap) * 2);
+  for (int64_t i = 0; i < n; ++i) {
+    if (std::fabs(u[i]) >= thr) hits.push_back(i);
+  }
+  if (hits.empty()) {
+    int64_t best = 0;
+    float bm = -1.0f;
+    for (int64_t i = 0; i < n; ++i) {
+      const float m = std::fabs(u[i]);
+      if (m > bm) { bm = m; best = i; }
+    }
+    idx_out[0] = best;
+    return 1;
+  }
+  if (static_cast<int64_t>(hits.size()) > cap) {
+    std::nth_element(hits.begin(), hits.begin() + (cap - 1), hits.end(),
+                     [&](int64_t a, int64_t b) {
+                       return std::fabs(u[a]) > std::fabs(u[b]);
+                     });
+    hits.resize(cap);
+  }
+  std::sort(hits.begin(), hits.end());
+  std::copy(hits.begin(), hits.end(), idx_out);
+  return static_cast<int64_t>(hits.size());
+}
+
+// dense[idx[i]] += vals[i]  (sparse pull-delta application,
+// ref: BSCDecompress :310-336)
+void geo_sparse_add(float* dense, const float* vals, const int64_t* idx,
+                    int64_t k) {
+  for (int64_t i = 0; i < k; ++i) dense[idx[i]] += vals[i];
+}
+
+}  // extern "C"

@@ -1,0 +1,3 @@
+from geomx_tpu.ops.quantize import (  # noqa: F401
+    quantize_2bit_tpu, dequantize_2bit_tpu, dgc_update_tpu,
+)

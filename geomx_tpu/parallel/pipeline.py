@@ -1,0 +1,120 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a ``pp`` axis.
+
+Absent from the reference (SURVEY.md §2.3 — no PP anywhere); a TPU-design
+addition.  A stack of identical blocks is sharded layer-wise over the
+``pp`` mesh axis (each device owns ``L / pp`` consecutive blocks).  The
+batch splits into M microbatches; activations flow rank→rank+1 via
+``lax.ppermute`` each tick, so at steady state all stages compute
+concurrently.  The whole schedule is a ``lax.scan`` (M + pp − 1 ticks)
+inside ``shard_map`` — fully differentiable, so one jit compiles the
+complete pipelined train step.
+
+Bubble fraction is the usual (pp−1)/(M+pp−1); pick M ≥ 4·pp in practice.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(
+    mesh: Mesh,
+    block_fn: Callable,
+    stacked_params,
+    x_mb: jax.Array,
+    axis: str = "pp",
+):
+    """Run microbatches through the pipelined block stack.
+
+    - ``block_fn(params_one_block, x) -> x`` applies ONE block.
+    - ``stacked_params``: pytree whose leaves have a leading layer dim L,
+      sharded ``P(axis)`` (L must divide by the pp axis size).
+    - ``x_mb``: [M, mb, ...] microbatches, replicated across ``axis``.
+
+    Returns [M, mb, ...] outputs, replicated.
+    """
+    pp = mesh.shape[axis]
+
+    def stage(params_local, x):
+        # scan my local blocks over the activation
+        def one(block_params, h):
+            return block_fn(block_params, h), None
+
+        def apply_local(h):
+            h, _ = lax.scan(lambda c, p: (block_fn(p, c), None),
+                            h, params_local)
+            return h
+
+        my = lax.axis_index(axis)
+        M = x.shape[0]
+        steps = M + pp - 1
+        zero_mb = jnp.zeros_like(x[0])
+        fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, t):
+            prev_act, out_buf = carry
+            # rank 0 feeds microbatch t (garbage past M never lands in a
+            # valid output slot); other ranks consume the relayed act
+            x_t = lax.dynamic_index_in_dim(x, jnp.minimum(t, M - 1), axis=0,
+                                           keepdims=False)
+            inp = jnp.where(my == 0, x_t, prev_act)
+            h = apply_local(inp)
+            # last rank writes finished microbatch t-(pp-1)
+            out_idx = t - (pp - 1)
+            write = jnp.logical_and(my == pp - 1, out_idx >= 0)
+            safe_idx = jnp.clip(out_idx, 0, M - 1)
+            cur = lax.dynamic_index_in_dim(out_buf, safe_idx, 0,
+                                           keepdims=False)
+            new = jnp.where(write, h, cur)
+            out_buf = lax.dynamic_update_index_in_dim(out_buf, new,
+                                                      safe_idx, 0)
+            # relay my activation to the next stage
+            nxt = lax.ppermute(h, axis, fwd_perm)
+            return (nxt, out_buf), None
+
+        out0 = jnp.zeros_like(x)
+        (_, out), _ = lax.scan(tick, (zero_mb, out0), jnp.arange(steps))
+        # only the last rank holds real outputs; psum broadcasts them
+        # (all other ranks contribute zeros)
+        mask = jnp.where(my == pp - 1, 1.0, 0.0).astype(out.dtype)
+        return lax.psum(out * mask, axis)
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    return shard_map(
+        stage, mesh=mesh,
+        in_specs=(P(axis), P(*([None] * x_mb.ndim))),
+        out_specs=P(*([None] * x_mb.ndim)),
+        check_vma=False,
+    )(stacked_params, x_mb)
+
+
+def mlp_block(params, x):
+    """Reference block for tests/dry runs: pre-norm MLP residual block."""
+    w1, w2 = params["w1"], params["w2"]
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    h = x * lax.rsqrt(var + 1e-6)
+    return x + jax.nn.gelu(h @ w1) @ w2
+
+
+def init_mlp_stack(rng, n_layers: int, d: int, f: int):
+    k1, k2 = jax.random.split(rng)
+    scale1 = 1.0 / jnp.sqrt(d)
+    scale2 = 1.0 / jnp.sqrt(f)
+    return {
+        "w1": jax.random.normal(k1, (n_layers, d, f), jnp.float32) * scale1,
+        "w2": jax.random.normal(k2, (n_layers, f, d), jnp.float32) * scale2,
+    }
+
+
+def sequential_apply(stacked_params, x_mb, block_fn=mlp_block):
+    """Single-device reference: same math, no pipeline."""
+    def apply_one(x):
+        h, _ = lax.scan(lambda c, p: (block_fn(p, c), None), x, stacked_params)
+        return h
+
+    return jax.vmap(apply_one)(x_mb)

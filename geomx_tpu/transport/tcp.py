@@ -63,7 +63,11 @@ class TcpFabric:
         self._boxes: Dict[str, _Mailbox] = {}
         self._listeners = []
         self._conns: Dict[str, socket.socket] = {}
-        self._conn_mu = threading.Lock()
+        # per-destination locks: one slow/unreachable peer must not stall
+        # sends to every other peer (heartbeats would time out and trigger
+        # false dead-node detection)
+        self._conn_mus: Dict[str, threading.Lock] = {}
+        self._registry_mu = threading.Lock()
         self._stop = False
         self.dropped = 0
 
@@ -131,24 +135,27 @@ class TcpFabric:
             raise KeyError(f"no mailbox for {msg.recipient}")
         data = msg.to_bytes()
         frame = struct.pack("<q", len(data)) + data
-        with self._conn_mu:
+        with self._registry_mu:
+            mu = self._conn_mus.setdefault(dest, threading.Lock())
+        with mu:
             conn = self._conns.get(dest)
             if conn is None:
-                host, port = self.plan[dest]
-                conn = socket.create_connection((host, port), timeout=30)
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._conns[dest] = conn
+                conn = self._dial(dest)
             try:
                 conn.sendall(frame)
             except OSError:
                 # peer restarted: redial once
                 conn.close()
-                host, port = self.plan[dest]
-                conn = socket.create_connection((host, port), timeout=30)
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._conns[dest] = conn
+                conn = self._dial(dest)
                 conn.sendall(frame)
         return True
+
+    def _dial(self, dest: str) -> socket.socket:
+        host, port = self.plan[dest]
+        conn = socket.create_connection((host, port), timeout=30)
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._conns[dest] = conn
+        return conn
 
     def shutdown(self):
         self._stop = True
@@ -157,7 +164,7 @@ class TcpFabric:
                 srv.close()
             except OSError:
                 pass
-        with self._conn_mu:
+        with self._registry_mu:
             for c in self._conns.values():
                 try:
                     c.close()

@@ -261,15 +261,17 @@ class Van:
         self._deliver_guarded(msg)
 
     def _deliver_guarded(self, msg: Message):
-        """An unknown recipient must not kill sender threads (resend loop,
-        priority drain); surface it as a log + drop instead."""
+        """Unknown recipients and transient transport failures (TCP connect
+        refused during startup races, peer restarts) must not kill sender
+        threads (resend loop, priority drain) or crash app threads —
+        surface as a log + drop; the resender recovers reliable traffic."""
         try:
             self.fabric.deliver(msg)
-        except KeyError:
+        except (KeyError, OSError) as e:
             import logging
 
             logging.getLogger(__name__).warning(
-                "%s: dropping message to unknown node %s", self.node, msg.recipient
+                "%s: dropping message to %s (%s)", self.node, msg.recipient, e
             )
 
     def _account_send(self, msg: Message):

@@ -70,6 +70,8 @@ class Profiler:
                 })
 
     def count(self, name: str, value: float = 1.0):
+        if not self.running:
+            return
         with self._mu:
             self._counters[name] = self._counters.get(name, 0.0) + value
 
