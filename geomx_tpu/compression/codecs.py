@@ -154,6 +154,23 @@ def unpack_sparse(payload: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return values, indices
 
 
+def pack_rows(row_ids: np.ndarray, rows: np.ndarray) -> np.ndarray:
+    """Row-sparse wire format: [rows.ravel() ‖ int32 row_ids bit-cast]
+    (one definition for the four client/server codec sites)."""
+    return np.concatenate([
+        np.asarray(rows, np.float32).ravel(),
+        np.asarray(row_ids, np.int64).astype(np.int32).view(np.float32),
+    ])
+
+
+def unpack_rows(payload: np.ndarray, cols: int):
+    """Inverse of pack_rows → (row_ids int64 [k], rows float32 [k, cols])."""
+    k = len(payload) // (cols + 1)
+    rows = payload[:k * cols].reshape(k, cols).astype(np.float32)
+    row_ids = payload[k * cols:].view(np.int32).astype(np.int64)
+    return row_ids, rows
+
+
 def scatter_sparse(payload: np.ndarray, orig_len: int) -> np.ndarray:
     """Densify a [values ‖ indices] payload (shared by all bsc decoders)."""
     vals, idx = unpack_sparse(payload)

@@ -180,6 +180,83 @@ class WorkerKVStore:
         self._track(ts)
         return ts
 
+    # ---- row-sparse (embedding) path ----------------------------------------
+    def _rs_check(self, tid: int, row_ids: np.ndarray):
+        """Validate a row-sparse access; returns (key, cols).
+
+        Row-sparse tensors must live whole under one ps key (the reference
+        never partitions them, ref: EncodeRowSparseKey
+        kvstore_dist.h:900-957) — a table big enough to shard across
+        global servers, or sliced by P3, is rejected loudly instead of
+        corrupting server state.  HFA pushes weights, not gradients, so
+        the combination is rejected too."""
+        shape = self._shapes[tid]
+        if len(shape) != 2:
+            raise ValueError("row-sparse requires a 2D tensor")
+        if self.config.use_hfa:
+            raise ValueError("row-sparse push/pull is incompatible with HFA "
+                             "(HFA rounds exchange weights, not gradients)")
+        size = int(np.prod(shape))
+        parts = self.plan.parts(tid, size)
+        if len(parts) != 1:
+            raise ValueError(
+                f"row-sparse tensor {tid} ({shape}) would be partitioned "
+                f"into {len(parts)} keys (bigarray_bound/P3); row-sparse "
+                "tensors must fit one shard")
+        if row_ids.size and (row_ids.min() < 0 or row_ids.max() >= shape[0]):
+            raise ValueError(
+                f"row ids out of range for tensor {tid} with {shape[0]} rows")
+        return parts[0].ps_key, shape[1]
+
+    def push_row_sparse(self, tid: int, row_ids: np.ndarray,
+                        rows: np.ndarray, priority: int = 0) -> int:
+        """Push gradients for a subset of rows of a 2D tensor
+        (ref: row-sparse push kvstore_dist.h:628-702).  Only active rows
+        cross the LAN; the merged round crosses the WAN sparse when that
+        is smaller."""
+        from geomx_tpu.compression.codecs import pack_rows
+        from geomx_tpu.ps import KVPairs
+
+        row_ids = np.asarray(row_ids, dtype=np.int64)
+        key, cols = self._rs_check(tid, row_ids)
+        rows = np.asarray(rows, dtype=np.float32).reshape(len(row_ids), cols)
+        payload = pack_rows(row_ids, rows)
+        ts = self.worker.zpush(
+            KVPairs(np.array([key], np.int64), payload,
+                    np.array([len(payload)], np.int64)),
+            cmd=Cmd.ROW_SPARSE_PUSH, priority=priority,
+            body={"rs_cols": int(cols)},
+        )
+        with self._mu:
+            self._last_push_ts[tid] = ts
+        self._track(ts)
+        return ts
+
+    def pull_row_sparse(self, tid: int, row_ids: np.ndarray,
+                        cb: Callable[[int, np.ndarray], None],
+                        priority: int = 0) -> int:
+        """Pull only the given rows (ref: PullRowSparse
+        include/mxnet/kvstore.h; kvstore_dist.h:662-702).  cb receives
+        (tid, rows [len(row_ids), cols]) in row_ids order."""
+        row_ids = np.asarray(row_ids, dtype=np.int64)
+        key, cols = self._rs_check(tid, row_ids)
+        with self._mu:
+            after = self._last_push_ts.get(tid)
+
+        def decode(kvs):
+            from geomx_tpu.compression.codecs import unpack_rows
+
+            _, rows = unpack_rows(kvs.vals, cols)
+            cb(tid, np.array(rows, copy=True))
+
+        ts = self.worker.zpull(
+            [key], cb=decode, cmd=Cmd.ROW_SPARSE_PULL, priority=priority,
+            after_ts=after,
+            body={"rows": row_ids.tolist(), "rs_cols": int(cols)},
+        )
+        self._track(ts)
+        return ts
+
     def push_pull(self, tid: int, grad: np.ndarray,
                   cb: Callable[[int, np.ndarray], None],
                   priority: int = 0) -> List[int]:

@@ -22,6 +22,9 @@ class Cmd(enum.IntEnum):
                       # kvstore_dist_server.h:959-972)
     TS_AUTOPULL = 3   # TSEngine overlay model relay (ref: AutoPullUpdate
                       # kv_app.h:1040-1224)
+    ROW_SPARSE_PUSH = 4  # embedding-style sparse-row gradient push
+                         # (ref: row-sparse paths kvstore_dist.h:628-702)
+    ROW_SPARSE_PULL = 5  # pull a subset of rows (ref: PullRowSparse)
 
 
 class Ctrl(enum.IntEnum):

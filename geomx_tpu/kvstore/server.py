@@ -70,7 +70,8 @@ def _handle_profiler_cmd(po: Postoffice, msg: Message, server: KVServer):
 class _KeyState:
     """Per-ps-key aggregation state on the local server."""
 
-    __slots__ = ("accum", "count", "parked_pulls", "in_flight", "version", "round")
+    __slots__ = ("accum", "count", "parked_pulls", "in_flight", "version",
+                 "round", "row_sparse")
 
     def __init__(self):
         self.accum: Optional[np.ndarray] = None
@@ -79,6 +80,7 @@ class _KeyState:
         self.in_flight = False   # a round is between first-push and weights-back
         self.version = 0         # completed rounds (local or global)
         self.round = 0           # completed aggregation rounds (HFA K2 gate)
+        self.row_sparse = False  # merged grad is mostly-zero rows
 
 
 class LocalServer:
@@ -133,6 +135,13 @@ class LocalServer:
         if msg.cmd == Cmd.INIT:
             with prof.span("local.init"):
                 self._handle_init(msg, kvs)
+        elif msg.cmd == Cmd.ROW_SPARSE_PUSH:
+            with prof.span("local.push_rs"):
+                self._handle_push_row_sparse(msg, kvs)
+        elif msg.cmd == Cmd.ROW_SPARSE_PULL:
+            with prof.span("local.pull_rs"):
+                with self._mu:
+                    self._try_serve_pull_locked(msg)
         elif msg.push:
             with prof.span("local.push"):
                 self._handle_push(msg, kvs)
@@ -210,6 +219,52 @@ class LocalServer:
         if completed:
             self._round_complete(completed)
 
+    def _handle_push_row_sparse(self, msg: Message, kvs: KVPairs):
+        """Scatter-accumulate active rows; the merged round rides the
+        push-up path, sparsified for the WAN when that is smaller
+        (ref: row-sparse server merge kvstore_dist_server.h row_sparse
+        handlers).  The client rejects HFA×row-sparse, but guard here too
+        — adopting a gradient sum as HFA weights would corrupt training."""
+        from geomx_tpu.compression.codecs import unpack_rows
+
+        if self.hfa_enabled:
+            import logging
+
+            logging.getLogger(__name__).error(
+                "%s: dropping row-sparse push under HFA (incompatible)",
+                self.po.node)
+            self.server.response(msg)
+            return
+        cols = int(msg.body["rs_cols"])
+        row_ids, rows = unpack_rows(kvs.vals, cols)
+        key = int(kvs.keys[0])
+        if not self.sync_mode:
+            # async: no accumulation round — densify once and forward
+            with self._mu:
+                st = self._keys.setdefault(key, _KeyState())
+                st.in_flight = False
+                dense = np.zeros_like(self.store[key], dtype=np.float32)
+                np.add.at(dense.reshape(-1, cols), row_ids, rows)
+                self._drain_parked_locked(st)
+            self.server.response(msg)
+            self._push_up(KVPairs(kvs.keys, dense,
+                                  np.array([len(dense)], np.int64)))
+            return
+        completed = []
+        with self._mu:
+            st = self._keys.setdefault(key, _KeyState())
+            if st.accum is None:
+                st.accum = np.zeros_like(self.store[key], dtype=np.float32)
+            np.add.at(st.accum.reshape(-1, cols), row_ids, rows)
+            st.count += 1
+            st.in_flight = True
+            st.row_sparse = True
+            if st.count >= self.num_workers:
+                completed.append(key)
+        self.server.response(msg)
+        if completed:
+            self._round_complete(completed)
+
     def _round_complete(self, keys: List[int]):
         """All party workers pushed `keys` — run the WAN push-up.
 
@@ -266,20 +321,35 @@ class LocalServer:
             # (ref: DataHandlePushResponseDefault :941-957)
             self.up.zpull(keys, cb=self._on_pull_down)
 
-        if self.push_codec is None:
-            self.up.zpush(kvs, cmd=Cmd.DEFAULT, on_complete=pull_down)
-            return
-        # compress per key; group by codec so each wire message has a
-        # uniform payload dtype + compr tag (ref: PushCompressed
-        # kvstore_dist.h:530-563, DataPushToGlobalServersCompressed)
-        from geomx_tpu.compression import MpqSelector
-
+        # group keys by wire codec so each message has a uniform payload
+        # dtype + compr tag (ref: PushCompressed kvstore_dist.h:530-563)
         groups: Dict[str, list] = {}
-        for k, v in kvs.slices():
-            codec = (self.push_codec.select(len(v))
-                     if isinstance(self.push_codec, MpqSelector)
-                     else self.push_codec)
-            groups.setdefault(codec.name, []).append((k, codec.compress(k, v)))
+        if self.push_codec is None:
+            # uncompressed mode — except row-sparse rounds, whose merged
+            # gradient is mostly zeros: ship [values ‖ indices] when
+            # that is smaller (the WAN half of the row-sparse path)
+            from geomx_tpu.compression.codecs import pack_sparse
+
+            with self._mu:
+                rs = {k: (k in self._keys and self._keys[k].row_sparse)
+                      for k in keys}
+            for k, v in kvs.slices():
+                if rs[int(k)]:
+                    idx = np.nonzero(v)[0]
+                    if 2 * len(idx) < len(v):
+                        groups.setdefault("bsc", []).append(
+                            (k, pack_sparse(v[idx], idx)))
+                        continue
+                groups.setdefault("", []).append((k, v))
+        else:
+            from geomx_tpu.compression import MpqSelector
+
+            for k, v in kvs.slices():
+                codec = (self.push_codec.select(len(v))
+                         if isinstance(self.push_codec, MpqSelector)
+                         else self.push_codec)
+                groups.setdefault(codec.name, []).append(
+                    (k, codec.compress(k, v)))
         remaining = [len(groups)]
         lock = threading.Lock()
 
@@ -397,6 +467,23 @@ class LocalServer:
             if k not in self.store or st.in_flight:
                 st.parked_pulls.append(req)
                 return False
+        if req.cmd == Cmd.ROW_SPARSE_PULL:
+            # gather the requested rows only (ref: PullRowSparse).
+            # Out-of-range ids are clamped defensively (the client
+            # validates; an exception here would swallow the request and
+            # hang the puller)
+            key = int(req.keys[0])
+            row_ids = np.asarray(req.body["rows"], dtype=np.int64)
+            cols = int(req.body["rs_cols"])
+            from geomx_tpu.compression.codecs import pack_rows
+
+            table = self.store[key].reshape(-1, cols)
+            row_ids = np.clip(row_ids, 0, len(table) - 1)
+            payload = pack_rows(row_ids, table[row_ids])
+            self.server.response(req, KVPairs(
+                np.array([key], np.int64), payload,
+                np.array([len(payload)], np.int64)))
+            return True
         ks, vs, ls = [], [], []
         for k in req.keys:
             k = int(k)

@@ -1,0 +1,60 @@
+"""Party-level data parallelism: one party = one TPU slice.
+
+This is the build plan's core mapping (SURVEY.md §7): the reference's
+intra-DC tier — workers pushing to a local server over the LAN, with the
+`Comm`/NCCL device-aggregation layer underneath (ref: src/kvstore/comm.h,
+kvstore_nccl.h) — lowers to a single pjit'd train step over the party's
+device mesh.  XLA inserts the gradient AllReduce over ICI; the host edge
+then pushes ONE already-aggregated gradient per tensor into the HiPS
+tier (so ``workers_per_party=1`` in the PS topology: the slice is the
+worker).
+
+``make_party_step`` builds that step: batch sharded over ``dp``, params
+replicated, gradients returned replicated (mean over the global batch).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_party_step(grad_fn: Callable, mesh: Mesh) -> Callable:
+    """Wrap ``grad_fn(params, x, y) -> (loss, acc, grads)`` into a
+    slice-wide DP step on ``mesh`` (axis ``dp``).
+
+    Returns ``step(params, x, y)`` taking host numpy batches; gradients
+    come back as host-ready arrays, aggregated across the slice by XLA.
+    """
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P("dp"))
+
+    @jax.jit
+    def _step(params, x, y):
+        return grad_fn(params, x, y)
+
+    def step(params, x, y):
+        params = jax.device_put(params, repl)
+        x = jax.device_put(jnp.asarray(x), batch_sh)
+        y = jax.device_put(jnp.asarray(y), batch_sh)
+        return _step(params, x, y)
+
+    return step
+
+
+def party_meshes(num_parties: int, devices=None, axis: str = "dp"):
+    """Split the available devices into one mesh per party — the
+    simulation analog of 'each party is its own pod slice'."""
+    if devices is None:
+        devices = jax.devices()
+    per = len(devices) // num_parties
+    assert per >= 1, f"{len(devices)} devices cannot host {num_parties} parties"
+    out = []
+    for p in range(num_parties):
+        devs = np.asarray(devices[p * per:(p + 1) * per]).reshape(per)
+        out.append(Mesh(devs, (axis,)))
+    return out

@@ -1,0 +1,184 @@
+"""TSEngine push direction: scheduler-paired worker-to-worker merging.
+
+Reimplements the reference's push-side overlay (ref: ProcessAskPushCommand
+van.cc:1197-1252; worker-side merge WorkersMerge kvstore_dist.h:91-173;
+TS_Process re-ask loop kv_app.h:1111-1179): instead of every worker
+pushing its gradient to the server (N uplinks), ready workers ask the
+scheduler for a pairing; the scheduler matches two, one ships its
+gradients to the other, the receiver merges (tracking ``num_merge``
+contributions) and re-asks.  When a single holder carries all
+``num_workers`` contributions, the scheduler answers "server" and that
+worker pushes the merged gradient set once — a merge tree shaped by
+which links are free, halving server fan-in pressure.
+
+Control plane: Control.ASK_PUSH → Control.REPLY with
+``{"action": "send"|"recv"|"server", "peer": ...}``.  Data plane: one
+``Cmd.TS_PUSH_MERGE`` data request carrying the concatenated gradient
+set.  API: ``TsPushWorker.merge_push(grads) -> merged or None`` — the
+elected worker receives the full merged set back and is responsible for
+the single server push; everyone else gets None.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomx_tpu.core.config import NodeId
+from geomx_tpu.ps.postoffice import Postoffice
+from geomx_tpu.transport.message import Control, Domain, Message
+
+TS_PUSH_MERGE_CMD = 100  # data-plane cmd for merge relays
+
+
+class TsPushScheduler:
+    """Pairs ready pushers per round (ref: van.cc:1197-1252)."""
+
+    def __init__(self, postoffice: Postoffice, num_workers: int):
+        self.po = postoffice
+        self.num_workers = num_workers
+        self._mu = threading.Lock()
+        # iter -> list of (asker Message, num_merge)
+        self._pending: Dict[int, List[Tuple[Message, int]]] = {}
+        postoffice.add_control_hook(self._on_control)
+
+    def _on_control(self, msg: Message) -> bool:
+        if msg.control is not Control.ASK_PUSH:
+            return False
+        body = msg.body or {}
+        it = int(body.get("iter", 0))
+        nm = int(body.get("num_merge", 1))
+        replies = []
+        with self._mu:
+            pend = self._pending.setdefault(it, [])
+            if nm >= self.num_workers:
+                # this node holds everything → send to server
+                replies.append((msg, {"action": "server"}))
+                self._pending.pop(it, None)
+            elif pend:
+                other, other_nm = pend.pop(0)
+                # the longer-waiting node receives; the newcomer sends
+                replies.append((other, {"action": "recv",
+                                        "peer": str(msg.sender),
+                                        "num_merge": other_nm + nm}))
+                replies.append((msg, {"action": "send",
+                                      "peer": str(other.sender)}))
+            else:
+                pend.append((msg, nm))
+        for req, body_out in replies:
+            self.po.van.send(req.reply_to(control=Control.REPLY,
+                                          body=body_out))
+        return True
+
+
+class TsPushWorker:
+    """Worker-side merge participant.
+
+    Usage per round: ``merged = tsp.merge_push({tid: grad_array, ...})``;
+    if ``merged`` is not None this worker was elected to push the full
+    merged set to the server (divide by num_workers upstream as usual).
+    """
+
+    def __init__(self, postoffice: Postoffice, scheduler: NodeId,
+                 kv_worker, domain: Domain = Domain.LOCAL):
+        self.po = postoffice
+        self.scheduler = scheduler
+        self.domain = domain
+        self._cv = threading.Condition()
+        self._reply: Optional[dict] = None
+        self._incoming: List[Tuple[dict, dict]] = []  # (grads, body)
+        self._iter = 0
+        postoffice.add_control_hook(self._on_control)
+        # chain with any existing handler (the pull-direction overlay also
+        # routes inbound data requests through ts_handler)
+        prev = kv_worker.ts_handler
+
+        def dispatch(msg: Message):
+            if msg.cmd == TS_PUSH_MERGE_CMD:
+                self._on_merge_msg(msg)
+            elif prev is not None:
+                prev(msg)
+            else:
+                raise AssertionError(f"unexpected TS request: {msg}")
+
+        kv_worker.ts_handler = dispatch
+
+    # ---- control ------------------------------------------------------------
+    def _on_control(self, msg: Message) -> bool:
+        if msg.control is Control.REPLY and isinstance(msg.body, dict) \
+                and "action" in msg.body:
+            with self._cv:
+                self._reply = msg.body
+                self._cv.notify_all()
+            return True
+        return False
+
+    def _ask(self, it: int, num_merge: int, timeout: float = 30.0) -> dict:
+        with self._cv:
+            self._reply = None
+        self.po.van.send(Message(
+            recipient=self.scheduler, control=Control.ASK_PUSH,
+            domain=self.domain, body={"iter": it, "num_merge": num_merge}))
+        with self._cv:
+            ok = self._cv.wait_for(lambda: self._reply is not None,
+                                   timeout=timeout)
+            if not ok:
+                raise TimeoutError(f"{self.po.node}: ASK_PUSH timed out")
+            return self._reply
+
+    # ---- data plane ---------------------------------------------------------
+    def _on_merge_msg(self, msg: Message):
+        grads = {}
+        off = 0
+        for tid, ln in zip(msg.keys, msg.lens):
+            grads[int(tid)] = np.array(msg.vals[off:off + ln], copy=True)
+            off += ln
+        with self._cv:
+            self._incoming.append((grads, msg.body or {}))
+            self._cv.notify_all()
+
+    def _send_grads(self, peer: NodeId, grads: dict, num_merge: int, it: int):
+        tids = sorted(grads)
+        keys = np.array(tids, dtype=np.int64)
+        vals = np.concatenate([grads[t].ravel() for t in tids])
+        lens = np.array([grads[t].size for t in tids], dtype=np.int64)
+        self.po.van.send(Message(
+            recipient=peer, domain=self.domain, app_id=0, customer_id=0,
+            timestamp=-1, request=True, push=True, cmd=TS_PUSH_MERGE_CMD,
+            keys=keys, vals=vals.astype(np.float32), lens=lens,
+            body={"iter": it, "num_merge": num_merge},
+        ))
+
+    def _wait_incoming(self, timeout: float = 30.0) -> Tuple[dict, dict]:
+        with self._cv:
+            ok = self._cv.wait_for(lambda: len(self._incoming) > 0,
+                                   timeout=timeout)
+            if not ok:
+                raise TimeoutError(f"{self.po.node}: merge relay never arrived")
+            return self._incoming.pop(0)
+
+    # ---- public -------------------------------------------------------------
+    def merge_push(self, grads: Dict[int, np.ndarray]) -> Optional[dict]:
+        """Join this round's merge tree.  Returns the fully-merged gradient
+        set if this worker was elected to push to the server, else None."""
+        self._iter += 1
+        it = self._iter
+        grads = {t: np.asarray(g, np.float32).ravel() for t, g in grads.items()}
+        num_merge = 1
+        while True:
+            reply = self._ask(it, num_merge)
+            action = reply["action"]
+            if action == "server":
+                return grads
+            if action == "send":
+                self._send_grads(NodeId.parse(reply["peer"]), grads,
+                                 num_merge, it)
+                return None
+            # recv: wait for the peer's set, merge (ref: WorkersMerge —
+            # elementwise sum of contributions), carry the summed count
+            peer_grads, body = self._wait_incoming()
+            for t, g in peer_grads.items():
+                grads[t] = grads.get(t, 0) + g
+            num_merge += int(body.get("num_merge", 1))

@@ -178,6 +178,45 @@ def test_tsengine_scheduler_greedy_prefers_fast_links():
     assert all(p == "w1" for p in picks)
 
 
+def test_tsengine_push_direction_merge_tree():
+    """3 workers merge their gradients worker-to-worker; exactly one is
+    elected to push the fully-merged set (ref: ASK_PUSH pairing
+    van.cc:1197-1252 + WorkersMerge kvstore_dist.h:91-173)."""
+    from geomx_tpu.sched.ts_push import TsPushScheduler, TsPushWorker
+
+    sim = make_sim(parties=1, workers=3)
+    try:
+        topo = sim.topology
+        TsPushScheduler(sim.offices[str(topo.scheduler(0))], num_workers=3)
+        results = {}
+        lock = threading.Lock()
+
+        def worker_main(rank):
+            kv = sim.worker(0, rank)
+            tsp = TsPushWorker(kv.po, topo.scheduler(0), kv.worker)
+            grads = {0: np.full(16, float(rank + 1), np.float32),
+                     1: np.full(4, 10.0 * (rank + 1), np.float32)}
+            merged = tsp.merge_push(grads)
+            with lock:
+                results[rank] = merged
+
+        threads = [threading.Thread(target=worker_main, args=(r,))
+                   for r in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == 3
+        elected = [r for r, m in results.items() if m is not None]
+        assert len(elected) == 1, results
+        merged = results[elected[0]]
+        # sum over workers: (1+2+3) and 10*(1+2+3)
+        np.testing.assert_allclose(merged[0], 6.0)
+        np.testing.assert_allclose(merged[1], 60.0)
+    finally:
+        sim.shutdown()
+
+
 def test_p3_priority_queue_on_van():
     """enable_p3 switches worker vans to priority send queues."""
     sim = make_sim(parties=1, workers=1, enable_p3=True)
