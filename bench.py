@@ -30,7 +30,9 @@ from geomx_tpu.models import create_cnn_state
 # is input/launch-bound, so an A100 (312 bf16 TFLOPs) and a v5e chip land
 # in the same range; assume parity (~400k img/s) until BASELINE.json gains
 # a measured number.  vs_baseline ~1.0 therefore means "at the 0.9x-A100
-# target".
+# target".  NOTE: the workload (BATCH/STEPS) and this constant are pinned
+# together — changing one without re-estimating the other corrupts
+# vs_baseline comparability across rounds.
 A100_REF_IMAGES_PER_SEC = 400_000.0
 BATCH = 1024
 STEPS = 50

@@ -304,11 +304,15 @@ class WorkerKVStore:
         return out[tid]
 
     def wait_all(self):
-        """Drain every outstanding push/pull (ref: kvstore.py _wait semantics)."""
+        """Drain every outstanding push/pull (ref: kvstore.py _wait
+        semantics).  Raises if any server rejected a request."""
         with self._mu:
             pending, self._pending = self._pending, []
         for ts in pending:
             self.worker.wait(ts)
+        if self.worker.errors:
+            errs, self.worker.errors = list(self.worker.errors), []
+            raise RuntimeError("; ".join(errs))
 
     def barrier(self, is_global: bool = False):
         """Party-wide (workers+server) or WAN-wide barrier

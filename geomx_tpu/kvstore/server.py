@@ -117,6 +117,7 @@ class LocalServer:
         self.hfa_enabled = self.config.use_hfa
         self.hfa_k2 = self.config.hfa_k2
         self._milestone: Dict[int, np.ndarray] = {}
+        self._saw_row_sparse = False
         self.compression: dict = {"type": "none"}
         self.push_codec = None  # set by Ctrl.SET_COMPRESSION
         # TSEngine intra-party dissemination (ref: DefaultAutoPull
@@ -228,12 +229,10 @@ class LocalServer:
         from geomx_tpu.compression.codecs import unpack_rows
 
         if self.hfa_enabled:
-            import logging
-
-            logging.getLogger(__name__).error(
-                "%s: dropping row-sparse push under HFA (incompatible)",
-                self.po.node)
-            self.server.response(msg)
+            # reject with an error body the client surfaces on wait_all()
+            # — a bare ACK would let training silently diverge
+            self.server.response(msg, body={
+                "error": "row-sparse push rejected: server is in HFA mode"})
             return
         cols = int(msg.body["rs_cols"])
         row_ids, rows = unpack_rows(kvs.vals, cols)
@@ -248,9 +247,11 @@ class LocalServer:
                 self._drain_parked_locked(st)
             self.server.response(msg)
             self._push_up(KVPairs(kvs.keys, dense,
-                                  np.array([len(dense)], np.int64)))
+                                  np.array([len(dense)], np.int64)),
+                          rs_keys={key})
             return
         completed = []
+        self._saw_row_sparse = True
         with self._mu:
             st = self._keys.setdefault(key, _KeyState())
             if st.accum is None:
@@ -281,6 +282,8 @@ class LocalServer:
                 else:
                     up_ks.append(k)
 
+            rs_keys = set()
+
             def take(ks):
                 vs, ls = [], []
                 for k in ks:
@@ -289,6 +292,9 @@ class LocalServer:
                     ls.append(len(st.accum))
                     st.accum = None
                     st.count = 0
+                    if st.row_sparse:
+                        rs_keys.add(k)
+                        st.row_sparse = False  # describes this round only
                 return KVPairs(np.array(ks, dtype=np.int64),
                                np.concatenate(vs), np.array(ls, dtype=np.int64))
 
@@ -299,6 +305,8 @@ class LocalServer:
         if kvs_up is not None:
             if self.hfa_enabled:
                 self._push_up_hfa(kvs_up)
+            elif rs_keys:
+                self._push_up(kvs_up, rs_keys=rs_keys)
             else:
                 self._push_up(kvs_up)
 
@@ -311,7 +319,7 @@ class LocalServer:
                 self.store[k] = np.array(v, copy=True)
             self._finish_round(list(kvs.keys))
 
-    def _push_up(self, kvs: KVPairs):
+    def _push_up(self, kvs: KVPairs, rs_keys=frozenset()):
         if self._prof.running:
             self._prof.count("wan_rounds", 1.0)
         keys = [int(k) for k in kvs.keys]
@@ -330,11 +338,8 @@ class LocalServer:
             # that is smaller (the WAN half of the row-sparse path)
             from geomx_tpu.compression.codecs import pack_sparse
 
-            with self._mu:
-                rs = {k: (k in self._keys and self._keys[k].row_sparse)
-                      for k in keys}
             for k, v in kvs.slices():
-                if rs[int(k)]:
+                if int(k) in rs_keys:
                     idx = np.nonzero(v)[0]
                     if 2 * len(idx) < len(v):
                         groups.setdefault("bsc", []).append(
@@ -514,6 +519,11 @@ class LocalServer:
                 self.server.reply_cmd(msg, body={"error": str(e)})
                 return
         elif msg.cmd == Ctrl.SET_HFA:
+            if bool(body["enabled"]) and self._saw_row_sparse:
+                self.server.reply_cmd(msg, body={
+                    "error": "cannot enable HFA: row-sparse tensors are in "
+                             "use (HFA exchanges weights, not gradients)"})
+                return
             self.hfa_enabled = bool(body["enabled"])
             self.hfa_k2 = int(body.get("k2", 1))
         elif msg.cmd == Ctrl.QUERY_STATS:

@@ -53,6 +53,11 @@ def party_meshes(num_parties: int, devices=None, axis: str = "dp"):
         devices = jax.devices()
     per = len(devices) // num_parties
     assert per >= 1, f"{len(devices)} devices cannot host {num_parties} parties"
+    if len(devices) % num_parties:
+        raise ValueError(
+            f"{len(devices)} devices do not divide into {num_parties} "
+            f"parties — {len(devices) % num_parties} chips would be "
+            "silently stranded; pass an explicit device subset")
     out = []
     for p in range(num_parties):
         devs = np.asarray(devices[p * per:(p + 1) * per]).reshape(per)

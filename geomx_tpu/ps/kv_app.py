@@ -170,6 +170,9 @@ class KVWorker(_App):
         self._pull_cbs: Dict[int, Callable[[KVPairs], None]] = {}
         self._pull_expected: Dict[int, int] = {}
         self._mu = threading.Lock()
+        # server-reported errors (e.g. rejected pushes); surfaced by the
+        # kvstore client on wait_all — a bare ACK would hide them
+        self.errors: List[str] = []
 
     # ---- slicing ------------------------------------------------------------
     def _slice(self, kvs: KVPairs) -> Dict[int, KVPairs]:
@@ -313,6 +316,9 @@ class KVWorker(_App):
                 self.ts_handler(msg)
                 return
             raise AssertionError(f"KVWorker got a request: {msg}")
+        if isinstance(msg.body, dict) and "error" in msg.body:
+            with self._mu:
+                self.errors.append(str(msg.body["error"]))
         ts = msg.timestamp
         if msg.keys is not None and msg.vals is not None:
             # pull (or push_pull) response carrying data

@@ -36,29 +36,42 @@ TS_PUSH_MERGE_CMD = 100  # data-plane cmd for merge relays
 class TsPushScheduler:
     """Pairs ready pushers per round (ref: van.cc:1197-1252)."""
 
-    def __init__(self, postoffice: Postoffice, num_workers: int):
+    def __init__(self, postoffice: Postoffice, num_workers: int,
+                 pending_ttl_s: float = 60.0):
         self.po = postoffice
         self.num_workers = num_workers
+        self.pending_ttl_s = pending_ttl_s
         self._mu = threading.Lock()
-        # iter -> list of (asker Message, num_merge)
-        self._pending: Dict[int, List[Tuple[Message, int]]] = {}
+        # iter -> list of (asker Message, num_merge, enqueue_time)
+        self._pending: Dict[int, List[Tuple[Message, int, float]]] = {}
         postoffice.add_control_hook(self._on_control)
 
     def _on_control(self, msg: Message) -> bool:
+        import time as _time
+
         if msg.control is not Control.ASK_PUSH:
             return False
         body = msg.body or {}
         it = int(body.get("iter", 0))
         nm = int(body.get("num_merge", 1))
         replies = []
+        now = _time.monotonic()
         with self._mu:
+            # expire abandoned entries (their worker timed out waiting for
+            # a pairing that can no longer happen) so the dict can't leak
+            # and dead waiters are never paired against
+            for k in list(self._pending):
+                self._pending[k] = [e for e in self._pending[k]
+                                    if now - e[2] < self.pending_ttl_s]
+                if not self._pending[k]:
+                    del self._pending[k]
             pend = self._pending.setdefault(it, [])
             if nm >= self.num_workers:
                 # this node holds everything → send to server
                 replies.append((msg, {"action": "server"}))
                 self._pending.pop(it, None)
             elif pend:
-                other, other_nm = pend.pop(0)
+                other, other_nm, _ = pend.pop(0)
                 # the longer-waiting node receives; the newcomer sends
                 replies.append((other, {"action": "recv",
                                         "peer": str(msg.sender),
@@ -66,7 +79,7 @@ class TsPushScheduler:
                 replies.append((msg, {"action": "send",
                                       "peer": str(other.sender)}))
             else:
-                pend.append((msg, nm))
+                pend.append((msg, nm, now))
         for req, body_out in replies:
             self.po.van.send(req.reply_to(control=Control.REPLY,
                                           body=body_out))
