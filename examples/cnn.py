@@ -54,6 +54,9 @@ def main():
                          "sends + piggybacked pulls)")
     ap.add_argument("--tsengine", action="store_true",
                     help="TSEngine overlay dissemination (intra-party)")
+    ap.add_argument("--tsengine-inter", action="store_true",
+                    help="TSEngine WAN overlay (global servers -> local "
+                         "servers replaces the FSA pull-down)")
     ap.add_argument("--dgt", type=int, default=0, choices=[0, 1, 2],
                     help="DGT transport mode (1=lossy channels, 2=reliable)")
     ap.add_argument("--hfa", action="store_true")
@@ -76,6 +79,7 @@ def main():
         enable_p3=args.p3,
         p3_slice_elems=50_000,
         enable_intra_ts=args.tsengine,
+        enable_inter_ts=args.tsengine_inter,
         enable_dgt=args.dgt,
     )
     sim = Simulation(cfg)

@@ -269,6 +269,16 @@ class Config:
                 f"drop_rate must be a fraction in [0,1], got {self.drop_rate} "
                 "(note: the GEOMX_DROP_MSG / PS_DROP_MSG env vars are percents)"
             )
+        if self.enable_inter_ts and not self.sync_global_mode:
+            raise ValueError(
+                "enable_inter_ts requires a synchronous global tier: the "
+                "async tier never disseminates, so local servers (which "
+                "skip the pull-down under inter-TS) would deadlock")
+        if self.enable_inter_ts and self.compression in ("bsc", "mpq"):
+            raise ValueError(
+                "enable_inter_ts cannot combine with bsc/mpq pull "
+                "compression (per-subscriber sparsified deltas don't fit "
+                "a shared relay payload); use fp16 or none")
 
     @staticmethod
     def from_env() -> "Config":

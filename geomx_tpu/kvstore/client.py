@@ -120,7 +120,7 @@ class WorkerKVStore:
         on this customer thread, which must stay free to receive replies."""
         from geomx_tpu.ps import KVPairs as _KVPairs
 
-        it = int(msg.body["iter"])
+        it = str(msg.body["iter"])
         kvs = _KVPairs(msg.keys, msg.vals, msg.lens)
         with self._ts_cv:
             for k, v in kvs.slices():

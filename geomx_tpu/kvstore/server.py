@@ -129,6 +129,16 @@ class LocalServer:
 
             self.ts_client = TsClient(
                 postoffice, topo.scheduler(postoffice.node.party))
+        # inter-party TSEngine: the WAN pull-down is replaced by overlay
+        # dissemination from the global servers; this client relays onward
+        # to sibling local servers (ref: inter-DC TS — server-side
+        # WorkersMerge/AutoPullUpdate, kvstore_dist_server.h:228-310)
+        self.ts_inter = None
+        if self.config.enable_inter_ts:
+            from geomx_tpu.sched.tsengine import TsClient
+
+            self.ts_inter = TsClient(
+                postoffice, topo.global_scheduler(), domain=Domain.GLOBAL)
 
     # ---- request handling ---------------------------------------------------
     def _handle(self, msg: Message, kvs: Optional[KVPairs], server: KVServer):
@@ -143,6 +153,9 @@ class LocalServer:
             with prof.span("local.pull_rs"):
                 with self._mu:
                     self._try_serve_pull_locked(msg)
+        elif msg.cmd == Cmd.TS_AUTOPULL:
+            with prof.span("local.ts_inter"):
+                self._on_inter_ts_delivery(msg, kvs)
         elif msg.push:
             with prof.span("local.push"):
                 self._handle_push(msg, kvs)
@@ -266,6 +279,21 @@ class LocalServer:
         if completed:
             self._round_complete(completed)
 
+    def _on_inter_ts_delivery(self, msg: Message, kvs: KVPairs):
+        """Updated weights arrived via the WAN overlay instead of a pull
+        (inter-party TSEngine): adopt them, finish the round, confirm
+        delivery, and relay onward to sibling local servers."""
+        it = str(msg.body["iter"])
+        with self._mu:
+            for k, v in kvs.slices():
+                # fp16 relay payloads decode back to f32 replicas
+                self.store[k] = np.asarray(v, dtype=np.float32).copy()
+            self._finish_round([int(k) for k in kvs.keys
+                                if int(k) in self._keys])
+        self.ts_inter.send_reply(msg.sender, it)
+        self.ts_inter.disseminate_async(msg.keys, msg.vals, msg.lens, it,
+                                        Cmd.TS_AUTOPULL)
+
     def _round_complete(self, keys: List[int]):
         """All party workers pushed `keys` — run the WAN push-up.
 
@@ -326,7 +354,10 @@ class LocalServer:
 
         def pull_down():
             # all global shards applied the update → pull fresh weights
-            # (ref: DataHandlePushResponseDefault :941-957)
+            # (ref: DataHandlePushResponseDefault :941-957).  Under
+            # inter-party TS the overlay delivers them instead.
+            if self.ts_inter is not None:
+                return
             self.up.zpull(keys, cb=self._on_pull_down)
 
         # group keys by wire codec so each message has a uniform payload
@@ -448,7 +479,7 @@ class LocalServer:
                 np.array(ks, dtype=np.int64),
                 np.concatenate([self.store[k].astype(np.float32) for k in ks]),
                 np.array([len(self.store[k]) for k in ks], dtype=np.int64),
-                self._ts_iter, Cmd.TS_AUTOPULL)
+                f"{self.po.node}:{self._ts_iter}", Cmd.TS_AUTOPULL)
 
     def _drain_parked_locked(self, st: _KeyState):
         parked, st.parked_pulls = st.parked_pulls, []
@@ -543,6 +574,8 @@ class LocalServer:
     def stop(self):
         if self.ts_client is not None:
             self.ts_client.stop()
+        if self.ts_inter is not None:
+            self.ts_inter.stop()
         self.server.stop()
         self.up.stop()
 
@@ -579,6 +612,16 @@ class GlobalServer:
         from geomx_tpu.utils import get_profiler
 
         self._prof = get_profiler(str(postoffice.node))
+        # inter-party TSEngine: after a sync round updates, disseminate
+        # the fresh weights to the local servers via the WAN overlay
+        # instead of serving N pulls (sync tier only)
+        self.ts_inter = None
+        self._ts_iter = 0
+        if self.config.enable_inter_ts:
+            from geomx_tpu.sched.tsengine import TsClient
+
+            self.ts_inter = TsClient(
+                postoffice, topo.global_scheduler(), domain=Domain.GLOBAL)
         self.server = KVServer(APP_PS, 0, postoffice, self._handle)
         self.server.cmd_handler = self._on_cmd
 
@@ -674,8 +717,28 @@ class GlobalServer:
                         to_ack.append(ent[0])
                 st.parked_pushes.clear()
                 self._serve_parked_pulls_locked(k)
+            if (self.ts_inter is not None and completed
+                    and msg.cmd == Cmd.DEFAULT):
+                ks = sorted(completed)
+                self._ts_iter += 1
+                # honor fp16 pull compression on the relay payload (bsc/mpq
+                # are rejected at config time — per-subscriber deltas don't
+                # fit a shared relay)
+                dt = (np.float16 if self.compression.get("type") == "fp16"
+                      else np.float32)
+                dissem = (
+                    np.array(ks, dtype=np.int64),
+                    np.concatenate([self.store[k].astype(dt) for k in ks]),
+                    np.array([len(self.store[k]) for k in ks],
+                             dtype=np.int64),
+                    f"{self.po.node}:{self._ts_iter}",
+                )
+            else:
+                dissem = None
         for req in to_ack:
             self.server.response(req)
+        if dissem is not None:
+            self.ts_inter.disseminate_async(*dissem, Cmd.TS_AUTOPULL)
 
     # ---- async tier (MixedSync, ref :1519-1698) -----------------------------
     def _push_async(self, msg: Message, kvs: KVPairs):
@@ -792,6 +855,13 @@ class GlobalServer:
             except ValueError as e:
                 self.server.reply_cmd(msg, body={"error": str(e)})
                 return
+            if (self.ts_inter is not None
+                    and body.get("type") in ("bsc", "mpq")):
+                self.server.reply_cmd(msg, body={
+                    "error": "bsc/mpq pull compression cannot combine with "
+                             "inter-TS dissemination (per-subscriber deltas "
+                             "don't fit a shared relay payload)"})
+                return
             with self._mu:
                 if body == self.compression:
                     # idempotent: every party's rank-0 sends this; a
@@ -801,6 +871,12 @@ class GlobalServer:
                     return
                 self._apply_compression_locked(body)
         elif msg.cmd == Ctrl.SET_SYNC_GLOBAL_MODE:
+            if not bool(body["sync"]) and self.ts_inter is not None:
+                self.server.reply_cmd(msg, body={
+                    "error": "cannot switch the global tier async under "
+                             "inter-TS (the async tier never disseminates "
+                             "— local servers would deadlock)"})
+                return
             self.sync_mode = bool(body["sync"])
         elif msg.cmd == Ctrl.QUERY_STATS:
             van = self.po.van
@@ -850,4 +926,6 @@ class GlobalServer:
         self.server.reply_cmd(msg)
 
     def stop(self):
+        if self.ts_inter is not None:
+            self.ts_inter.stop()
         self.server.stop()

@@ -24,10 +24,6 @@ class Simulation:
         self.config = config
         self.topology = config.topology
         self.fabric = InProcFabric(fault=fault, config=config)
-        if config.enable_inter_ts:
-            raise NotImplementedError(
-                "inter-party TSEngine is not wired yet; intra-party "
-                "(enable_intra_ts) is supported")
         self.offices: Dict[str, Postoffice] = {}
         for n in self.topology.all_nodes():
             po = Postoffice(n, self.topology, self.fabric, config)
@@ -43,6 +39,14 @@ class Simulation:
                     members=self.topology.workers(p),
                     greed_rate=config.ts_max_greed_rate,
                 ))
+        if config.enable_inter_ts:
+            from geomx_tpu.sched.tsengine import TsScheduler
+
+            self.ts_schedulers.append(TsScheduler(
+                self.offices[str(self.topology.global_scheduler())],
+                members=self.topology.servers(),
+                greed_rate=config.ts_max_greed_rate,
+            ))
         self.local_servers: List[LocalServer] = [
             LocalServer(self.offices[str(self.topology.server(p))], config)
             for p in range(self.topology.num_parties)

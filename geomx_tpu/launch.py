@@ -68,6 +68,11 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
 
         role_obj = TsScheduler(po, config.topology.workers(node.party),
                                greed_rate=config.ts_max_greed_rate)
+    elif node.role is Role.GLOBAL_SCHEDULER and config.enable_inter_ts:
+        from geomx_tpu.sched.tsengine import TsScheduler
+
+        role_obj = TsScheduler(po, config.topology.servers(),
+                               greed_rate=config.ts_max_greed_rate)
     elif node.role is Role.WORKER:
         from geomx_tpu.kvstore.client import WorkerKVStore
 
@@ -140,6 +145,7 @@ def main(argv=None):
     ap.add_argument("--hfa", action="store_true")
     ap.add_argument("--p3", action="store_true")
     ap.add_argument("--tsengine", action="store_true")
+    ap.add_argument("--tsengine-inter", action="store_true")
     ap.add_argument("--sync", default="fsa", choices=["fsa", "mixed"])
     ap.add_argument("--dgt", type=int, default=0, choices=[0, 1, 2])
     args = ap.parse_args(argv)
@@ -157,6 +163,7 @@ def main(argv=None):
     cfg.use_hfa = args.hfa or cfg.use_hfa
     cfg.enable_p3 = args.p3 or cfg.enable_p3
     cfg.enable_intra_ts = args.tsengine or cfg.enable_intra_ts
+    cfg.enable_inter_ts = args.tsengine_inter or cfg.enable_inter_ts
     cfg.sync_global_mode = (args.sync == "fsa") and cfg.sync_global_mode
     cfg.enable_dgt = args.dgt or cfg.enable_dgt
     po, role_obj, stop_ev = build_runtime(node, cfg, args.base_port)

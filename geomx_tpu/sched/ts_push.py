@@ -37,7 +37,11 @@ class TsPushScheduler:
     """Pairs ready pushers per round (ref: van.cc:1197-1252)."""
 
     def __init__(self, postoffice: Postoffice, num_workers: int,
-                 pending_ttl_s: float = 60.0):
+                 pending_ttl_s: float = 25.0):
+        # NOTE: pending_ttl_s must stay BELOW the workers' ask timeout
+        # (30s in TsPushWorker._ask) — an entry older than its asker's
+        # timeout belongs to a worker that already gave up and must never be
+        # paired against.
         self.po = postoffice
         self.num_workers = num_workers
         self.pending_ttl_s = pending_ttl_s

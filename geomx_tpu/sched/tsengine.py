@@ -11,10 +11,12 @@ last transfer, the scheduler keeps a matrix ``A[from][to]`` and picks the
 next receiver greedily with probability ``min(known_fraction,
 MAX_GREED_RATE_TS)``, else uniformly (ε-exploration, ref: van.cc:1312-1386).
 
-Scope: the intra-party tier (server → workers) is wired into the kvstore;
-the same scheduler serves any member set, so the inter-party tier (global
-server → local servers over DCN) reuses this machinery when enabled in a
-later round (Config.enable_inter_ts currently rejects loudly).
+Scope: both tiers are wired into the kvstore — intra-party
+(enable_intra_ts: party server → workers over the LAN) and inter-party
+(enable_inter_ts: global servers → local servers over the WAN, replacing
+the FSA pull-down with overlay dissemination).  Round tokens are strings
+("node:counter") so concurrent initiators (MultiGPS global servers)
+never collide in the scheduler's served-set.
 
 Control plane: Control.ASK_PULL / Control.REPLY / Control.AUTOPULL_REPLY
 messages through Postoffice control hooks (ref: new control cmds
@@ -47,7 +49,9 @@ class TsScheduler:
         self.members = [str(m) for m in members]
         self.greed = greed_rate
         self.A: Dict[str, Dict[str, float]] = {}  # A[from][to] = throughput
-        self._served: Dict[int, set] = {}
+        self._served: Dict[str, set] = {}
+        self._done: set = set()
+        self._done_rounds: list = []
         self._mu = threading.Lock()
         self._rng = random.Random(seed)
         postoffice.add_control_hook(self._on_control)
@@ -56,24 +60,39 @@ class TsScheduler:
         if msg.control is not Control.ASK_PULL:
             return False
         body = msg.body or {}
-        it = int(body.get("iter", 0))
+        it = str(body.get("iter", ""))
         sender = str(msg.sender)
         # learn the reported throughput of the asker's last transfer
         last, thr = body.get("last"), body.get("throughput")
         if last is not None and thr is not None:
             self.A.setdefault(sender, {})[last] = float(thr)
         with self._mu:
-            served = self._served.setdefault(it, set())
-            candidates = [m for m in self.members
-                          if m not in served and m != sender]
-            if not candidates:
+            if it in self._done:
+                # round already fully served — a late relayer's ask must
+                # NOT recreate the served-set and re-serve stale data
                 receiver = None
-                # round fully served: garbage-collect old rounds
-                for old in [k for k in self._served if k < it - 2]:
-                    del self._served[old]
             else:
-                receiver = self._choose(sender, candidates)
-                served.add(receiver)
+                if it not in self._served and len(self._served) > 1000:
+                    # rounds abandoned mid-flight (relay timeout, dead
+                    # member) never reach the no-candidates branch — bound
+                    # the map by evicting the oldest stalled round
+                    oldest = next(iter(self._served))
+                    del self._served[oldest]
+                served = self._served.setdefault(it, set())
+                candidates = [m for m in self.members
+                              if m not in served and m != sender]
+                if not candidates:
+                    receiver = None
+                    self._served.pop(it, None)
+                    self._done.add(it)
+                    self._done_rounds.append(it)
+                    if len(self._done_rounds) > 1000:
+                        old = self._done_rounds.pop(0)
+                        self._done.discard(old)
+                        self._served.pop(old, None)
+                else:
+                    receiver = self._choose(sender, candidates)
+                    served.add(receiver)
         self.po.van.send(msg.reply_to(
             control=Control.REPLY, body={"receiver": receiver, "iter": it}))
         return True
@@ -99,9 +118,12 @@ class TsClient:
         self.po = postoffice
         self.scheduler = scheduler
         self.domain = domain
+        import collections
+
         self._cv = threading.Condition()
         self._replies: Dict[int, Optional[str]] = {}
         self._acks: set = set()
+        self._ack_order: "collections.deque" = collections.deque()
         self._seq = 0
         postoffice.add_control_hook(self._on_control)
         # dissemination runs on a dedicated thread: the ask/send loop
@@ -113,7 +135,7 @@ class TsClient:
             name=f"ts-dissem-{postoffice.node}")
         self._dissem_thread.start()
 
-    def disseminate_async(self, keys, vals, lens, it: int, cmd: int):
+    def disseminate_async(self, keys, vals, lens, it: str, cmd: int):
         """Queue a relay round: ask the scheduler for receivers and send
         until the round is fully served (ref: AutoPullUpdate loop
         kv_app.h:1181-1224). Returns immediately."""
@@ -137,14 +159,21 @@ class TsClient:
                 import logging
 
                 logging.getLogger(__name__).warning(
-                    "%s: TS dissemination round %d aborted", self.po.node, it)
+                    "%s: TS dissemination round %s aborted", self.po.node, it)
 
     def stop(self):
         self._dq.put(None)
 
     def _on_control(self, msg: Message) -> bool:
+        """A node can host several TsClients (intra + inter overlays):
+        scheduler REPLYs are consumed only by the client of that
+        scheduler; AUTOPULL_REPLY acks are recorded but NOT consumed so
+        every client sees them (the ack key includes the round token,
+        which only the initiating client waits on)."""
         if msg.control is Control.REPLY and isinstance(msg.body, dict) \
                 and "receiver" in msg.body:
+            if msg.sender != self.scheduler:
+                return False
             with self._cv:
                 self._replies[msg.timestamp] = msg.body["receiver"]
                 self._cv.notify_all()
@@ -152,13 +181,19 @@ class TsClient:
         if msg.control is Control.AUTOPULL_REPLY:
             # delivery confirmation from a relay receiver
             # (ref: WaitForFinish van.cc:1142-1165)
+            key = (str(msg.sender), str(msg.body["iter"]))
             with self._cv:
-                self._acks.add((str(msg.sender), int(msg.body["iter"])))
+                self._acks.add(key)
+                self._ack_order.append(key)
+                # evict oldest unmatched (foreign) acks only — a blanket
+                # clear() could wipe an ack a live send_model is awaiting
+                while len(self._ack_order) > 10_000:
+                    self._acks.discard(self._ack_order.popleft())
                 self._cv.notify_all()
-            return True
+            return False
         return False
 
-    def send_model(self, recipient: NodeId, keys, vals, lens, it: int,
+    def send_model(self, recipient: NodeId, keys, vals, lens, it: str,
                    cmd: int, app_id: int = 0,
                    timeout: float = 30.0) -> float:
         """Send a model relay message; block for the receiver's
@@ -184,13 +219,13 @@ class TsClient:
         elapsed = max(time.monotonic() - t0, 1e-9)
         return nbytes / elapsed
 
-    def send_reply(self, to: NodeId, it: int):
+    def send_reply(self, to: NodeId, it: str):
         self.po.van.send(Message(
             recipient=to, control=Control.AUTOPULL_REPLY,
             domain=self.domain, body={"iter": it},
         ))
 
-    def ask_receiver(self, it: int, last: Optional[str] = None,
+    def ask_receiver(self, it: str, last: Optional[str] = None,
                      throughput: Optional[float] = None,
                      timeout: float = 30.0) -> Optional[NodeId]:
         """Blocking: who should I send the round-``it`` model to next?"""

@@ -1,3 +1,3 @@
 #!/usr/bin/env bash
 # Acceptance config: tsengine (mirrors the reference scripts/cpu/run_tsengine.sh)
-exec "$(dirname "$0")/run_cluster.sh" --tsengine
+exec "$(dirname "$0")/run_cluster.sh" --tsengine --tsengine-inter

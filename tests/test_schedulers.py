@@ -159,6 +159,54 @@ def test_tsengine_overlay_delivers_updates():
         sim.shutdown()
 
 
+def test_tsengine_inter_party_overlay():
+    """Inter-TS: the WAN pull-down is replaced by scheduler-driven
+    dissemination from the global server to the local servers — results
+    must match plain FSA exactly."""
+    sim = make_sim(parties=3, workers=1, enable_inter_ts=True)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(64, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        for step in range(3):
+            for w in ws:
+                w.push(0, np.ones(64, np.float32))
+            outs = [w.pull_sync(0) for w in ws]
+        # global grad per step = sum over 3 parties / 3 = 1 → -0.1/step
+        for out in outs:
+            np.testing.assert_allclose(out, -0.3, rtol=1e-5)
+        # the global scheduler's throughput matrix learned links
+        assert len(sim.ts_schedulers[-1].A) > 0
+    finally:
+        sim.shutdown()
+
+
+def test_tsengine_intra_plus_inter_combined():
+    """Both overlays at once: worker pulls come from the intra relay,
+    local-server weights come from the inter relay."""
+    sim = make_sim(parties=2, workers=2, enable_intra_ts=True,
+                   enable_inter_ts=True)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(32, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        got = {}
+        for step in range(2):
+            for w in ws:
+                w.push(0, np.ones(32, np.float32))
+            for i, w in enumerate(ws):
+                w.pull(0, lambda t, a, i=i: got.__setitem__(i, a))
+            for w in ws:
+                w.wait_all()
+        # party sum = 2, global mean over 2 parties = 2 → -0.2/step × 2
+        for i in range(4):
+            np.testing.assert_allclose(got[i], -0.4, rtol=1e-5)
+    finally:
+        sim.shutdown()
+
+
 def test_tsengine_scheduler_greedy_prefers_fast_links():
     """With a fully-known throughput row, greed picks the argmax."""
     from geomx_tpu.sched.tsengine import TsScheduler
