@@ -57,8 +57,8 @@ def main():
     ap.add_argument("--tsengine-inter", action="store_true",
                     help="TSEngine WAN overlay (global servers -> local "
                          "servers replaces the FSA pull-down)")
-    ap.add_argument("--dgt", type=int, default=0, choices=[0, 1, 2],
-                    help="DGT transport mode (1=lossy channels, 2=reliable)")
+    ap.add_argument("--dgt", type=int, default=0, choices=[0, 1, 2, 3],
+                    help="DGT transport mode (1=lossy channels, 2=reliable, 3=reliable+4bit requant)")
     ap.add_argument("--hfa", action="store_true")
     ap.add_argument("--hfa-k1", type=int, default=2,
                     help="local steps between party syncs")

@@ -274,6 +274,11 @@ class Config:
                 "enable_inter_ts requires a synchronous global tier: the "
                 "async tier never disseminates, so local servers (which "
                 "skip the pull-down under inter-TS) would deadlock")
+        if self.enable_p3 and self.enable_intra_ts:
+            raise ValueError(
+                "enable_p3 and enable_intra_ts are mutually exclusive "
+                "accelerations: P3's piggybacked pulls bypass the TS "
+                "overlay, and the merge tree bypasses P3's sliced sends")
         if self.enable_inter_ts and self.compression in ("bsc", "mpq"):
             raise ValueError(
                 "enable_inter_ts cannot combine with bsc/mpq pull "

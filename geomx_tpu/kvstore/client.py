@@ -57,8 +57,10 @@ class WorkerKVStore:
         # buffer instead of the server (ref: KVWorker::AutoPull blocks on
         # auto_pull_kvs_ kv_app.h:1408-1455)
         self.ts_client = None
+        self.ts_push = None
         if self.config.enable_intra_ts:
             from geomx_tpu.sched.tsengine import TsClient
+            from geomx_tpu.sched.ts_push import TsPushWorker
 
             self.ts_client = TsClient(postoffice, topo.scheduler(self.party))
             self._ts_cv = threading.Condition()
@@ -66,6 +68,10 @@ class WorkerKVStore:
             self._ts_count: Dict[int, int] = {}
             self._push_rounds: Dict[int, int] = {}
             self.worker.ts_handler = self._on_ts_relay
+            # push-direction overlay: worker-to-worker merge trees
+            # (ref: ASK_PUSH pairing van.cc:1197-1252)
+            self.ts_push = TsPushWorker(postoffice, topo.scheduler(self.party),
+                                        self.worker)
         self._shapes: Dict[int, tuple] = {}
         self._dtypes: Dict[int, np.dtype] = {}
         self._pending: List[int] = []
@@ -131,17 +137,43 @@ class WorkerKVStore:
         self.ts_client.disseminate_async(msg.keys, msg.vals, msg.lens, it,
                                          Cmd.TS_AUTOPULL)
 
-    def push(self, tid: int, grad: np.ndarray, priority: int = 0) -> int:
-        """Async push of a gradient (ref: kvstore_dist.h:460-528)."""
+    def push(self, tid: int, grad: np.ndarray, priority: int = 0,
+             num_merge: int = 1, _count_round: bool = True) -> int:
+        """Async push of a gradient (ref: kvstore_dist.h:460-528).
+
+        ``num_merge > 1`` marks a pre-merged gradient carrying that many
+        workers' contributions (TS push-direction: the elected holder
+        pushes once for everyone, ref: num_merge counting van.cc:1197-1252).
+        """
         flat = np.asarray(grad).astype(np.float32).ravel()
+        fields = {"body": {"num_merge": int(num_merge)}} if num_merge > 1 else {}
         ts = self.worker.zpush(self._encode(tid, flat, priority),
-                               cmd=Cmd.DEFAULT, priority=priority)
+                               cmd=Cmd.DEFAULT, priority=priority, **fields)
         with self._mu:
             self._last_push_ts[tid] = ts
-            if self.ts_client is not None:
+            if self.ts_client is not None and _count_round:
                 self._push_rounds[tid] = self._push_rounds.get(tid, 0) + 1
         self._track(ts)
         return ts
+
+    def ts_merge_push(self, grads: Dict[int, np.ndarray]) -> bool:
+        """Push one round's gradients through the TS merge overlay: join
+        the scheduler-paired worker-to-worker merge tree; the elected
+        holder pushes the fully-merged set to the server once (counted as
+        num_workers contributions).  Returns True if this worker was the
+        elected pusher.  Blocks until this worker's overlay role is done."""
+        assert self.ts_push is not None, "requires enable_intra_ts"
+        merged = self.ts_push.merge_push(
+            {t: np.asarray(g, np.float32).ravel() for t, g in grads.items()})
+        with self._mu:
+            for tid in grads:
+                self._push_rounds[tid] = self._push_rounds.get(tid, 0) + 1
+        if merged is None:
+            return False
+        for tid, g in merged.items():
+            self.push(tid, g.reshape(self._shapes[tid]),
+                      num_merge=self.num_workers, _count_round=False)
+        return True
 
     def pull(self, tid: int, cb: Callable[[int, np.ndarray], None],
              priority: int = 0) -> int:

@@ -191,6 +191,11 @@ class LocalServer:
 
     def _handle_push(self, msg: Message, kvs: KVPairs):
         completed: List[int] = []
+        # a TS-merged push carries several workers' contributions at once
+        # (ref: num_merge counting van.cc:1197-1252)
+        num_merge = 1
+        if isinstance(msg.body, dict):
+            num_merge = int(msg.body.get("num_merge", 1))
         with self._mu:
             for k, v in kvs.slices():
                 st = self._keys.setdefault(k, _KeyState())
@@ -198,7 +203,7 @@ class LocalServer:
                     st.accum = v.astype(np.float32, copy=True)
                 else:
                     st.accum += v
-                st.count += 1
+                st.count += num_merge
                 st.in_flight = True
                 if st.count >= self.num_workers:
                     completed.append(k)

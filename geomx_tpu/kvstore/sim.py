@@ -31,14 +31,18 @@ class Simulation:
             self.offices[str(n)] = po
         self.ts_schedulers = []
         if config.enable_intra_ts:
+            from geomx_tpu.sched.ts_push import TsPushScheduler
             from geomx_tpu.sched.tsengine import TsScheduler
 
             for p in range(self.topology.num_parties):
+                sched_po = self.offices[str(self.topology.scheduler(p))]
                 self.ts_schedulers.append(TsScheduler(
-                    self.offices[str(self.topology.scheduler(p))],
+                    sched_po,
                     members=self.topology.workers(p),
                     greed_rate=config.ts_max_greed_rate,
                 ))
+                TsPushScheduler(sched_po,
+                                num_workers=self.topology.workers_per_party)
         if config.enable_inter_ts:
             from geomx_tpu.sched.tsengine import TsScheduler
 

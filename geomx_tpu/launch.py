@@ -64,10 +64,12 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
 
         role_obj = GlobalServer(po, config)
     elif node.role is Role.SCHEDULER and config.enable_intra_ts:
+        from geomx_tpu.sched.ts_push import TsPushScheduler
         from geomx_tpu.sched.tsengine import TsScheduler
 
         role_obj = TsScheduler(po, config.topology.workers(node.party),
                                greed_rate=config.ts_max_greed_rate)
+        TsPushScheduler(po, num_workers=config.topology.workers_per_party)
     elif node.role is Role.GLOBAL_SCHEDULER and config.enable_inter_ts:
         from geomx_tpu.sched.tsengine import TsScheduler
 
@@ -147,7 +149,7 @@ def main(argv=None):
     ap.add_argument("--tsengine", action="store_true")
     ap.add_argument("--tsengine-inter", action="store_true")
     ap.add_argument("--sync", default="fsa", choices=["fsa", "mixed"])
-    ap.add_argument("--dgt", type=int, default=0, choices=[0, 1, 2])
+    ap.add_argument("--dgt", type=int, default=0, choices=[0, 1, 2, 3])
     args = ap.parse_args(argv)
     if not args.role:
         ap.error("--role or GEOMX_ROLE required")

@@ -110,7 +110,15 @@ def run_worker(
             break
         loss, acc, grads = grad_fn(params, x, y)
         g_leaves, _ = jax.tree_util.tree_flatten(grads)
-        if kv.config.enable_p3:
+        if kv.ts_push is not None:
+            # TS push direction: worker-to-worker merge tree; the elected
+            # holder pushes the merged set once for the whole party
+            kv.ts_merge_push({tid: np.asarray(g) * scale
+                              for tid, g in enumerate(g_leaves)})
+            for tid in range(len(leaves)):
+                kv.pull(tid, lambda t, arr: buf.__setitem__(t, arr),
+                        priority=-tid)
+        elif kv.config.enable_p3:
             # P3: sliced combined push+pull, values ride the push response
             for tid, g in enumerate(g_leaves):
                 kv.push_pull(tid, np.asarray(g) * scale,

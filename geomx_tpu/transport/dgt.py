@@ -14,9 +14,11 @@ Transport mapping: the reference uses raw UDP sockets with DSCP marks;
 in-proc the lossy channels are fabric channels with a configurable drop
 rate, and on real DCN they map to secondary QUIC/UDP streams.  Modes
 (ref: ENABLE_DGT∈{1,2,3}, van.cc:750-824): 1 = lossy channels; 2 = all
-chunks reliable (chunking + prioritization only).  Mode 3's 4-bit
-re-quantization of unimportant chunks is not yet implemented — configure
-compression=fp16/bsc for bandwidth instead.
+chunks reliable (chunking + prioritization only); 3 = all reliable but
+unimportant chunks re-quantized to 4-bit (per-chunk min/max scale, two
+nibbles per byte — the reference's encode/decode 4-bit path,
+van.cc:750-824), trading precision of the low-contribution mass for
+8x less wire on it.
 
 Sparse payloads (bsc) are never chunked — dropping a chunk of a
 [values ‖ indices] payload would corrupt it; DGT applies to dense and
@@ -33,6 +35,27 @@ import numpy as np
 
 from geomx_tpu.core.config import Config
 from geomx_tpu.transport.message import Message
+
+
+def quant4(vals: np.ndarray):
+    """4-bit linear quantization: returns (packed uint8 [(n+1)//2],
+    lo, hi).  Two nibbles per byte, low nibble first."""
+    v = vals.astype(np.float32)
+    lo = float(v.min())
+    hi = float(v.max())
+    scale = (hi - lo) or 1.0
+    q = np.clip(np.round((v - lo) / scale * 15.0), 0, 15).astype(np.uint8)
+    if len(q) % 2:
+        q = np.append(q, np.uint8(0))
+    return (q[0::2] | (q[1::2] << 4)).astype(np.uint8), lo, hi
+
+
+def dequant4(packed: np.ndarray, n: int, lo: float, hi: float) -> np.ndarray:
+    q = np.empty(len(packed) * 2, dtype=np.uint8)
+    q[0::2] = packed & 15
+    q[1::2] = packed >> 4
+    return (q[:n].astype(np.float32) / 15.0 * ((hi - lo) or 1.0)
+            + lo).astype(np.float32)
 
 
 class DgtSender:
@@ -91,9 +114,18 @@ class DgtSender:
             else:
                 channel_of[int(c)] = 1 + (rank - k_cnt) % self.channels
 
+        rank_of = {int(c): r for r, c in enumerate(order)}
         out = []
         for c in range(nchunks):
             blk = vals[c * bs:(c + 1) * bs]
+            # mode 3: requantize unimportant (non-final) chunks to 4-bit
+            chunk_body = None
+            if (self.mode == 3 and rank_of[c] >= k_cnt
+                    and c != nchunks - 1
+                    and vals.dtype in (np.float32, np.float16)):
+                packed, lo, hi = quant4(blk)
+                chunk_body = {"_dgt4": {"n": len(blk), "lo": lo, "hi": hi}}
+                blk = packed
             chunk = Message(
                 sender=msg.sender, recipient=msg.recipient, domain=msg.domain,
                 app_id=msg.app_id, customer_id=msg.customer_id,
@@ -105,6 +137,8 @@ class DgtSender:
                 total_bytes=n,            # total elements of the payload
                 val_bytes=c * bs,         # element offset of this chunk
             )
+            if chunk_body is not None:
+                chunk.body = chunk_body
             if c == nchunks - 1:
                 # meta rides the completion chunk, always reliable; it also
                 # lists the reliable seqs so the receiver can wait for any
@@ -176,7 +210,14 @@ class DgtReassembler:
         vals = np.zeros(total, dtype=final.vals.dtype)
         for s, chunk in have.items():
             off = chunk.val_bytes
-            vals[off:off + len(chunk.vals)] = chunk.vals
+            meta4 = (chunk.body or {}).get("_dgt4") if isinstance(
+                chunk.body, dict) else None
+            if meta4 is not None:
+                dec = dequant4(chunk.vals, meta4["n"], meta4["lo"],
+                               meta4["hi"])
+                vals[off:off + len(dec)] = dec
+            else:
+                vals[off:off + len(chunk.vals)] = chunk.vals
         out = Message(
             sender=final.sender, recipient=final.recipient,
             domain=final.domain, app_id=final.app_id,
