@@ -163,8 +163,7 @@ class WorkerKVStore:
         num_workers contributions).  Returns True if this worker was the
         elected pusher.  Blocks until this worker's overlay role is done."""
         assert self.ts_push is not None, "requires enable_intra_ts"
-        merged = self.ts_push.merge_push(
-            {t: np.asarray(g, np.float32).ravel() for t, g in grads.items()})
+        merged = self.ts_push.merge_push(grads)  # normalizes f32/flat itself
         with self._mu:
             for tid in grads:
                 self._push_rounds[tid] = self._push_rounds.get(tid, 0) + 1

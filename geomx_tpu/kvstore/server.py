@@ -845,6 +845,27 @@ class GlobalServer:
         else:
             self.pull_comp = None
 
+    def load_checkpoint(self, path: str):
+        """Restore weights + optimizer + config from a checkpoint file and
+        drain any pulls that parked while the state was missing.  Used by
+        the Ctrl.CHECKPOINT command and launcher crash-recovery
+        (GEOMX_CHECKPOINT_DIR)."""
+        from geomx_tpu.kvstore import checkpoint as ckpt
+
+        store, opt, meta = ckpt.load_server_state(path)
+        with self._mu:
+            self.store = {k: np.array(v) for k, v in store.items()}
+            for k in self.store:
+                self._keys.setdefault(k, _GlobalKeyState())
+            self.optimizer = opt["optimizer"]
+            # resume under the checkpointed config, not whatever this
+            # fresh process happened to default to
+            self.sync_mode = meta.get("sync_mode", self.sync_mode)
+            self._apply_compression_locked(
+                meta.get("compression", self.compression))
+            for k in list(self.store):
+                self._serve_parked_pulls_locked(k)
+
     # ---- control ------------------------------------------------------------
     def _on_cmd(self, msg: Message):
         body = msg.body or {}
@@ -911,19 +932,7 @@ class GlobalServer:
                         body["path"], store_snap,
                         {"optimizer": opt_snap}, meta)
                 elif body["action"] == "load":
-                    store, opt, meta = ckpt.load_server_state(body["path"])
-                    with self._mu:
-                        self.store = {k: np.array(v) for k, v in store.items()}
-                        for k in self.store:
-                            self._keys.setdefault(k, _GlobalKeyState())
-                        self.optimizer = opt["optimizer"]
-                        # resume under the checkpointed config, not
-                        # whatever this fresh process happened to default to
-                        self.sync_mode = meta.get("sync_mode", self.sync_mode)
-                        self._apply_compression_locked(
-                            meta.get("compression", self.compression))
-                        for k in list(self.store):
-                            self._serve_parked_pulls_locked(k)
+                    self.load_checkpoint(body["path"])
                 self.server.reply_cmd(msg, body={"ok": True})
             except Exception as e:  # surface failures to the caller
                 self.server.reply_cmd(msg, body={"error": repr(e)})

@@ -63,6 +63,16 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
         from geomx_tpu.kvstore.server import GlobalServer
 
         role_obj = GlobalServer(po, config)
+        # crash recovery: a restarted global server resumes from its last
+        # checkpoint (weights + optimizer + config); load_checkpoint also
+        # drains pulls that parked during the restart window
+        ckpt_dir = os.environ.get("GEOMX_CHECKPOINT_DIR")
+        if ckpt_dir:
+            path = f"{ckpt_dir}/global_server_{node.rank}.npz"
+            if os.path.exists(path):
+                role_obj.load_checkpoint(path)
+                print(f"{node}: resumed from {path} "
+                      f"({len(role_obj.store)} keys)", flush=True)
     elif node.role is Role.SCHEDULER and config.enable_intra_ts:
         from geomx_tpu.sched.ts_push import TsPushScheduler
         from geomx_tpu.sched.tsengine import TsScheduler

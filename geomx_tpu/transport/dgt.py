@@ -120,9 +120,9 @@ class DgtSender:
             blk = vals[c * bs:(c + 1) * bs]
             # mode 3: requantize unimportant (non-final) chunks to 4-bit
             chunk_body = None
+            # (dtype already constrained to f32/f16 by the entry assert)
             if (self.mode == 3 and rank_of[c] >= k_cnt
-                    and c != nchunks - 1
-                    and vals.dtype in (np.float32, np.float16)):
+                    and c != nchunks - 1):
                 packed, lo, hi = quant4(blk)
                 chunk_body = {"_dgt4": {"n": len(blk), "lo": lo, "hi": hi}}
                 blk = packed
