@@ -30,7 +30,7 @@ import numpy as np
 from geomx_tpu.core.config import Config, Topology
 from geomx_tpu.data import ShardedIterator, synthetic_classification
 from geomx_tpu.kvstore import Simulation
-from geomx_tpu.models import create_cnn_state
+from geomx_tpu.models import create_cnn_state, create_resnet_state
 from geomx_tpu.training import run_worker, run_worker_hfa
 
 
@@ -44,6 +44,7 @@ def main():
     ap.add_argument("--lr", type=float, default=0.01)
     ap.add_argument("--optimizer", default="adam",
                     choices=["sgd", "adam", "dcasgd"])
+    ap.add_argument("--model", default="cnn", choices=["cnn", "resnet"])
     ap.add_argument("--sync", default="fsa", choices=["fsa", "mixed"],
                     help="fsa = both tiers sync; mixed = async global tier")
     ap.add_argument("--compression", default="none",
@@ -86,7 +87,11 @@ def main():
     x, y = synthetic_classification(n=4096, seed=args.seed)
     num_all = cfg.topology.num_workers_total
 
-    _, params, grad_fn = create_cnn_state(jax.random.PRNGKey(args.seed))
+    if args.model == "resnet":
+        _, params, grad_fn = create_resnet_state(
+            jax.random.PRNGKey(args.seed), input_shape=(1, 28, 28, 1))
+    else:
+        _, params, grad_fn = create_cnn_state(jax.random.PRNGKey(args.seed))
 
     histories = {}
     lock = threading.Lock()

@@ -1,0 +1,11 @@
+#!/usr/bin/env python
+"""Reference example-file parity: cnn_hfa.py == cnn.py --hfa
+(ref: examples/cnn_hfa.py in the reference)."""
+import sys
+sys.argv[1:1] = "--hfa".split()
+from pathlib import Path
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from cnn import main
+
+if __name__ == "__main__":
+    sys.exit(main())

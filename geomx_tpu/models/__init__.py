@@ -1,1 +1,2 @@
 from geomx_tpu.models.cnn import CNN, create_cnn_state  # noqa: F401
+from geomx_tpu.models.resnet import ResNet, create_resnet_state  # noqa: F401

@@ -50,19 +50,8 @@ def create_cnn_state(
     Returns (model, params, grad_fn) where
     ``grad_fn(params, x, y) -> (loss, grads)`` is jit-compiled.
     """
+    from geomx_tpu.models.common import make_grad_fn
+
     model = CNN(num_classes=num_classes, compute_dtype=compute_dtype)
     params = model.init(rng, jnp.zeros(input_shape, jnp.float32))
-
-    def loss_fn(params, x, y):
-        logits = model.apply(params, x)
-        logp = jax.nn.log_softmax(logits)
-        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
-        acc = jnp.mean(jnp.argmax(logits, -1) == y)
-        return loss, acc
-
-    @jax.jit
-    def grad_fn(params, x, y):
-        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, x, y)
-        return loss, acc, grads
-
-    return model, params, grad_fn
+    return model, params, make_grad_fn(model)

@@ -1,0 +1,28 @@
+"""Shared model-factory plumbing: every family returns the same
+(model, params, grad_fn) contract so training loops, examples, and the
+kvstore integration swap models freely."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_grad_fn(model):
+    """Jitted ``grad_fn(params, x, y) -> (loss, acc, grads)`` with
+    log-softmax NLL + accuracy — the one loss definition all families use."""
+
+    def loss_fn(params, x, y):
+        logits = model.apply(params, x)
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+        acc = jnp.mean(jnp.argmax(logits, -1) == y)
+        return loss, acc
+
+    @jax.jit
+    def grad_fn(params, x, y):
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, x, y)
+        return loss, acc, grads
+
+    return grad_fn

@@ -1,0 +1,84 @@
+"""Small ResNet for CIFAR-shape inputs — widens the model zoo beyond the
+reference's demo CNN (ref: examples/cnn.py is the only model family in
+the reference; SURVEY.md §6 uses CIFAR-10 as the north-star workload).
+
+bf16 activations / f32 params like the CNN; plain flax, XLA-friendly
+static shapes throughout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _gn(features: int, dtype):
+    """GroupNorm with groups derived from the channel count — hard-coding
+    8 crashes opaquely for widths not divisible by 8."""
+    return nn.GroupNorm(num_groups=math.gcd(8, features), dtype=dtype)
+
+
+class ResBlock(nn.Module):
+    features: int
+    stride: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.features, (3, 3), strides=(self.stride, self.stride),
+                    use_bias=False, dtype=self.dtype)(x)
+        h = _gn(self.features, self.dtype)(h)
+        h = nn.relu(h)
+        h = nn.Conv(self.features, (3, 3), use_bias=False, dtype=self.dtype)(h)
+        h = _gn(self.features, self.dtype)(h)
+        if x.shape[-1] != self.features or self.stride != 1:
+            x = nn.Conv(self.features, (1, 1),
+                        strides=(self.stride, self.stride),
+                        use_bias=False, dtype=self.dtype)(x)
+        return nn.relu(h + x)
+
+
+class ResNet(nn.Module):
+    """ResNet-8/14-style: one conv stem + N stages of residual blocks."""
+
+    num_classes: int = 10
+    stage_sizes: Sequence[int] = (1, 1, 1)
+    width: int = 32
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.compute_dtype
+        x = x.astype(dt)
+        x = nn.Conv(self.width, (3, 3), use_bias=False, dtype=dt)(x)
+        x = nn.relu(_gn(self.width, dt)(x))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            feats = self.width * (2 ** i)
+            for b in range(n_blocks):
+                stride = 2 if (b == 0 and i > 0) else 1
+                x = ResBlock(feats, stride=stride, dtype=dt)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=dt)(x)
+        return x.astype(jnp.float32)
+
+
+def create_resnet_state(
+    rng: jax.Array,
+    input_shape: Tuple[int, ...] = (1, 32, 32, 3),
+    num_classes: int = 10,
+    stage_sizes: Sequence[int] = (1, 1, 1),
+    width: int = 32,
+):
+    """Init params + a jitted (loss, acc, grads) fn — same contract as
+    create_cnn_state so training loops and examples swap models freely."""
+    from geomx_tpu.models.common import make_grad_fn
+
+    model = ResNet(num_classes=num_classes, stage_sizes=tuple(stage_sizes),
+                   width=width)
+    params = model.init(rng, jnp.zeros(input_shape, jnp.float32))
+    return model, params, make_grad_fn(model)
