@@ -1,0 +1,14 @@
+"""Shared mechanism for the reference-parity example wrappers: each
+cnn_<feature>.py preset-injects its flags and delegates to cnn.main
+(user-supplied flags still win — argparse takes the last occurrence)."""
+
+import sys
+from pathlib import Path
+
+
+def run(flags: str) -> int:
+    sys.argv[1:1] = flags.split()
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from cnn import main
+
+    return main()

@@ -2,10 +2,10 @@
 """Reference example-file parity: cnn_p3.py == cnn.py --p3
 (ref: examples/cnn_p3.py in the reference)."""
 import sys
-sys.argv[1:1] = "--p3".split()
 from pathlib import Path
+
 sys.path.insert(0, str(Path(__file__).resolve().parent))
-from cnn import main
+from _wrapper import run
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run("--p3"))

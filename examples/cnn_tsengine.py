@@ -2,10 +2,10 @@
 """Reference example-file parity: cnn_tsengine.py == cnn.py --tsengine --tsengine-inter
 (ref: examples/cnn_tsengine.py in the reference)."""
 import sys
-sys.argv[1:1] = "--tsengine --tsengine-inter".split()
 from pathlib import Path
+
 sys.path.insert(0, str(Path(__file__).resolve().parent))
-from cnn import main
+from _wrapper import run
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run("--tsengine --tsengine-inter"))

@@ -45,10 +45,10 @@ def create_cnn_state(
     num_classes: int = 10,
     compute_dtype: Any = jnp.bfloat16,
 ):
-    """Init params + a jitted (loss, grads) function.
+    """Init params + a jitted grad function.
 
     Returns (model, params, grad_fn) where
-    ``grad_fn(params, x, y) -> (loss, grads)`` is jit-compiled.
+    ``grad_fn(params, x, y) -> (loss, acc, grads)`` is jit-compiled.
     """
     from geomx_tpu.models.common import make_grad_fn
 

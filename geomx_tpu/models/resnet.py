@@ -73,12 +73,13 @@ def create_resnet_state(
     num_classes: int = 10,
     stage_sizes: Sequence[int] = (1, 1, 1),
     width: int = 32,
+    compute_dtype: Any = jnp.bfloat16,
 ):
     """Init params + a jitted (loss, acc, grads) fn — same contract as
     create_cnn_state so training loops and examples swap models freely."""
     from geomx_tpu.models.common import make_grad_fn
 
     model = ResNet(num_classes=num_classes, stage_sizes=tuple(stage_sizes),
-                   width=width)
+                   width=width, compute_dtype=compute_dtype)
     params = model.init(rng, jnp.zeros(input_shape, jnp.float32))
     return model, params, make_grad_fn(model)

@@ -1,0 +1,67 @@
+"""Robustness under combined faults: loss + latency + resend, full
+training flow (the reference's PS_DROP_MSG + PS_RESEND acceptance style,
+ref: SURVEY.md §4 fault injection)."""
+
+import numpy as np
+import pytest
+
+from geomx_tpu.core.config import Config, Topology
+from geomx_tpu.kvstore import Simulation
+from geomx_tpu.transport.van import FaultPolicy
+
+
+@pytest.mark.slow
+def test_training_survives_lossy_latent_network():
+    """20% drop on every link + 2ms LAN / 10ms WAN latency + resend:
+    training must complete with exact FSA semantics."""
+    cfg = Config(
+        topology=Topology(num_parties=2, workers_per_party=2),
+        resend_timeout_ms=50,
+    )
+    fault = FaultPolicy(drop_rate=0.2, latency_s=0.002, wan_latency_s=0.01,
+                        seed=13)
+    sim = Simulation(cfg, fault=fault)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(512, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        for step in range(5):
+            for w in ws:
+                w.push(0, np.ones(512, np.float32))
+            outs = [w.pull_sync(0) for w in ws]
+        # party sum 2, global mean 2 → -0.2/step × 5
+        for out in outs:
+            np.testing.assert_allclose(out, -1.0, rtol=1e-5)
+        assert sim.fabric.dropped > 0  # the network really was lossy
+    finally:
+        sim.shutdown()
+
+
+@pytest.mark.slow
+def test_compressed_training_survives_loss():
+    """BSC compression + drops + resend still converges identically on
+    both replicas (codec state must not desync under retransmits)."""
+    cfg = Config(
+        topology=Topology(num_parties=2, workers_per_party=1),
+        resend_timeout_ms=50,
+    )
+    sim = Simulation(cfg, fault=FaultPolicy(drop_rate=0.15, seed=7))
+    try:
+        ws = sim.all_workers()
+        for p in range(2):
+            sim.worker(p, 0).set_gradient_compression(
+                {"type": "bsc", "ratio": 0.1})
+        for w in ws:
+            w.init(0, np.zeros(2000, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        rng = np.random.default_rng(0)
+        for step in range(4):
+            g = np.abs(rng.standard_normal(2000)).astype(np.float32)
+            for w in ws:
+                w.push(0, g)
+            outs = [w.pull_sync(0) for w in ws]
+        np.testing.assert_allclose(outs[0], outs[1], atol=1e-5)
+        assert outs[0].mean() < -0.005
+    finally:
+        sim.shutdown()
