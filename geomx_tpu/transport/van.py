@@ -30,6 +30,11 @@ from typing import Callable, Dict, Optional
 from geomx_tpu.core.config import Config, NodeId
 from geomx_tpu.transport.message import Control, Domain, Message
 
+import logging as _logging_mod
+
+_WIRE_LOG = _logging_mod.getLogger("geomx.wire")
+_WIRE_LOG.propagate_checked = False  # one-time handler bootstrap flag
+
 
 class FaultPolicy:
     """Programmable message loss & latency.
@@ -280,6 +285,31 @@ class Van:
             self.send_bytes += n
             if msg.domain is Domain.GLOBAL:
                 self.wan_send_bytes += n
+        if self.config.verbose >= 2:
+            self._log_wire("SEND", msg, n)
+
+    def _log_wire(self, direction: str, msg: Message, nbytes: int):
+        """Wire-level message log (ref: PS_VERBOSE >= 2 prints every
+        message, van.cc:841-843,880-882).  Ensures the logger actually
+        emits: python's last-resort handler drops INFO, and asking for
+        verbose wire logs IS the opt-in."""
+        if not _WIRE_LOG.handlers and not _WIRE_LOG.propagate_checked:
+            _WIRE_LOG.propagate_checked = True
+            import logging as _logging
+
+            if not _logging.getLogger().handlers:
+                h = _logging.StreamHandler()
+                h.setFormatter(_logging.Formatter("%(message)s"))
+                _WIRE_LOG.addHandler(h)
+            _WIRE_LOG.setLevel(_logging.INFO)
+        _WIRE_LOG.info(
+            "%s %s %s->%s ctrl=%s %s%s%s cmd=%s ts=%s keys=%s %dB",
+            direction, msg.domain.name, msg.sender, msg.recipient,
+            msg.control.name, "REQ" if msg.request else "rsp",
+            " push" if msg.push else "", " pull" if msg.pull else "",
+            msg.cmd, msg.timestamp,
+            None if msg.keys is None else len(msg.keys), nbytes,
+        )
 
     def _send_loop(self):
         while self._running:
@@ -299,6 +329,8 @@ class Van:
                 self.recv_bytes += n
                 if msg.domain is Domain.GLOBAL:
                     self.wan_recv_bytes += n
+            if self.config.verbose >= 2:
+                self._log_wire("RECV", msg, n)
             if msg.control is Control.ACK:
                 self._pending_acks.pop(msg.msg_sig, None)
                 continue
