@@ -27,13 +27,14 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+import logging
+
 from geomx_tpu.core.config import Config, NodeId
 from geomx_tpu.transport.message import Control, Domain, Message
 
-import logging as _logging_mod
-
-_WIRE_LOG = _logging_mod.getLogger("geomx.wire")
-_WIRE_LOG.propagate_checked = False  # one-time handler bootstrap flag
+_WIRE_LOG = logging.getLogger("geomx.wire")
+_wire_bootstrap_lock = threading.Lock()
+_wire_bootstrapped = False
 
 
 class FaultPolicy:
@@ -154,8 +155,6 @@ class InProcFabric:
             except KeyError:
                 # an unregistered recipient must not kill the shared timer
                 # thread and stall every other delayed delivery
-                import logging
-
                 logging.getLogger(__name__).warning(
                     "dropping delayed message to unknown node %s", msg.recipient
                 )
@@ -273,8 +272,6 @@ class Van:
         try:
             self.fabric.deliver(msg)
         except (KeyError, OSError) as e:
-            import logging
-
             logging.getLogger(__name__).warning(
                 "%s: dropping message to %s (%s)", self.node, msg.recipient, e
             )
@@ -293,15 +290,19 @@ class Van:
         message, van.cc:841-843,880-882).  Ensures the logger actually
         emits: python's last-resort handler drops INFO, and asking for
         verbose wire logs IS the opt-in."""
-        if not _WIRE_LOG.handlers and not _WIRE_LOG.propagate_checked:
-            _WIRE_LOG.propagate_checked = True
-            import logging as _logging
-
-            if not _logging.getLogger().handlers:
-                h = _logging.StreamHandler()
-                h.setFormatter(_logging.Formatter("%(message)s"))
-                _WIRE_LOG.addHandler(h)
-            _WIRE_LOG.setLevel(_logging.INFO)
+        global _wire_bootstrapped
+        if not _wire_bootstrapped:
+            with _wire_bootstrap_lock:
+                if not _wire_bootstrapped:
+                    if not logging.getLogger().handlers:
+                        h = logging.StreamHandler()
+                        h.setFormatter(logging.Formatter("%(message)s"))
+                        _WIRE_LOG.addHandler(h)
+                        # a private handler must not double-emit once the
+                        # app later configures the root logger
+                        _WIRE_LOG.propagate = False
+                    _WIRE_LOG.setLevel(logging.INFO)
+                    _wire_bootstrapped = True
         _WIRE_LOG.info(
             "%s %s %s->%s ctrl=%s %s%s%s cmd=%s ts=%s keys=%s %dB",
             direction, msg.domain.name, msg.sender, msg.recipient,
@@ -370,8 +371,6 @@ class Van:
                 if now - last_send < self._resend_timeout * (1 + num_retry):
                     continue
                 if num_retry >= self._max_retries:
-                    import logging
-
                     logging.getLogger(__name__).warning(
                         "giving up on message sig=%s to %s after %d retries",
                         sig, msg.recipient, num_retry,
