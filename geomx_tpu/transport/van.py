@@ -294,7 +294,10 @@ class Van:
         if not _wire_bootstrapped:
             with _wire_bootstrap_lock:
                 if not _wire_bootstrapped:
-                    if not logging.getLogger().handlers:
+                    # respect handlers the application already attached to
+                    # geomx.wire or the root — only bootstrap into a void
+                    if (not _WIRE_LOG.handlers
+                            and not logging.getLogger().handlers):
                         h = logging.StreamHandler()
                         h.setFormatter(logging.Formatter("%(message)s"))
                         _WIRE_LOG.addHandler(h)
@@ -343,7 +346,9 @@ class Van:
                     domain=msg.domain, msg_sig=msg.msg_sig,
                 )
                 self._account_send(ack)
-                self.fabric.deliver(ack)
+                # guarded: an ACK to a vanished peer must not kill the
+                # receive thread
+                self._deliver_guarded(ack)
                 dedup_key = (str(msg.sender), msg.msg_sig)
                 if dedup_key in self._seen_sigs:
                     continue  # duplicate suppression (ref: resender.h:60-77)
