@@ -24,7 +24,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from geomx_tpu.core.platform import apply_platform_from_env
 from geomx_tpu.models import create_cnn_state
+
+apply_platform_from_env()
 
 # Provisional A100 reference for this tiny CNN at batch 1024: the workload
 # is input/launch-bound, so an A100 (312 bf16 TFLOPs) and a v5e chip land

@@ -68,6 +68,10 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
+    from geomx_tpu.core.platform import apply_platform_from_env
+
+    apply_platform_from_env()
+
     cfg = Config(
         topology=Topology(num_parties=args.parties,
                           workers_per_party=args.workers,

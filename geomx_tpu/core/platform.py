@@ -1,0 +1,22 @@
+"""Honor a JAX_PLATFORMS request made through the environment.
+
+Site customization in some deployments imports jax at interpreter start
+and pins a backend, which makes the JAX_PLATFORMS env var alone too late
+— jax's config snapshots it on first import.  Entry points (examples,
+launcher) call this before their first backend use to route the request
+through jax.config instead.  When the env var is unset this is a no-op
+and jax picks its default backend (on TPU hosts: the TPU).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_from_env() -> None:
+    want = os.environ.get("JAX_PLATFORMS") or os.environ.get(
+        "JAX_PLATFORM_NAME")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)

@@ -164,6 +164,10 @@ def main(argv=None):
     if not args.role:
         ap.error("--role or GEOMX_ROLE required")
 
+    from geomx_tpu.core.platform import apply_platform_from_env
+
+    apply_platform_from_env()
+
     node = NodeId.parse(args.role)
     # env supplies the full documented knob surface (drop injection,
     # resend, heartbeats, tuning — docs/env-vars.md); CLI flags override

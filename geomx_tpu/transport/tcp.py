@@ -16,12 +16,14 @@ by this static plan; elastic join/recovery rides the heartbeat layer.
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import queue
 import socket
 import struct
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from geomx_tpu.core.config import Config, NodeId, Topology
@@ -68,6 +70,9 @@ class TcpFabric:
         # false dead-node detection)
         self._conn_mus: Dict[str, threading.Lock] = {}
         self._registry_mu = threading.Lock()
+        self._accepted: list = []
+        self._established: set = set()
+        self._dial_window: Dict[str, float] = {}
         self._stop = False
         self.dropped = 0
 
@@ -77,12 +82,30 @@ class TcpFabric:
         if s in self._boxes:
             return self._boxes[s]
         box = _Mailbox()
-        self._boxes[s] = box
         host, port = self.plan[s]
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        srv.bind(("0.0.0.0", port))
-        srv.listen(64)
+        # a restarted role re-binds its fixed port; sockets lingering from
+        # the previous incarnation can hold it for a moment — retry, but
+        # only on EADDRINUSE (anything else is a real config error).
+        # NOTE: deliberately no SO_REUSEPORT — it would let two live
+        # incarnations share the port and silently split inbound traffic.
+        deadline = time.monotonic() + 5.0
+        try:
+            while True:
+                try:
+                    srv.bind(("0.0.0.0", port))
+                    break
+                except OSError as e:
+                    if (e.errno != errno.EADDRINUSE
+                            or time.monotonic() >= deadline):
+                        raise
+                    time.sleep(0.1)
+            srv.listen(64)
+        except OSError:
+            srv.close()  # a retried register() must not find a dead box
+            raise
+        self._boxes[s] = box
         self._listeners.append(srv)
         threading.Thread(target=self._accept_loop, args=(srv, box),
                          name=f"tcp-accept-{s}", daemon=True).start()
@@ -98,6 +121,8 @@ class TcpFabric:
                              daemon=True).start()
 
     def _recv_loop(self, conn: socket.socket, box: _Mailbox):
+        with self._registry_mu:
+            self._accepted.append(conn)
         try:
             while not self._stop:
                 hdr = self._recv_exact(conn, 8)
@@ -108,8 +133,18 @@ class TcpFabric:
                 if data is None:
                     return
                 box.q.put(Message.from_bytes(data))
+        except OSError:
+            return  # connection torn down (peer reset or fabric shutdown)
         finally:
-            conn.close()
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._registry_mu:
+                try:
+                    self._accepted.remove(conn)
+                except ValueError:
+                    pass
 
     @staticmethod
     def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
@@ -144,30 +179,81 @@ class TcpFabric:
             try:
                 conn.sendall(frame)
             except OSError:
-                # peer restarted: redial once
+                # peer restarted: redial once; drop the dead socket from
+                # the registry first so a failed redial doesn't leave it
+                # there for every later send to trip over
                 conn.close()
+                self._conns.pop(dest, None)
                 conn = self._dial(dest)
                 conn.sendall(frame)
         return True
 
-    def _dial(self, dest: str) -> socket.socket:
+    # connect errors worth waiting out during bring-up; anything else
+    # (DNS failure, ENETUNREACH, …) is a config error and raises at once
+    _TRANSIENT_ERRNOS = frozenset({errno.ECONNREFUSED, errno.ECONNRESET,
+                                   errno.ECONNABORTED, errno.ETIMEDOUT})
+
+    def _dial(self, dest: str, retry_for: float = 30.0) -> socket.socket:
+        """Connect to a peer, retrying while its listener comes up.
+
+        Roles start as independent processes in arbitrary order (the
+        reference's ZMQ sockets reconnect transparently); a connection
+        refused during the bring-up window must retry, not drop — a lost
+        control command (e.g. set_optimizer) would hang the caller.
+
+        The retry window opens at the FIRST dial attempt to a peer and is
+        never re-armed: once the peer has been reached — or the window
+        has expired without contact — later dial failures fail fast, so
+        the (serial) heartbeat and resend loops are not head-of-line
+        blocked behind a dead destination.  Redelivery to a restarted
+        peer is the resend layer's job."""
         host, port = self.plan[dest]
-        conn = socket.create_connection((host, port), timeout=30)
+        with self._registry_mu:
+            if dest in self._established:
+                deadline = 0.0
+            else:
+                opened = self._dial_window.setdefault(dest, time.monotonic())
+                deadline = opened + retry_for
+        while True:
+            try:
+                conn = socket.create_connection((host, port), timeout=5)
+                break
+            except OSError as e:
+                # connect timeouts surface as TimeoutError with errno None
+                transient = (isinstance(e, TimeoutError)
+                             or e.errno in self._TRANSIENT_ERRNOS)
+                if (self._stop or not transient
+                        or time.monotonic() >= deadline):
+                    raise
+                time.sleep(0.1)
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._conns[dest] = conn
+        with self._registry_mu:
+            if self._stop:  # lost the race against shutdown()
+                conn.close()
+                raise OSError(errno.ESHUTDOWN, "fabric shut down")
+            self._conns[dest] = conn
+            self._established.add(dest)
         return conn
 
     def shutdown(self):
         self._stop = True
         for srv in self._listeners:
+            # close() alone does not release a listener whose accept() is
+            # blocked in another thread — the kernel keeps the socket (and
+            # the port) alive until accept returns; shutdown() wakes it
+            try:
+                srv.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 srv.close()
             except OSError:
                 pass
         with self._registry_mu:
-            for c in self._conns.values():
+            for c in list(self._conns.values()) + self._accepted:
                 try:
                     c.close()
                 except OSError:
                     pass
             self._conns.clear()
+            self._accepted.clear()
