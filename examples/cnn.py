@@ -65,6 +65,11 @@ def main():
                     help="local steps between party syncs")
     ap.add_argument("--hfa-k2", type=int, default=2,
                     help="party syncs between WAN syncs")
+    ap.add_argument("--record", default=None, metavar="PATH",
+                    help="train from a record-IO dataset file instead of "
+                         "in-memory synthetic data (written on first use); "
+                         "exercises the IO subsystem: record reader + "
+                         "augmentation + threaded prefetch")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -89,6 +94,14 @@ def main():
     )
     sim = Simulation(cfg)
     x, y = synthetic_classification(n=4096, seed=args.seed)
+    if args.record:
+        from pathlib import Path as _P
+
+        from geomx_tpu.data import write_array_dataset
+
+        if not _P(args.record).exists():
+            write_array_dataset(args.record, x, y)
+            print(f"wrote record dataset: {args.record}", flush=True)
     num_all = cfg.topology.num_workers_total
 
     if args.model == "resnet":
@@ -111,7 +124,18 @@ def main():
                 kv.set_gradient_compression(
                     {"type": args.compression, "ratio": args.bsc_ratio})
         kv.barrier()
-        it = ShardedIterator(x, y, args.batch, widx, num_all, seed=args.seed)
+        prefetch = None
+        if args.record:
+            from geomx_tpu.data import (AugmentIter, PrefetchIter,
+                                        RecordDatasetIter)
+
+            it = prefetch = PrefetchIter(AugmentIter(
+                RecordDatasetIter(args.record, args.batch, widx, num_all,
+                                  seed=args.seed),
+                flip=True, seed=args.seed + widx))
+        else:
+            it = ShardedIterator(x, y, args.batch, widx, num_all,
+                                 seed=args.seed)
         t0 = time.time()
 
         def log(step, loss, acc):
@@ -124,6 +148,8 @@ def main():
                                   k1=args.hfa_k1, log_fn=log)
         else:
             hist = run_worker(kv, params, grad_fn, it, args.steps, log_fn=log)
+        if prefetch is not None:
+            prefetch.close()
         with lock:
             histories[(party, rank)] = hist
 

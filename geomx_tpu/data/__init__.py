@@ -1,1 +1,9 @@
 from geomx_tpu.data.synthetic import synthetic_classification, ShardedIterator  # noqa: F401
+from geomx_tpu.data.recordio import (  # noqa: F401
+    RecordReader, RecordWriter, pack_array, unpack_array,
+    write_array_dataset,
+)
+from geomx_tpu.data.iterators import (  # noqa: F401
+    AugmentIter, CSVIter, LibSVMIter, MNISTIter, PrefetchIter,
+    RecordDatasetIter,
+)

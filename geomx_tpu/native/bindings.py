@@ -41,13 +41,26 @@ def _build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    """True when any source is newer than the built library (a rebuilt
+    tree with an old .so would otherwise miss newly added symbols)."""
+    try:
+        so_mtime = os.path.getmtime(_SO)
+    except OSError:
+        return True
+    for f in os.listdir(_DIR):
+        if f.endswith(".cc") and os.path.getmtime(os.path.join(_DIR, f)) > so_mtime:
+            return True
+    return False
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     with _lock:
         if _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO) and not _build():
+        if _stale() and not _build() and not os.path.exists(_SO):
             return None
         try:
             lib = ctypes.CDLL(_SO)
@@ -61,6 +74,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.geo_select_threshold.argtypes = [_f32p, _i64, _f32, _i64, _i64p]
         lib.geo_select_threshold.restype = _i64
         lib.geo_sparse_add.argtypes = [_f32p, _f32p, _i64p, _i64]
+        # newer symbols may be absent from a stale .so we couldn't rebuild
+        # (no toolchain); callers probe with hasattr so the codec symbols
+        # above keep accelerating either way
+        if hasattr(lib, "geo_recordio_index"):
+            lib.geo_recordio_index.argtypes = [_u8p, _i64, _i64, _i64p, _i64p]
+            lib.geo_recordio_index.restype = _i64
         _lib = lib
         return _lib
 
