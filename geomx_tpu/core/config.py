@@ -258,6 +258,17 @@ class Config:
     drop_rate: float = 0.0
     resend_timeout_ms: int = 0    # 0 = resender off
 
+    # --- elastic recovery (improvement over the reference, whose recovery
+    # is scheduler id-reassignment only, ref: van.cc:176-193; global-tier
+    # recovery is a TODO there, van.cc:224)
+    request_retry_s: float = 0.0  # 0 = off; else re-send unanswered
+    #                               requests after this many seconds
+    #                               (application-level replay; servers
+    #                               dedup by (sender, ts))
+    checkpoint_dir: str = ""      # where global servers save/resume state
+    auto_ckpt_updates: int = 0    # 0 = off; else checkpoint every N
+    #                               optimizer updates (key-rounds)
+
     # --- misc runtime
     heartbeat_interval_s: float = 0.0   # 0 = off
     heartbeat_timeout_s: float = 10.0
@@ -337,6 +348,9 @@ class Config:
                 "GEOMX_RESEND_TIMEOUT_MS",
                 _env_int("PS_RESEND_TIMEOUT", 1000) if _env_bool("PS_RESEND") else 0,
             ),
+            request_retry_s=_env_float("GEOMX_REQUEST_RETRY_S", 0.0),
+            checkpoint_dir=os.environ.get("GEOMX_CHECKPOINT_DIR", ""),
+            auto_ckpt_updates=_env_int("GEOMX_AUTO_CKPT_UPDATES", 0),
             heartbeat_interval_s=_env_float(
                 "GEOMX_HEARTBEAT_INTERVAL", _env_float("PS_HEARTBEAT_INTERVAL", 0.0)
             ),

@@ -7,9 +7,67 @@ kvstore.cc:53-63).  We keep data commands and control heads as two small
 enums; dtype travels with the numpy array itself.
 """
 
+import collections
 import enum
+import threading
 
 APP_PS = 0  # the parameter-server app id
+
+
+class RecentRequests:
+    """Bounded replay-dedup window for push requests.
+
+    Application-level request replay (Config.request_retry_s) can deliver
+    the same push twice — once the original, once the retry.  Servers
+    consult this window keyed by (sender, app, customer, timestamp):
+
+    - ``check`` returns "new" (first sighting — process it), "pending"
+      (already accumulating — drop silently; the parked original will be
+      acked), or "done" (already processed+acked — the ACK was lost, so
+      re-ack without re-applying).
+    - ``mark_done`` flips a request to "done" when its response is sent;
+      an optional response body (e.g. an error) is remembered so a
+      re-ack carries the same body the lost original did.
+
+    The window is bounded; evicting the oldest entries is safe because
+    the retry backoff caps how late a replay can arrive.
+    """
+
+    _PENDING = object()
+
+    def __init__(self, cap: int = 8192):
+        self._seen: "collections.OrderedDict" = collections.OrderedDict()
+        self._cap = cap
+        self._mu = threading.Lock()
+
+    @staticmethod
+    def _key(msg):
+        return (str(msg.sender), msg.app_id, msg.customer_id, msg.timestamp)
+
+    def check(self, msg) -> str:
+        k = self._key(msg)
+        with self._mu:
+            if k in self._seen:
+                self._seen.move_to_end(k)
+                return ("pending" if self._seen[k] is self._PENDING
+                        else "done")
+            self._seen[k] = self._PENDING
+            while len(self._seen) > self._cap:
+                self._seen.popitem(last=False)
+        return "new"
+
+    def mark_done(self, msg, body=None) -> None:
+        k = self._key(msg)
+        with self._mu:
+            if k in self._seen:
+                self._seen[k] = body
+
+    def done_body(self, msg):
+        """The response body recorded at mark_done (None if none)."""
+        k = self._key(msg)
+        with self._mu:
+            v = self._seen.get(k)
+            return None if v is self._PENDING else v
 
 
 class Cmd(enum.IntEnum):

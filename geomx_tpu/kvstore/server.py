@@ -35,7 +35,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from geomx_tpu.core.config import Config, Group, NodeId, Topology
-from geomx_tpu.kvstore.common import APP_PS, Cmd, Ctrl
+from geomx_tpu.kvstore.common import APP_PS, Cmd, Ctrl, RecentRequests
 from geomx_tpu.optim import DCASGD, ServerOptimizer, Sgd, make_optimizer
 from geomx_tpu.ps import KVPairs, KVServer, KVWorker, Postoffice
 from geomx_tpu.ps.postoffice import split_range
@@ -99,6 +99,7 @@ class LocalServer:
         from geomx_tpu.utils import get_profiler
 
         self._prof = get_profiler(str(postoffice.node))
+        self._recent = RecentRequests()  # replayed-push dedup
         self.server = KVServer(APP_PS, 0, postoffice, self._handle)
         self.server.cmd_handler = self._on_cmd
         # the "global worker" half (ref: kvstore_dist_server.h uses the
@@ -190,6 +191,17 @@ class LocalServer:
             self.server.response(msg)
 
     def _handle_push(self, msg: Message, kvs: KVPairs):
+        state = self._recent.check(msg)
+        if state == "pending":
+            return  # replay of a push we're still aggregating
+        if state == "done":
+            # already applied; the ACK (or piggybacked values) was lost
+            if msg.pull:
+                with self._mu:
+                    self._try_serve_pull_locked(msg)
+            else:
+                self.server.response(msg, body=self._recent.done_body(msg))
+            return
         completed: List[int] = []
         # a TS-merged push carries several workers' contributions at once
         # (ref: num_merge counting van.cc:1197-1252)
@@ -221,6 +233,7 @@ class LocalServer:
                 if msg.pull:
                     self._try_serve_pull_locked(msg)
             if not msg.pull:
+                self._recent.mark_done(msg)
                 self.server.response(msg)
             self._push_up(KVPairs(kvs.keys, kvs.vals.astype(np.float32),
                                   kvs.lens))
@@ -234,6 +247,7 @@ class LocalServer:
                 self._keys[int(msg.keys[0])].parked_pulls.append(msg)
         else:
             # ack the push immediately — workers overlap next layers
+            self._recent.mark_done(msg)
             self.server.response(msg)
         if completed:
             self._round_complete(completed)
@@ -246,11 +260,18 @@ class LocalServer:
         — adopting a gradient sum as HFA weights would corrupt training."""
         from geomx_tpu.compression.codecs import unpack_rows
 
+        state = self._recent.check(msg)
+        if state == "pending":
+            return
+        if state == "done":
+            self.server.response(msg, body=self._recent.done_body(msg))
+            return
         if self.hfa_enabled:
             # reject with an error body the client surfaces on wait_all()
             # — a bare ACK would let training silently diverge
-            self.server.response(msg, body={
-                "error": "row-sparse push rejected: server is in HFA mode"})
+            err = {"error": "row-sparse push rejected: server is in HFA mode"}
+            self._recent.mark_done(msg, err)
+            self.server.response(msg, body=err)
             return
         cols = int(msg.body["rs_cols"])
         row_ids, rows = unpack_rows(kvs.vals, cols)
@@ -263,6 +284,7 @@ class LocalServer:
                 dense = np.zeros_like(self.store[key], dtype=np.float32)
                 np.add.at(dense.reshape(-1, cols), row_ids, rows)
                 self._drain_parked_locked(st)
+            self._recent.mark_done(msg)
             self.server.response(msg)
             self._push_up(KVPairs(kvs.keys, dense,
                                   np.array([len(dense)], np.int64)),
@@ -280,6 +302,7 @@ class LocalServer:
             st.row_sparse = True
             if st.count >= self.num_workers:
                 completed.append(key)
+        self._recent.mark_done(msg)
         self.server.response(msg)
         if completed:
             self._round_complete(completed)
@@ -530,6 +553,9 @@ class LocalServer:
             k = int(k)
             w = self.store[k]
             ks.append(k); vs.append(w.astype(np.float32)); ls.append(len(w))
+        # P3 piggybacked pushes park here until the round finishes; record
+        # the response so a replay re-serves values instead of re-merging
+        self._recent.mark_done(req)
         self.server.response(req, KVPairs(
             np.array(ks, dtype=np.int64), np.concatenate(vs),
             np.array(ls, dtype=np.int64)))
@@ -614,6 +640,12 @@ class GlobalServer:
         self.sync_mode = self.config.sync_global_mode
         self.compression: dict = {"type": "none"}
         self.pull_comp = None  # BroadcastCompressor under bsc/mpq
+        self._recent = RecentRequests()  # replayed-push dedup
+        # automatic periodic checkpoints (mid-round crash recovery; an
+        # improvement over the reference, whose server state is RAM-only)
+        self._since_ckpt = 0
+        self._ckpt_busy = False
+        self._ckpt_pending = False
         from geomx_tpu.utils import get_profiler
 
         self._prof = get_profiler(str(postoffice.node))
@@ -643,14 +675,20 @@ class GlobalServer:
                       server: KVServer):
         if msg.cmd == Cmd.INIT:
             with self._mu:
+                fresh = False
                 for k, v in kvs.slices():
                     if k not in self.store:
+                        fresh = True
                         self.store[k] = np.array(v, copy=True)
                         self._keys[k] = _GlobalKeyState()
                         if self.pull_comp is not None:
                             self.pull_comp.ensure_base(int(k), v)
                         # init may race ahead of early pulls
                         self._serve_parked_pulls_locked(int(k))
+                if fresh:
+                    # force a baseline checkpoint: a crash before the
+                    # first periodic one must still restore the key set
+                    self._auto_ckpt_locked(force=True)
             server.response(msg)
             return
         if msg.push and msg.compr and kvs is not None:
@@ -690,7 +728,15 @@ class GlobalServer:
         if len(kvs.keys) == 0:
             self.server.response(msg)
             return
-        to_ack: List[Message] = []
+        state = self._recent.check(msg)
+        if state == "pending":
+            return  # replay of a push already in this round's accumulator
+        if state == "done":
+            # the original ACK was lost — repeat it, same body (an error
+            # body must not degrade into a clean ACK on the replay)
+            self.server.response(msg, body=self._recent.done_body(msg))
+            return
+        to_ack: List[tuple] = []  # (request, error-body | None)
         with self._mu:
             entry = [msg, {int(k) for k in kvs.keys}]
             completed = []
@@ -707,6 +753,19 @@ class GlobalServer:
                     completed.append(k)
             for k in completed:
                 st = self._keys[k]
+                if k not in self.store:
+                    # a restarted server without a checkpoint cannot host
+                    # this key — fail the pushers loudly, don't hang them
+                    err = {"error": f"key {k} lost across server restart "
+                                    "(no checkpoint to resume from)"}
+                    st.accum = None
+                    st.count = 0
+                    for ent in st.parked_pushes:
+                        ent[1].discard(k)
+                        if not ent[1]:
+                            to_ack.append((ent[0], err))
+                    st.parked_pushes.clear()
+                    continue
                 if msg.cmd == Cmd.HFA_DELTA:
                     # milestone deltas come pre-divided by num_global_workers;
                     # apply additively (ref: HandleHFAAccumulate :959-972)
@@ -719,9 +778,11 @@ class GlobalServer:
                 for ent in st.parked_pushes:
                     ent[1].discard(k)
                     if not ent[1]:
-                        to_ack.append(ent[0])
+                        to_ack.append((ent[0], None))
                 st.parked_pushes.clear()
                 self._serve_parked_pulls_locked(k)
+            if completed:
+                self._auto_ckpt_locked(len(completed))
             if (self.ts_inter is not None and completed
                     and msg.cmd == Cmd.DEFAULT):
                 ks = sorted(completed)
@@ -740,13 +801,20 @@ class GlobalServer:
                 )
             else:
                 dissem = None
-        for req in to_ack:
-            self.server.response(req)
+        for req, err in to_ack:
+            self._recent.mark_done(req, err)
+            self.server.response(req, body=err)
         if dissem is not None:
             self.ts_inter.disseminate_async(*dissem, Cmd.TS_AUTOPULL)
 
     # ---- async tier (MixedSync, ref :1519-1698) -----------------------------
     def _push_async(self, msg: Message, kvs: KVPairs):
+        state = self._recent.check(msg)
+        if state != "new":
+            # async pushes apply immediately, so any replay means the ACK
+            # was lost — re-ack without re-applying the gradient
+            self.server.response(msg, body=self._recent.done_body(msg))
+            return
         with self._mu:
             for k, v in kvs.slices():
                 k = int(k)
@@ -756,6 +824,8 @@ class GlobalServer:
                         k, self.store[k], grad, sender=str(msg.sender))
                 else:
                     self.store[k] = self.optimizer.update(k, self.store[k], grad)
+            self._auto_ckpt_locked(len(kvs.keys))
+        self._recent.mark_done(msg)
         self.server.response(msg)
 
     # ---- pulls --------------------------------------------------------------
@@ -844,6 +914,61 @@ class GlobalServer:
             self.pull_comp = pc
         else:
             self.pull_comp = None
+
+    def _auto_ckpt_locked(self, n_updates: int = 0, force: bool = False):
+        """Periodic background checkpoint (caller holds self._mu).
+
+        Snapshots under the lock, serializes on a daemon thread — a
+        multi-MB savez must not stall every party's round.  ``force``
+        writes immediately (used right after INIT so a crash before the
+        first interval still restores the key set)."""
+        if not self.config.checkpoint_dir or not self.config.auto_ckpt_updates:
+            return
+        self._since_ckpt += n_updates
+        if not force and self._since_ckpt < self.config.auto_ckpt_updates:
+            return
+        self._since_ckpt = 0
+        if self._ckpt_busy:
+            # a write is in flight with an older snapshot — re-snapshot
+            # when it finishes (dropping this request could persist a
+            # checkpoint that is missing keys INITed during the write)
+            self._ckpt_pending = True
+            return
+        self._spawn_ckpt_write_locked()
+
+    def _spawn_ckpt_write_locked(self):
+        self._ckpt_busy = True
+        import copy
+        import os
+
+        from geomx_tpu.kvstore import checkpoint as ckpt
+
+        store_snap = {k: v.copy() for k, v in self.store.items()}
+        opt_snap = copy.deepcopy(self.optimizer)
+        meta = {"sync_mode": self.sync_mode,
+                "compression": dict(self.compression)}
+        path = os.path.join(self.config.checkpoint_dir,
+                            f"global_server_{self.po.node.rank}.npz")
+
+        def write():
+            try:
+                ckpt.save_server_state(path, store_snap,
+                                       {"optimizer": opt_snap}, meta)
+            except Exception:  # any failure must not wedge _ckpt_busy —
+                # that would silently disable all future auto-checkpoints
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "auto-checkpoint to %s failed", path)
+            finally:
+                with self._mu:
+                    self._ckpt_busy = False
+                    if self._ckpt_pending:
+                        self._ckpt_pending = False
+                        self._spawn_ckpt_write_locked()
+
+        threading.Thread(target=write, daemon=True,
+                         name=f"auto-ckpt-{self.po.node}").start()
 
     def load_checkpoint(self, path: str):
         """Restore weights + optimizer + config from a checkpoint file and

@@ -66,7 +66,8 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
         # crash recovery: a restarted global server resumes from its last
         # checkpoint (weights + optimizer + config); load_checkpoint also
         # drains pulls that parked during the restart window
-        ckpt_dir = os.environ.get("GEOMX_CHECKPOINT_DIR")
+        ckpt_dir = config.checkpoint_dir or os.environ.get(
+            "GEOMX_CHECKPOINT_DIR")
         if ckpt_dir:
             path = f"{ckpt_dir}/global_server_{node.rank}.npz"
             if os.path.exists(path):
@@ -94,7 +95,14 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
 
 def shutdown_cluster(po: Postoffice):
     """Broadcast TERMINATE to every non-worker node (worker rank-0 of
-    party 0 calls this after training, ref: kStopServer)."""
+    party 0 calls this after training, ref: kStopServer).
+
+    The broadcast is sent twice with a gap: a peer that crashed and
+    restarted leaves this node holding a half-closed connection whose
+    first send is silently buffered into the void (no error until the
+    RST arrives).  By the second round the RST has landed, the send
+    raises, and the fabric redials the live incarnation.  TERMINATE is
+    idempotent, so the duplicate is harmless."""
     topo = po.topology
     targets = []
     for p in range(topo.num_parties):
@@ -103,12 +111,15 @@ def shutdown_cluster(po: Postoffice):
     for gs in topo.global_servers():
         targets.append((gs, Domain.GLOBAL))
     targets.append((topo.global_scheduler(), Domain.GLOBAL))
-    for node, domain in targets:
-        try:
-            po.van.send(Message(recipient=node, control=Control.TERMINATE,
-                                domain=domain))
-        except (KeyError, OSError):
-            pass
+    for attempt in range(2):
+        if attempt:
+            time.sleep(0.5)
+        for node, domain in targets:
+            try:
+                po.van.send(Message(recipient=node, control=Control.TERMINATE,
+                                    domain=domain))
+            except (KeyError, OSError):
+                pass
 
 
 def _worker_demo(po, kv, args):

@@ -173,6 +173,70 @@ class KVWorker(_App):
         # server-reported errors (e.g. rejected pushes); surfaced by the
         # kvstore client on wait_all — a bare ACK would hide them
         self.errors: List[str] = []
+        # application-level request replay (elastic recovery): a request
+        # whose response hasn't arrived within request_retry_s is re-sent
+        # to the targets that haven't answered; servers dedup replays by
+        # (sender, app, customer, ts).  This is what survives a server
+        # crash+restart — transport resend only covers lost *delivery*,
+        # not state lost with a dead process.
+        self._retry_s = float(postoffice.config.request_retry_s or 0.0)
+        self._inflight: Dict[int, dict] = {}  # ts -> {deadline, attempts,
+        #                                       msgs: {target_str: Message}}
+        self._retry_stop = threading.Event()
+        if self._retry_s > 0:
+            threading.Thread(
+                target=self._retry_loop, daemon=True,
+                name=f"kv-retry-{postoffice.node}-{app_id}.{customer_id}",
+            ).start()
+
+    # ---- request replay (elastic recovery) ----------------------------------
+    def _track(self, ts: int, msgs: List[Message]):
+        if self._retry_s <= 0 or not msgs:
+            return
+        import time
+
+        with self._mu:
+            self._inflight[ts] = {
+                "deadline": time.monotonic() + self._retry_s,
+                "attempts": 0,
+                "msgs": {str(m.recipient): m for m in msgs},
+            }
+
+    def _on_response_tracked(self, msg: Message) -> bool:
+        """Drop-duplicate filter; returns False for a response from a
+        target that already answered this request (a replayed request can
+        produce two responses — counting both would complete the request
+        before the *other* targets answered)."""
+        if self._retry_s <= 0:
+            return True
+        with self._mu:
+            ent = self._inflight.get(msg.timestamp)
+            if ent is None:
+                return False  # request already complete → duplicate
+            if ent["msgs"].pop(str(msg.sender), None) is None:
+                return False  # this target already answered
+            if not ent["msgs"]:
+                del self._inflight[msg.timestamp]
+        return True
+
+    def _retry_loop(self):
+        import time
+
+        while not self._retry_stop.wait(min(self._retry_s / 4, 1.0)):
+            now = time.monotonic()
+            resend: List[Message] = []
+            with self._mu:
+                for ent in self._inflight.values():
+                    if now >= ent["deadline"]:
+                        ent["attempts"] += 1
+                        backoff = min(2 ** ent["attempts"], 8)
+                        ent["deadline"] = now + self._retry_s * backoff
+                        resend.extend(ent["msgs"].values())
+            for m in resend:
+                try:
+                    self.postoffice.van.send(m)
+                except (KeyError, OSError):
+                    pass  # peer still down — the next sweep retries
 
     # ---- slicing ------------------------------------------------------------
     def _slice(self, kvs: KVPairs) -> Dict[int, KVPairs]:
@@ -215,6 +279,7 @@ class KVWorker(_App):
         """Push values to their owning servers (ref: kv_app.h:171 ZPush)."""
         parts = self._slice(kvs)
         ts = self.customer.new_request(len(parts), on_complete=on_complete)
+        sends: List[tuple] = []
         for sid, part in parts.items():
             m = Message(
                 recipient=self.targets[sid], domain=self.domain,
@@ -225,9 +290,17 @@ class KVWorker(_App):
             # DGT applies only to recurring gradient pushes: INIT and HFA
             # milestone deltas are one-shot — a dropped chunk would be
             # permanent corruption, not a delayed update
-            if (self.dgt_sender is not None and cmd == 0
-                    and m.compr in ("", "fp16") and m.vals is not None
-                    and len(m.vals) > self.dgt_sender.block_size):
+            use_dgt = (self.dgt_sender is not None and cmd == 0
+                       and m.compr in ("", "fp16") and m.vals is not None
+                       and len(m.vals) > self.dgt_sender.block_size)
+            sends.append((m, use_dgt))
+        # track BEFORE sending — a loopback-fast response must not race
+        # the bookkeeping and be dropped as a duplicate.  DGT pushes are
+        # tracked as their unsplit original: a replay re-sends the whole
+        # message reliably (seq=-1 bypasses chunk reassembly).
+        self._track(ts, [m for m, _ in sends])
+        for m, use_dgt in sends:
+            if use_dgt:
                 m.sender = self.postoffice.node  # split() copies sender
                 for chunk in self.dgt_sender.split(m):
                     self.postoffice.van.send(chunk)
@@ -268,14 +341,16 @@ class KVWorker(_App):
                 self._pull_cbs[ts] = cb
 
         def _send():
-            for sid, part in parts.items():
-                self.postoffice.van.send(Message(
-                    recipient=self.targets[sid], domain=self.domain,
-                    app_id=self.customer.app_id,
-                    customer_id=self.customer.customer_id,
-                    timestamp=ts, request=True, pull=True, cmd=cmd,
-                    priority=priority, keys=part.keys, **msg_fields,
-                ))
+            msgs = [Message(
+                recipient=self.targets[sid], domain=self.domain,
+                app_id=self.customer.app_id,
+                customer_id=self.customer.customer_id,
+                timestamp=ts, request=True, pull=True, cmd=cmd,
+                priority=priority, keys=part.keys, **msg_fields,
+            ) for sid, part in parts.items()]
+            self._track(ts, msgs)  # before sending (response could race)
+            for m in msgs:
+                self.postoffice.van.send(m)
 
         if after_ts is None:
             _send()
@@ -295,13 +370,15 @@ class KVWorker(_App):
             self._pull_expected[ts] = len(parts)
             if cb is not None:
                 self._pull_cbs[ts] = cb
-        for sid, part in parts.items():
-            self.postoffice.van.send(Message(
-                recipient=self.targets[sid], domain=self.domain,
-                app_id=self.customer.app_id, customer_id=self.customer.customer_id,
-                timestamp=ts, request=True, push=True, pull=True, cmd=cmd,
-                priority=priority, keys=part.keys, vals=part.vals, lens=part.lens,
-            ))
+        msgs = [Message(
+            recipient=self.targets[sid], domain=self.domain,
+            app_id=self.customer.app_id, customer_id=self.customer.customer_id,
+            timestamp=ts, request=True, push=True, pull=True, cmd=cmd,
+            priority=priority, keys=part.keys, vals=part.vals, lens=part.lens,
+        ) for sid, part in parts.items()]
+        self._track(ts, msgs)  # before sending (response could race)
+        for m in msgs:
+            self.postoffice.van.send(m)
         if wait:
             self.customer.wait(ts)
         return ts
@@ -316,6 +393,8 @@ class KVWorker(_App):
                 self.ts_handler(msg)
                 return
             raise AssertionError(f"KVWorker got a request: {msg}")
+        if not self._on_response_tracked(msg):
+            return  # duplicate response caused by a replayed request
         if isinstance(msg.body, dict) and "error" in msg.body:
             with self._mu:
                 self.errors.append(str(msg.body["error"]))
@@ -339,6 +418,10 @@ class KVWorker(_App):
                 if cb is not None:
                     cb(merged)
         self.customer.add_response(ts)
+
+    def stop(self):
+        self._retry_stop.set()
+        super().stop()
 
     @staticmethod
     def _merge(parts: List[KVPairs]) -> KVPairs:
