@@ -244,6 +244,9 @@ class Config:
     enable_intra_ts: bool = False
     enable_inter_ts: bool = False
     ts_max_greed_rate: float = 0.9
+    # under an async global tier, disseminate at most once per this many
+    # pushes (per-push dissemination would flood the WAN overlay)
+    inter_ts_async_every: int = 8
 
     # --- DGT (ref: kv_app.h:841-850)
     enable_dgt: int = 0           # 0 off; 1 UDP-like lossy; 2 reliable; 3 reliable+requant
@@ -280,11 +283,8 @@ class Config:
                 f"drop_rate must be a fraction in [0,1], got {self.drop_rate} "
                 "(note: the GEOMX_DROP_MSG / PS_DROP_MSG env vars are percents)"
             )
-        if self.enable_inter_ts and not self.sync_global_mode:
-            raise ValueError(
-                "enable_inter_ts requires a synchronous global tier: the "
-                "async tier never disseminates, so local servers (which "
-                "skip the pull-down under inter-TS) would deadlock")
+        if self.inter_ts_async_every < 1:
+            raise ValueError("inter_ts_async_every must be >= 1")
         if self.enable_p3 and self.enable_intra_ts:
             raise ValueError(
                 "enable_p3 and enable_intra_ts are mutually exclusive "
@@ -326,6 +326,7 @@ class Config:
             enable_intra_ts=_env_bool("GEOMX_ENABLE_INTRA_TS", _env_bool("ENABLE_INTRA_TS")),
             enable_inter_ts=_env_bool("GEOMX_ENABLE_INTER_TS", _env_bool("ENABLE_INTER_TS")),
             ts_max_greed_rate=_env_float("GEOMX_TS_GREED", _env_float("MAX_GREED_RATE_TS", 0.9)),
+            inter_ts_async_every=_env_int("GEOMX_INTER_TS_ASYNC_EVERY", 8),
             enable_dgt=_env_int("GEOMX_ENABLE_DGT", _env_int("ENABLE_DGT", 0)),
             dgt_block_size=_env_int("GEOMX_DGT_BLOCK_SIZE", _env_int("DGT_BLOCK_SIZE", 4096)),
             dgt_k=_env_float("GEOMX_DGT_K", _env_float("DMLC_K", 0.5)),

@@ -309,15 +309,21 @@ class LocalServer:
 
     def _on_inter_ts_delivery(self, msg: Message, kvs: KVPairs):
         """Updated weights arrived via the WAN overlay instead of a pull
-        (inter-party TSEngine): adopt them, finish the round, confirm
-        delivery, and relay onward to sibling local servers."""
+        (inter-party TSEngine): adopt them, confirm delivery, and relay
+        onward to sibling local servers.  Under the sync tier a delivery
+        IS the round completion, so it finishes the round; under the
+        async tier rounds complete via the push ACK instead, and a
+        delivery decoupled from any round must only refresh the replica
+        — force-finishing would break the intra-party BSP barrier
+        (serving parked pulls before every party worker pushed)."""
         it = str(msg.body["iter"])
         with self._mu:
             for k, v in kvs.slices():
                 # fp16 relay payloads decode back to f32 replicas
                 self.store[k] = np.asarray(v, dtype=np.float32).copy()
-            self._finish_round([int(k) for k in kvs.keys
-                                if int(k) in self._keys])
+            if self.config.sync_global_mode:
+                self._finish_round([int(k) for k in kvs.keys
+                                    if int(k) in self._keys])
         self.ts_inter.send_reply(msg.sender, it)
         self.ts_inter.disseminate_async(msg.keys, msg.vals, msg.lens, it,
                                         Cmd.TS_AUTOPULL)
@@ -385,6 +391,12 @@ class LocalServer:
             # (ref: DataHandlePushResponseDefault :941-957).  Under
             # inter-party TS the overlay delivers them instead.
             if self.ts_inter is not None:
+                if not self.config.sync_global_mode:
+                    # async tier: the overlay disseminates at its own
+                    # (rate-limited) pace — finish the round from the
+                    # current replica instead of gating on a delivery
+                    with self._mu:
+                        self._finish_round(keys)
                 return
             self.up.zpull(keys, cb=self._on_pull_down)
 
@@ -654,6 +666,12 @@ class GlobalServer:
         # instead of serving N pulls (sync tier only)
         self.ts_inter = None
         self._ts_iter = 0
+        # async-tier dissemination is rate-limited: per-push relays would
+        # flood the overlay, so fresh weights go out at most once per
+        # inter_ts_async_every pushes, covering every key updated since
+        # the previous dissemination
+        self._ts_async_pushes = 0
+        self._ts_async_dirty: set = set()
         if self.config.enable_inter_ts:
             from geomx_tpu.sched.tsengine import TsClient
 
@@ -785,20 +803,8 @@ class GlobalServer:
                 self._auto_ckpt_locked(len(completed))
             if (self.ts_inter is not None and completed
                     and msg.cmd == Cmd.DEFAULT):
-                ks = sorted(completed)
-                self._ts_iter += 1
-                # honor fp16 pull compression on the relay payload (bsc/mpq
-                # are rejected at config time — per-subscriber deltas don't
-                # fit a shared relay)
-                dt = (np.float16 if self.compression.get("type") == "fp16"
-                      else np.float32)
-                dissem = (
-                    np.array(ks, dtype=np.int64),
-                    np.concatenate([self.store[k].astype(dt) for k in ks]),
-                    np.array([len(self.store[k]) for k in ks],
-                             dtype=np.int64),
-                    f"{self.po.node}:{self._ts_iter}",
-                )
+                dissem = self._build_dissem_locked(sorted(
+                    k for k in completed if k in self.store))
             else:
                 dissem = None
         for req, err in to_ack:
@@ -806,6 +812,23 @@ class GlobalServer:
             self.server.response(req, body=err)
         if dissem is not None:
             self.ts_inter.disseminate_async(*dissem, Cmd.TS_AUTOPULL)
+
+    def _build_dissem_locked(self, ks: List[int]):
+        """Assemble one overlay-relay payload for keys ``ks`` (caller
+        holds self._mu).  Honors fp16 pull compression on the relay
+        (bsc/mpq are rejected at config time — per-subscriber deltas
+        don't fit a shared relay payload)."""
+        if not ks:
+            return None
+        self._ts_iter += 1
+        dt = (np.float16 if self.compression.get("type") == "fp16"
+              else np.float32)
+        return (
+            np.array(ks, dtype=np.int64),
+            np.concatenate([self.store[k].astype(dt) for k in ks]),
+            np.array([len(self.store[k]) for k in ks], dtype=np.int64),
+            f"{self.po.node}:{self._ts_iter}",
+        )
 
     # ---- async tier (MixedSync, ref :1519-1698) -----------------------------
     def _push_async(self, msg: Message, kvs: KVPairs):
@@ -815,6 +838,7 @@ class GlobalServer:
             # was lost — re-ack without re-applying the gradient
             self.server.response(msg, body=self._recent.done_body(msg))
             return
+        dissem = None
         with self._mu:
             for k, v in kvs.slices():
                 k = int(k)
@@ -825,8 +849,19 @@ class GlobalServer:
                 else:
                     self.store[k] = self.optimizer.update(k, self.store[k], grad)
             self._auto_ckpt_locked(len(kvs.keys))
+            if self.ts_inter is not None and msg.cmd == Cmd.DEFAULT:
+                self._ts_async_dirty.update(int(k) for k in kvs.keys)
+                self._ts_async_pushes += 1
+                if (self._ts_async_pushes
+                        >= self.config.inter_ts_async_every):
+                    self._ts_async_pushes = 0
+                    ks = sorted(self._ts_async_dirty)
+                    self._ts_async_dirty.clear()
+                    dissem = self._build_dissem_locked(ks)
         self._recent.mark_done(msg)
         self.server.response(msg)
+        if dissem is not None:
+            self.ts_inter.disseminate_async(*dissem, Cmd.TS_AUTOPULL)
 
     # ---- pulls --------------------------------------------------------------
     def _pull(self, msg: Message, kvs: KVPairs):
@@ -1022,11 +1057,16 @@ class GlobalServer:
                     return
                 self._apply_compression_locked(body)
         elif msg.cmd == Ctrl.SET_SYNC_GLOBAL_MODE:
-            if not bool(body["sync"]) and self.ts_inter is not None:
+            if self.ts_inter is not None and bool(body["sync"]) != self.sync_mode:
+                # local servers key their round-completion path off the
+                # STATIC config; a runtime flip only we can see would
+                # desync the tiers (sync→async would deadlock every
+                # party's round on a dissemination that never fires)
                 self.server.reply_cmd(msg, body={
-                    "error": "cannot switch the global tier async under "
-                             "inter-TS (the async tier never disseminates "
-                             "— local servers would deadlock)"})
+                    "error": "cannot switch the global sync mode at "
+                             "runtime under inter-TS — set "
+                             "sync_global_mode in the static config so "
+                             "all roles agree"})
                 return
             self.sync_mode = bool(body["sync"])
         elif msg.cmd == Ctrl.QUERY_STATS:

@@ -258,6 +258,41 @@ def test_tsengine_inter_party_overlay():
         sim.shutdown()
 
 
+def test_tsengine_inter_party_under_async_tier():
+    """Inter-TS + MixedSync (async global tier): rounds finish without a
+    pull-down; rate-limited dissemination refreshes the local replicas
+    (previously rejected; now supported via inter_ts_async_every)."""
+    sim = make_sim(parties=2, workers=1, enable_inter_ts=True,
+                   sync_global_mode=False, inter_ts_async_every=2)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(32, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        for step in range(4):  # 4 party-rounds → 8 async pushes → ≥4 dissems
+            for w in ws:
+                w.push(0, np.ones(32, np.float32))
+            for w in ws:
+                w.pull_sync(0)
+            for w in ws:
+                w.wait_all()
+        # dissemination is asynchronous — poll until the overlay delivered
+        # an updated replica to the local servers
+        deadline = time.monotonic() + 10
+        vals = [0.0, 0.0]
+        while time.monotonic() < deadline:
+            vals = [float(w.pull_sync(0)[0]) for w in ws]
+            if all(v < 0 for v in vals):
+                break
+            time.sleep(0.05)
+        # async: every push applies individually (8 pushes × lr 0.1 × grad 1
+        # = -0.8 at the global store); replicas must have caught up to a
+        # negative (post-update) value by now
+        assert all(v < 0 for v in vals), vals
+    finally:
+        sim.shutdown()
+
+
 def test_tsengine_intra_plus_inter_combined():
     """Both overlays at once: worker pulls come from the intra relay,
     local-server weights come from the inter relay."""
