@@ -30,7 +30,7 @@ import numpy as np
 from geomx_tpu.core.config import Config, Topology
 from geomx_tpu.data import ShardedIterator, synthetic_classification
 from geomx_tpu.kvstore import Simulation
-from geomx_tpu.models import create_cnn_state, create_resnet_state
+from geomx_tpu.models import MODEL_REGISTRY, create_model_state
 from geomx_tpu.training import run_worker, run_worker_hfa
 
 
@@ -44,7 +44,8 @@ def main():
     ap.add_argument("--lr", type=float, default=0.01)
     ap.add_argument("--optimizer", default="adam",
                     choices=["sgd", "adam", "dcasgd"])
-    ap.add_argument("--model", default="cnn", choices=["cnn", "resnet"])
+    ap.add_argument("--model", default="cnn",
+                    choices=sorted(MODEL_REGISTRY))
     ap.add_argument("--sync", default="fsa", choices=["fsa", "mixed"],
                     help="fsa = both tiers sync; mixed = async global tier")
     ap.add_argument("--compression", default="none",
@@ -104,11 +105,9 @@ def main():
             print(f"wrote record dataset: {args.record}", flush=True)
     num_all = cfg.topology.num_workers_total
 
-    if args.model == "resnet":
-        _, params, grad_fn = create_resnet_state(
-            jax.random.PRNGKey(args.seed), input_shape=(1, 28, 28, 1))
-    else:
-        _, params, grad_fn = create_cnn_state(jax.random.PRNGKey(args.seed))
+    _, params, grad_fn = create_model_state(
+        args.model, jax.random.PRNGKey(args.seed),
+        input_shape=(1, 28, 28, 1))
 
     histories = {}
     lock = threading.Lock()

@@ -1,2 +1,28 @@
 from geomx_tpu.models.cnn import CNN, create_cnn_state  # noqa: F401
 from geomx_tpu.models.resnet import ResNet, create_resnet_state  # noqa: F401
+from geomx_tpu.models.zoo import (  # noqa: F401
+    MLP, MobileNet, SqueezeNet, VGG, create_mlp_state,
+    create_mobilenet_state, create_squeezenet_state, create_vgg_state,
+)
+
+# name → factory registry (the reference's model_zoo get_model-by-name
+# surface, ref: python/mxnet/gluon/model_zoo/model_store.py)
+MODEL_REGISTRY = {
+    "cnn": create_cnn_state,
+    "resnet": create_resnet_state,
+    "mlp": create_mlp_state,
+    "vgg": create_vgg_state,
+    "mobilenet": create_mobilenet_state,
+    "squeezenet": create_squeezenet_state,
+}
+
+
+def create_model_state(name: str, rng, **kw):
+    """Look up a family by name and build (model, params, grad_fn)."""
+    try:
+        factory = MODEL_REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; choose from {sorted(MODEL_REGISTRY)}"
+        ) from None
+    return factory(rng, **kw)

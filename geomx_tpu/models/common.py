@@ -4,8 +4,17 @@ kvstore integration swap models freely."""
 
 from __future__ import annotations
 
+import math
+
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
+
+
+def group_norm(features: int, dtype):
+    """GroupNorm with groups derived from the channel count — hard-coding
+    8 crashes opaquely for widths not divisible by 8."""
+    return nn.GroupNorm(num_groups=math.gcd(8, features), dtype=dtype)
 
 
 def make_grad_fn(model):

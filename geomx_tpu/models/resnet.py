@@ -10,17 +10,11 @@ from __future__ import annotations
 
 from typing import Any, Sequence, Tuple
 
-import math
-
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-
-def _gn(features: int, dtype):
-    """GroupNorm with groups derived from the channel count — hard-coding
-    8 crashes opaquely for widths not divisible by 8."""
-    return nn.GroupNorm(num_groups=math.gcd(8, features), dtype=dtype)
+from geomx_tpu.models.common import group_norm as _gn
 
 
 class ResBlock(nn.Module):

@@ -1,0 +1,157 @@
+"""Model zoo: the families the reference ships via its gluon model zoo
+(ref: python/mxnet/gluon/model_zoo/vision/ — alexnet/vgg/resnet/
+mobilenet/squeezenet/densenet/inception), rebuilt as compact flax
+modules sized for the framework's CIFAR/MNIST-shape workloads.
+
+All families keep the TPU-first conventions of the existing models:
+bf16 activations / f32 params, static shapes, GroupNorm instead of
+BatchNorm (no cross-device batch-stat sync on the worker's mesh), and
+the shared ``(model, params, grad_fn)`` factory contract
+(geomx_tpu/models/common.py) so every training loop, example, and
+acceptance script swaps families by name.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from geomx_tpu.models.common import group_norm as _gn, make_grad_fn
+
+
+class MLP(nn.Module):
+    """Plain multi-layer perceptron (the smallest zoo member; the
+    reference's equivalent demo is gluon's Dense stacks)."""
+
+    num_classes: int = 10
+    hidden: Sequence[int] = (256, 128)
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.compute_dtype
+        x = x.reshape((x.shape[0], -1)).astype(dt)
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h, dtype=dt)(x))
+        return nn.Dense(self.num_classes, dtype=dt)(x).astype(jnp.float32)
+
+
+class VGG(nn.Module):
+    """VGG-style conv stacks (ref: gluon model_zoo vgg.py): N stages of
+    [conv3x3 × reps, maxpool], then dense head."""
+
+    num_classes: int = 10
+    stages: Sequence[Tuple[int, int]] = ((32, 1), (64, 1), (128, 2))
+    head: int = 256
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.compute_dtype
+        x = x.astype(dt)
+        for feats, reps in self.stages:
+            for _ in range(reps):
+                x = nn.Conv(feats, (3, 3), dtype=dt)(x)
+                x = nn.relu(_gn(feats, dt)(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.head, dtype=dt)(x))
+        return nn.Dense(self.num_classes, dtype=dt)(x).astype(jnp.float32)
+
+
+class _SeparableBlock(nn.Module):
+    """Depthwise 3x3 + pointwise 1x1 (ref: gluon model_zoo mobilenet.py
+    _add_conv_dw)."""
+
+    features: int
+    stride: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        in_feats = x.shape[-1]
+        x = nn.Conv(in_feats, (3, 3), strides=(self.stride, self.stride),
+                    feature_group_count=in_feats, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.relu(_gn(in_feats, self.dtype)(x))
+        x = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        return nn.relu(_gn(self.features, self.dtype)(x))
+
+
+class MobileNet(nn.Module):
+    """MobileNet-v1-style: conv stem + depthwise-separable stacks."""
+
+    num_classes: int = 10
+    blocks: Sequence[Tuple[int, int]] = ((64, 1), (128, 2), (256, 2))
+    width: int = 32
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.compute_dtype
+        x = x.astype(dt)
+        x = nn.Conv(self.width, (3, 3), use_bias=False, dtype=dt)(x)
+        x = nn.relu(_gn(self.width, dt)(x))
+        for feats, stride in self.blocks:
+            x = _SeparableBlock(feats, stride=stride, dtype=dt)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=dt)(x).astype(jnp.float32)
+
+
+class _Fire(nn.Module):
+    """Squeeze (1x1) then expand (1x1 ‖ 3x3) (ref: gluon model_zoo
+    squeezenet.py _make_fire)."""
+
+    squeeze: int
+    expand: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        s = nn.relu(nn.Conv(self.squeeze, (1, 1), dtype=self.dtype)(x))
+        e1 = nn.relu(nn.Conv(self.expand, (1, 1), dtype=self.dtype)(s))
+        e3 = nn.relu(nn.Conv(self.expand, (3, 3), dtype=self.dtype)(s))
+        return jnp.concatenate([e1, e3], axis=-1)
+
+
+class SqueezeNet(nn.Module):
+    num_classes: int = 10
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.compute_dtype
+        x = x.astype(dt)
+        x = nn.relu(nn.Conv(32, (3, 3), strides=(2, 2), dtype=dt)(x))
+        x = _Fire(8, 32, dtype=dt)(x)
+        x = _Fire(8, 32, dtype=dt)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = _Fire(16, 64, dtype=dt)(x)
+        x = _Fire(16, 64, dtype=dt)(x)
+        # classifier is a 1x1 conv + global pool (squeezenet's signature
+        # head: no dense layers at all)
+        x = nn.Conv(self.num_classes, (1, 1), dtype=dt)(x)
+        return jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+
+
+def _factory(cls):
+    def create(rng: jax.Array,
+               input_shape: Tuple[int, ...] = (1, 28, 28, 1),
+               num_classes: int = 10, **kw):
+        model = cls(num_classes=num_classes, **kw)
+        params = model.init(rng, jnp.zeros(input_shape, jnp.float32))
+        return model, params, make_grad_fn(model)
+
+    create.__name__ = f"create_{cls.__name__.lower()}_state"
+    create.__doc__ = (f"Init {cls.__name__} params + jitted grad_fn — the "
+                      "shared (model, params, grad_fn) zoo contract.")
+    return create
+
+
+create_mlp_state = _factory(MLP)
+create_vgg_state = _factory(VGG)
+create_mobilenet_state = _factory(MobileNet)
+create_squeezenet_state = _factory(SqueezeNet)

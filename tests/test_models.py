@@ -7,7 +7,8 @@ import numpy as np
 import pytest
 
 from geomx_tpu.data import synthetic_classification
-from geomx_tpu.models import create_cnn_state, create_resnet_state
+from geomx_tpu.models import (MODEL_REGISTRY, create_cnn_state,
+                              create_model_state, create_resnet_state)
 
 
 @pytest.mark.parametrize("factory,kw", [
@@ -27,6 +28,25 @@ def test_model_contract_and_learning(factory, kw):
                                         params, grads)
     loss1, _, _ = grad_fn(params, x, y)
     assert float(loss1) < float(loss0)
+
+
+@pytest.mark.parametrize("name", sorted(MODEL_REGISTRY))
+def test_registry_families_forward_and_grad(name):
+    """Every registered family builds by name, produces finite logits of
+    the right shape, and yields grads matching the param tree."""
+    _, params, grad_fn = create_model_state(
+        name, jax.random.PRNGKey(0), input_shape=(1, 12, 12, 1))
+    x, y = synthetic_classification(n=16, shape=(12, 12, 1), seed=1)
+    loss, acc, grads = grad_fn(params, jnp.asarray(x[:8]),
+                               jnp.asarray(y[:8].astype(np.int32)))
+    assert np.isfinite(float(loss)) and 0.0 <= float(acc) <= 1.0
+    assert (jax.tree_util.tree_structure(grads)
+            == jax.tree_util.tree_structure(params))
+
+
+def test_registry_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown model"):
+        create_model_state("alexnet9000", jax.random.PRNGKey(0))
 
 
 def test_example_wrappers_parse():
