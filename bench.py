@@ -3,6 +3,14 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
+``--wan`` runs the second BASELINE.md metric instead: WAN bytes/step of
+the geo-distributed stack per codec config (vanilla/fp16/2bit/bsc/mpq),
+a hardware-independent measure of the WAN-optimization value (the
+reference's headline is WAN-traffic reduction, README.md:21-45).  One
+JSON line: {"metric": "wan_bytes_per_step", "value": <vanilla>,
+"configs": {...}, "reduction": {...}}; vs_baseline is null — there is
+no published reference number to compare against.
+
 The north-star target (BASELINE.md) is >=0.9x the per-chip throughput of an
 A100 running the reference CUDA build on the same CNN.  No A100 is
 reachable from this environment, so ``A100_REF_IMAGES_PER_SEC`` is a
@@ -39,6 +47,65 @@ apply_platform_from_env()
 A100_REF_IMAGES_PER_SEC = 400_000.0
 BATCH = 1024
 STEPS = 50
+
+
+def wan_bench():
+    """WAN bytes/step per codec config on the full two-tier stack
+    (in-proc sim, 2 parties x 1 worker — topology doesn't change the
+    per-party WAN payload, codecs do)."""
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.kvstore import Simulation
+
+    # one big tensor (BSC regime) + one small tensor (below MPQ's
+    # size_bound) so the MPQ split actually exercises both branches and
+    # its number differs from pure BSC
+    N_BIG, N_SMALL = 400_000, 50_000
+    STEPS_W = 4
+    configs = {
+        "vanilla": None,
+        "fp16": {"type": "fp16"},
+        "2bit": {"type": "2bit", "threshold": 0.5},
+        "bsc": {"type": "bsc", "ratio": 0.01},
+        "mpq": {"type": "mpq", "ratio": 0.01, "size_bound": 200_000},
+    }
+    out = {}
+    for name, comp in configs.items():
+        sim = Simulation(Config(
+            topology=Topology(num_parties=2, workers_per_party=1)))
+        try:
+            ws = sim.all_workers()
+            rng = np.random.default_rng(0)
+            for w in ws:
+                w.init(0, np.zeros(N_BIG, np.float32))
+                w.init(1, np.zeros(N_SMALL, np.float32))
+            ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+            if comp is not None:
+                # rank-0 of EACH party configures its party server
+                # (ref semantics — one party left unconfigured would keep
+                # pushing dense)
+                for p in range(2):
+                    sim.worker(p, 0).set_gradient_compression(comp)
+            base = sim.wan_bytes()["wan_send_bytes"]
+            for _ in range(STEPS_W):
+                for tid, n in ((0, N_BIG), (1, N_SMALL)):
+                    g = rng.standard_normal(n).astype(np.float32)
+                    for w in ws:
+                        w.push(tid, g)
+                for w in ws:
+                    w.pull_sync(0)
+                    w.pull_sync(1)
+            out[name] = (sim.wan_bytes()["wan_send_bytes"] - base) / STEPS_W
+        finally:
+            sim.shutdown()
+    print(json.dumps({
+        "metric": "wan_bytes_per_step",
+        "value": round(out["vanilla"], 1),
+        "unit": "bytes/step (vanilla; see configs)",
+        "vs_baseline": None,  # no published reference WAN number
+        "configs": {k: round(v, 1) for k, v in out.items()},
+        "reduction": {k: round(out["vanilla"] / v, 2)
+                      for k, v in out.items() if v > 0},
+    }))
 
 
 def main():
@@ -91,4 +158,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--wan" in sys.argv:
+        wan_bench()
+    else:
+        main()
