@@ -6,9 +6,11 @@ parallelism the reference lacks:
 
 - **tp**: Megatron-style sharded projections — qkv/up-proj column-sharded,
   out/down-proj row-sharded; XLA/GSPMD inserts the psums.
-- **sp**: sequence dimension sharded; attention runs as ring attention
-  (`geomx_tpu.parallel.ring_attention`) inside shard_map, K/V blocks
-  rotating over ICI neighbors.
+- **sp**: sequence dimension sharded; attention runs inside shard_map
+  as ring attention (`geomx_tpu.parallel.ring_attention`, K/V blocks
+  rotating over ICI neighbors) or Ulysses all-to-all
+  (`geomx_tpu.parallel.ulysses`, head↔seq re-sharding) — selected by
+  ``TransformerConfig.sp_attn``.
 - **dp**: batch sharded; gradient AllReduce inserted by XLA.
 - **ep**: MoE layers (optional) shard the expert dimension over the tp
   axis — dense routing (every expert computes, combine weighted by the
@@ -32,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from geomx_tpu.parallel.ring_attention import dense_attention, ring_attention
+from geomx_tpu.parallel.ulysses import ulysses_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,6 +48,9 @@ class TransformerConfig:
     moe_every: int = 0       # every Nth layer is MoE (0 = none)
     n_experts: int = 4
     compute_dtype: Any = jnp.bfloat16
+    sp_attn: str = "ring"    # "ring" (K/V rotation, any head count) or
+    #                          "ulysses" (head<->seq all-to-all; needs
+    #                          per-device heads divisible by sp)
 
     @property
     def head_dim(self) -> int:
@@ -129,18 +135,27 @@ def _rms_norm(x, scale):
 
 def make_apply(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     """Build the forward fn.  With a mesh containing an ``sp`` axis of
-    size > 1, attention runs as ring attention in shard_map; otherwise the
+    size > 1, attention runs sequence-parallel in shard_map — ring
+    attention or Ulysses all-to-all per ``cfg.sp_attn`` — otherwise the
     dense single-device path."""
+    if cfg.sp_attn not in ("ring", "ulysses"):
+        raise ValueError(
+            f"sp_attn must be 'ring' or 'ulysses', got {cfg.sp_attn!r}")
     use_ring = mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1
 
     def attn_op(q, k, v):
         if not use_ring:
             return dense_attention(q, k, v, causal=True)
+        if cfg.sp_attn == "ulysses":
+            sp_fn = lambda a, b, c: ulysses_attention(  # noqa: E731
+                a, b, c, axis_name="sp", causal=True)
+        else:
+            sp_fn = lambda a, b, c: ring_attention(  # noqa: E731
+                a, b, c, axis_name="sp", axis_size=mesh.shape["sp"],
+                causal=True)
         spec = P("dp", "sp", "tp", None)
         f = shard_map(
-            lambda a, b, c: ring_attention(
-                a, b, c, axis_name="sp", axis_size=mesh.shape["sp"],
-                causal=True),
+            sp_fn,
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )

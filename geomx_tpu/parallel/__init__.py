@@ -1,2 +1,3 @@
 from geomx_tpu.parallel.mesh import make_mesh, named_sharding  # noqa: F401
 from geomx_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from geomx_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
