@@ -34,13 +34,21 @@ from geomx_tpu.transport.tcp import TcpFabric, default_address_plan
 
 
 def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
-                  hosts=None):
-    """Construct the postoffice + role object for one node."""
+                  hosts=None, advertise=None):
+    """Construct the postoffice + role object for one node.
+
+    ``advertise`` = (host, port) overrides this node's planned address —
+    a *replacement* node coming up somewhere new (the static plan's slot
+    is stale).  The new address is broadcast to every peer after start
+    (ref: the scheduler's re-registration broadcast van.cc:176-193;
+    plan-based here, so the node announces directly)."""
     if hosts is None:
         import json
 
         hosts = json.loads(os.environ.get("GEOMX_NODE_HOSTS", "{}"))
     plan = default_address_plan(config.topology, base_port, hosts)
+    if advertise is not None:
+        plan[str(node)] = advertise
     fabric = TcpFabric(plan, config=config)
     po = Postoffice(node, config.topology, fabric, config)
     stop_ev = threading.Event()
@@ -53,6 +61,8 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
 
     po.add_control_hook(on_control)
     po.start()
+    if advertise is not None:
+        announce_address(po, *advertise)
 
     role_obj = None
     if node.role is Role.SERVER:
@@ -91,6 +101,38 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
 
         role_obj = WorkerKVStore(po, config)
     return po, role_obj, stop_ev
+
+
+def announce_address(po: Postoffice, host: str, port: int,
+                     repeat_s: float = 5.0):
+    """Broadcast this node's replacement address to every peer, then
+    keep re-broadcasting every ``repeat_s`` from a background thread.
+
+    The repeat is what makes the announcement survive compound
+    failures: a peer that was down during (or restarted after) the
+    first broadcast rebuilds its plan from the STATIC addresses and
+    would otherwise dial the stale slot forever.  Receivers apply
+    updates idempotently, so the steady-state cost is a few 64-byte
+    messages per period.  Runs off the startup path — a down peer's
+    dial retry must not stall role construction."""
+    body = {"node": str(po.node), "host": host, "port": port}
+    peers = [n for n in po.topology.all_nodes() if str(n) != str(po.node)]
+
+    def broadcast_loop():
+        while True:
+            for n in peers:
+                domain = (Domain.LOCAL
+                          if n.party is not None and n.party == po.node.party
+                          else Domain.GLOBAL)
+                # van swallows delivery errors (down peers get the next
+                # round); sends to live peers are no-ops after the first
+                po.van.send(Message(recipient=n,
+                                    control=Control.ADDR_UPDATE,
+                                    domain=domain, body=body))
+            time.sleep(repeat_s)
+
+    threading.Thread(target=broadcast_loop, daemon=True,
+                     name=f"addr-announce-{po.node}").start()
 
 
 def shutdown_cluster(po: Postoffice):
@@ -162,6 +204,10 @@ def main(argv=None):
                     default=int(os.environ.get("GEOMX_NUM_GLOBAL_SERVERS", "1")))
     ap.add_argument("--base-port", type=int,
                     default=int(os.environ.get("GEOMX_BASE_PORT", "9200")))
+    ap.add_argument("--advertise", default=os.environ.get("GEOMX_ADVERTISE"),
+                    metavar="HOST:PORT",
+                    help="replacement node: bind+announce this address "
+                         "instead of the static plan's slot")
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--compression", default="none")
@@ -193,7 +239,14 @@ def main(argv=None):
     cfg.enable_inter_ts = args.tsengine_inter or cfg.enable_inter_ts
     cfg.sync_global_mode = (args.sync == "fsa") and cfg.sync_global_mode
     cfg.enable_dgt = args.dgt or cfg.enable_dgt
-    po, role_obj, stop_ev = build_runtime(node, cfg, args.base_port)
+    advertise = None
+    if args.advertise:
+        host, sep, port = args.advertise.rpartition(":")
+        if not sep or not port.isdigit():
+            ap.error(f"--advertise needs HOST:PORT, got {args.advertise!r}")
+        advertise = (host or "127.0.0.1", int(port))
+    po, role_obj, stop_ev = build_runtime(node, cfg, args.base_port,
+                                          advertise=advertise)
     print(f"{node}: up", flush=True)
     if node.role is Role.WORKER:
         _worker_demo(po, role_obj, args)

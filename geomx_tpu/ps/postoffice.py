@@ -236,6 +236,15 @@ class Postoffice:
         if msg.control is Control.BARRIER:
             self._handle_barrier(msg)
             return
+        if msg.control is Control.ADDR_UPDATE:
+            # a replacement node at a new host:port announced itself
+            # (ref: re-registration ADD_NODE van.cc:176-193; here the
+            # node broadcasts directly since the plan names every peer)
+            b = msg.body or {}
+            update = getattr(self.van.fabric, "update_address", None)
+            if update is not None:
+                update(b["node"], (b["host"], int(b["port"])))
+            return
         if msg.control is not Control.EMPTY:
             with self._lock:
                 hooks = list(self._control_hooks)

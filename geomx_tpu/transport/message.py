@@ -37,6 +37,9 @@ class Control(enum.Enum):
     REPLY = 8          # scheduler's answer
     AUTOPULL_REPLY = 9 # receiver confirms overlay delivery
     DEAD_NODES = 10    # query the scheduler's heartbeat table
+    ADDR_UPDATE = 11   # a replacement node announces its new address
+    #                    (ref: ADD_NODE re-registration van.cc:176-193;
+    #                    here plan-based — the node broadcasts directly)
 
 
 class Domain(enum.Enum):

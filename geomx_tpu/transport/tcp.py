@@ -235,6 +235,26 @@ class TcpFabric:
             self._established.add(dest)
         return conn
 
+    def update_address(self, node: str, addr: Tuple[str, int]) -> None:
+        """Re-point a peer's address (replacement node at a new
+        host:port).  Drops any live connection to the old address and
+        re-arms the bring-up dial window so the next send retries while
+        the replacement finishes starting."""
+        if node not in self.plan:
+            return  # unknown node: a stale broadcast from another epoch
+        with self._registry_mu:
+            if self.plan[node] == addr:
+                return
+            self.plan[node] = addr
+            conn = self._conns.pop(node, None)
+            self._established.discard(node)
+            self._dial_window.pop(node, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
     def shutdown(self):
         self._stop = True
         for srv in self._listeners:

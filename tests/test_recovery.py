@@ -181,6 +181,85 @@ def test_auto_checkpoint_written_and_resumable(tmp_path):
         sim.shutdown()
 
 
+def test_tcp_fabric_update_address():
+    """update_address re-points a peer and resets connection state."""
+    from geomx_tpu.transport.tcp import TcpFabric
+
+    plan = {"a": ("127.0.0.1", 1), "b": ("127.0.0.1", 2)}
+    fab = TcpFabric(dict(plan))
+    fab._established.add("b")
+    fab._dial_window["b"] = 123.0
+    fab.update_address("b", ("127.0.0.1", 99))
+    assert fab.plan["b"] == ("127.0.0.1", 99)
+    assert "b" not in fab._established and "b" not in fab._dial_window
+    fab.update_address("nobody", ("x", 1))  # unknown: ignored
+    fab.shutdown()
+
+
+@pytest.mark.slow
+def test_global_server_replacement_at_new_address(tmp_path):
+    """Kill the global server and bring its REPLACEMENT up at a
+    different port (--advertise): the address broadcast re-points every
+    peer's fabric and training completes (the reference's re-registration
+    broadcast, van.cc:176-193 — whose global tier is a TODO there)."""
+    topo = Topology(num_parties=1, workers_per_party=1)
+    import tests.test_tcp as ttcp
+
+    base = ttcp.free_base_port()
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu", "JAX_PLATFORM_NAME": "cpu",
+        "GEOMX_CHECKPOINT_DIR": str(tmp_path),
+        "GEOMX_AUTO_CKPT_UPDATES": "1",
+        "GEOMX_REQUEST_RETRY_S": "1.0",
+    })
+    cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def spawn(role, extra=()):
+        return subprocess.Popen(
+            [sys.executable, "-m", "geomx_tpu.launch", "--role", role,
+             "--parties", "1", "--workers", "1",
+             "--base-port", str(base), "--steps", "25", *extra],
+            cwd=cwd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+
+    roles = [str(n) for n in topo.all_nodes()]
+    gs_role = str(topo.global_servers()[0])
+    procs = {r: spawn(r) for r in roles}
+    try:
+        ckpt = tmp_path / "global_server_0.npz"
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not ckpt.exists():
+            time.sleep(0.1)
+        assert ckpt.exists(), "no auto-checkpoint appeared"
+        time.sleep(1.0)
+
+        procs[gs_role].send_signal(signal.SIGKILL)
+        procs[gs_role].wait(timeout=10)
+        new_port = ttcp.free_base_port()
+        procs[gs_role] = spawn(
+            gs_role, extra=["--advertise", f"127.0.0.1:{new_port}"])
+
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if all(p.poll() is not None for p in procs.values()):
+                break
+            time.sleep(0.5)
+        outputs = {}
+        for r, p in procs.items():
+            if p.poll() is None:
+                p.kill()
+            outputs[r] = p.communicate()[0]
+        worker_out = outputs[str(topo.workers(0)[0])]
+        assert "steps=25" in worker_out, worker_out[-2000:]
+        for r, p in procs.items():
+            assert p.returncode == 0, f"{r} rc={p.returncode}: {outputs[r][-800:]}"
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+
 @pytest.mark.slow
 def test_global_server_crash_restart_midtraining(tmp_path):
     """Full multiprocess topology over TCP: SIGKILL the global server
