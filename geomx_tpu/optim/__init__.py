@@ -1,3 +1,4 @@
 from geomx_tpu.optim.server_opt import (  # noqa: F401
-    ServerOptimizer, Sgd, Adam, DCASGD, make_optimizer,
+    AdaDelta, AdaGrad, Adam, DCASGD, Nag, RmsProp, ServerOptimizer, Sgd,
+    Signum, make_optimizer,
 )

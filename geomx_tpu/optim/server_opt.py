@@ -101,7 +101,92 @@ class DCASGD(ServerOptimizer):
         return new_w
 
 
-_REGISTRY = {"sgd": Sgd, "adam": Adam, "dcasgd": DCASGD}
+class Nag(ServerOptimizer):
+    """Nesterov accelerated SGD (ref: python/mxnet/optimizer/optimizer.py
+    class NAG)."""
+
+    def __init__(self, lr: float = 0.01, momentum: float = 0.9,
+                 wd: float = 0.0):
+        super().__init__(lr, wd)
+        self.momentum = momentum
+
+    def update(self, key, weight, grad):
+        g = grad + self.wd * weight
+        st = self._st(key, lambda: {"mom": np.zeros_like(weight)})
+        st["mom"] = self.momentum * st["mom"] + g
+        return weight - self.lr * (g + self.momentum * st["mom"])
+
+
+class RmsProp(ServerOptimizer):
+    """RMSProp (ref: optimizer.py class RMSProp, non-centered)."""
+
+    def __init__(self, lr: float = 0.01, rho: float = 0.9, eps: float = 1e-8,
+                 wd: float = 0.0):
+        super().__init__(lr, wd)
+        self.rho, self.eps = rho, eps
+
+    def update(self, key, weight, grad):
+        g = grad + self.wd * weight
+        st = self._st(key, lambda: {"v": np.zeros_like(weight)})
+        st["v"] = self.rho * st["v"] + (1 - self.rho) * g * g
+        return weight - self.lr * g / (np.sqrt(st["v"]) + self.eps)
+
+
+class AdaGrad(ServerOptimizer):
+    """AdaGrad (ref: optimizer.py class AdaGrad)."""
+
+    def __init__(self, lr: float = 0.01, eps: float = 1e-7, wd: float = 0.0):
+        super().__init__(lr, wd)
+        self.eps = eps
+
+    def update(self, key, weight, grad):
+        g = grad + self.wd * weight
+        st = self._st(key, lambda: {"h": np.zeros_like(weight)})
+        st["h"] += g * g
+        return weight - self.lr * g / (np.sqrt(st["h"]) + self.eps)
+
+
+class AdaDelta(ServerOptimizer):
+    """AdaDelta (ref: optimizer.py class AdaDelta) — no base lr."""
+
+    def __init__(self, lr: float = 1.0, rho: float = 0.9, eps: float = 1e-5,
+                 wd: float = 0.0):
+        super().__init__(lr, wd)
+        self.rho, self.eps = rho, eps
+
+    def update(self, key, weight, grad):
+        g = grad + self.wd * weight
+        st = self._st(key, lambda: {"acc_g": np.zeros_like(weight),
+                                    "acc_d": np.zeros_like(weight)})
+        st["acc_g"] = self.rho * st["acc_g"] + (1 - self.rho) * g * g
+        d = (np.sqrt(st["acc_d"] + self.eps)
+             / np.sqrt(st["acc_g"] + self.eps)) * g
+        st["acc_d"] = self.rho * st["acc_d"] + (1 - self.rho) * d * d
+        return weight - self.lr * d
+
+
+class Signum(ServerOptimizer):
+    """Momentum-sign SGD (ref: optimizer.py class Signum) — a natural fit
+    for WAN tiers: the update magnitude is bounded by lr regardless of
+    gradient scale."""
+
+    def __init__(self, lr: float = 0.01, momentum: float = 0.9,
+                 wd: float = 0.0):
+        super().__init__(lr, wd)
+        self.momentum = momentum
+
+    def update(self, key, weight, grad):
+        g = grad + self.wd * weight
+        if self.momentum > 0.0:
+            st = self._st(key, lambda: {"mom": np.zeros_like(weight)})
+            st["mom"] = self.momentum * st["mom"] + (1 - self.momentum) * g
+            g = st["mom"]
+        return weight - self.lr * np.sign(g)
+
+
+_REGISTRY = {"sgd": Sgd, "adam": Adam, "dcasgd": DCASGD, "nag": Nag,
+             "rmsprop": RmsProp, "adagrad": AdaGrad, "adadelta": AdaDelta,
+             "signum": Signum}
 
 
 def make_optimizer(config: dict) -> ServerOptimizer:
@@ -109,4 +194,10 @@ def make_optimizer(config: dict) -> ServerOptimizer:
     ``{"type": "adam", "lr": 0.01}``."""
     cfg = dict(config)
     typ = cfg.pop("type")
-    return _REGISTRY[typ](**cfg)
+    try:
+        cls = _REGISTRY[typ]
+    except KeyError:
+        raise ValueError(
+            f"unknown optimizer {typ!r}; choose from {sorted(_REGISTRY)}"
+        ) from None
+    return cls(**cfg)

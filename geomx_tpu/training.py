@@ -37,6 +37,7 @@ def run_worker_hfa(
     optimizer=None,
     barrier_init: bool = True,
     log_fn: Optional[Callable[[int, float, float], None]] = None,
+    params_out: Optional[dict] = None,
 ) -> List[Tuple[float, float]]:
     """HFA client loop (ref: examples/cnn_hfa.py): each worker runs a LOCAL
     optimizer for k1 steps, then pushes weight/num_workers (the local server
@@ -75,7 +76,84 @@ def run_worker_hfa(
         history.append((float(loss), float(acc)))
         if log_fn is not None:
             log_fn(step, float(loss), float(acc))
+    if params_out is not None:
+        params_out["params"] = params
     return history
+
+
+class Trainer:
+    """High-level fit/evaluate facade over the worker loop.
+
+    The reference's user surface is ``gluon.Trainer`` + ``Module.fit``
+    (ref: python/mxnet/gluon/trainer.py; module/base_module.py:410 fit —
+    bind/init/optimizer/metric handled for the user).  This wraps the
+    same ceremony: rank-0 control-plane configuration (optimizer to the
+    global tier, compression to the party server), init barrier, the
+    training loop (plain FSA or HFA), and streaming-metric evaluation.
+    """
+
+    def __init__(self, kv: WorkerKVStore, params, grad_fn: Callable,
+                 model=None, optimizer: Optional[dict] = None,
+                 compression: Optional[dict] = None,
+                 hfa_k1: Optional[int] = None):
+        self.kv = kv
+        self.params = params
+        self.grad_fn = grad_fn
+        self.model = model  # flax module; needed for evaluate()
+        self.hfa_k1 = hfa_k1
+        if (hfa_k1 is not None) != bool(kv.config.use_hfa):
+            # the HFA client loop pushes WEIGHTS, the plain loop pushes
+            # GRADIENTS — a mismatch with the servers' mode silently
+            # corrupts training (weights fed to the optimizer as grads)
+            raise ValueError(
+                "hfa_k1 must be set if and only if the cluster runs with "
+                f"use_hfa (got hfa_k1={hfa_k1!r}, "
+                f"config.use_hfa={kv.config.use_hfa})")
+        if kv.party == 0 and kv.rank == 0 and optimizer is not None:
+            kv.set_optimizer(optimizer)
+        if kv.rank == 0 and compression is not None:
+            kv.set_gradient_compression(compression)
+        kv.barrier()
+
+    def fit(self, data_iter: Iterable, steps: int,
+            log_fn: Optional[Callable[[int, float, float], None]] = None
+            ) -> List[Tuple[float, float]]:
+        """Train; returns [(loss, acc)] per step.  Updated params stay on
+        the trainer for evaluate()/further fits."""
+        captured: dict = {}
+        if self.hfa_k1 is not None:
+            hist = run_worker_hfa(self.kv, self.params, self.grad_fn,
+                                  data_iter, steps, k1=self.hfa_k1,
+                                  log_fn=log_fn, params_out=captured)
+        else:
+            hist = run_worker(self.kv, self.params, self.grad_fn,
+                              data_iter, steps, log_fn=log_fn,
+                              params_out=captured)
+        if "params" in captured:
+            self.params = captured["params"]
+        return hist
+
+    def evaluate(self, data_iter: Iterable, batches: int, metric=None):
+        """Forward `batches` batches through the model, streaming
+        (labels, probabilities) into `metric` (default Accuracy);
+        returns ``metric.get()`` — the reference's Module.score
+        (ref: module/base_module.py score + metric.py).  Logits are
+        softmaxed before the metric so probability-contract metrics
+        (CrossEntropy) are correct; argmax metrics are unaffected."""
+        from geomx_tpu.utils import metrics as _metrics
+
+        if self.model is None:
+            raise ValueError("evaluate() needs the model; pass it to "
+                             "Trainer(model=...)")
+        if metric is None:
+            metric = _metrics.Accuracy()
+        for i, (x, y) in enumerate(data_iter):
+            if i >= batches:
+                break
+            logits = self.model.apply(self.params, x)
+            probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+            metric.update(np.asarray(y), probs)
+        return metric.get()
 
 
 def run_worker(
@@ -87,6 +165,7 @@ def run_worker(
     normalize: bool = True,
     barrier_init: bool = True,
     log_fn: Optional[Callable[[int, float, float], None]] = None,
+    params_out: Optional[dict] = None,
 ) -> List[Tuple[float, float]]:
     """Train `steps` steps; returns [(loss, acc), ...] per step.
 
@@ -135,4 +214,6 @@ def run_worker(
         history.append((float(loss), float(acc)))
         if log_fn is not None:
             log_fn(step, float(loss), float(acc))
+    if params_out is not None:
+        params_out["params"] = params
     return history

@@ -1,1 +1,2 @@
 from geomx_tpu.utils.profiler import Profiler, get_profiler  # noqa: F401
+from geomx_tpu.utils import metrics  # noqa: F401

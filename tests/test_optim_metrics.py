@@ -1,0 +1,157 @@
+"""Server-optimizer family + metrics module + Trainer facade
+(ref surface: python/mxnet/optimizer/optimizer.py, metric.py,
+gluon/trainer.py + module/base_module.py fit/score)."""
+
+import jax
+import numpy as np
+import pytest
+
+from geomx_tpu.core.config import Config, Topology
+from geomx_tpu.kvstore import Simulation
+from geomx_tpu.optim import make_optimizer
+from geomx_tpu.utils import metrics
+
+
+W = np.full(8, 1.0, np.float32)
+G = np.full(8, 0.5, np.float32)
+
+
+@pytest.mark.parametrize("cfg,expected_first", [
+    ({"type": "sgd", "lr": 0.1}, W - 0.05),
+    ({"type": "nag", "lr": 0.1, "momentum": 0.9},
+     W - 0.1 * (G + 0.9 * G)),
+    ({"type": "rmsprop", "lr": 0.1, "rho": 0.9, "eps": 0.0},
+     W - 0.1 * G / np.sqrt(0.1 * G * G)),
+    ({"type": "adagrad", "lr": 0.1, "eps": 0.0},
+     W - 0.1 * G / np.abs(G)),
+    ({"type": "signum", "lr": 0.1, "momentum": 0.0}, W - 0.1),
+])
+def test_optimizer_first_step_math(cfg, expected_first):
+    opt = make_optimizer(cfg)
+    out = opt.update(0, W.copy(), G.copy())
+    np.testing.assert_allclose(out, expected_first, rtol=1e-6)
+
+
+@pytest.mark.parametrize("name", ["sgd", "adam", "nag", "rmsprop",
+                                  "adagrad", "adadelta", "signum"])
+def test_all_optimizers_descend(name):
+    """On f(w) = 0.5*w^2 every family must reduce |w|."""
+    opt = make_optimizer({"type": name, "lr": 0.05})
+    w = np.full(16, 2.0, np.float32)
+    for _ in range(50):
+        w = opt.update(0, w, w.copy())  # grad of 0.5 w^2 is w
+    assert np.all(np.abs(w) < 2.0)
+    assert np.all(np.isfinite(w))
+
+
+def test_make_optimizer_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        make_optimizer({"type": "lion9000"})
+
+
+def test_metrics_accuracy_and_topk():
+    acc = metrics.create("acc")
+    logits = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    acc.update(np.array([1, 0, 0]), logits)
+    assert acc.get() == ("accuracy", pytest.approx(2 / 3))
+    topk = metrics.TopKAccuracy(top_k=2)
+    topk.update(np.array([1, 0, 0]), logits)
+    assert topk.get()[1] == 1.0  # 2 classes → top-2 always hits
+
+
+def test_metrics_f1_regression_and_composite():
+    f1 = metrics.create("f1")
+    f1.update(np.array([1, 1, 0, 0]), np.array([1, 0, 1, 0]))
+    assert f1.get() == ("f1", pytest.approx(0.5))
+    mae = metrics.create("mae")
+    mae.update(np.array([1.0, 2.0]), np.array([2.0, 4.0]))
+    assert mae.get()[1] == pytest.approx(1.5)
+    rmse = metrics.create("rmse")
+    rmse.update(np.array([0.0, 0.0]), np.array([3.0, 4.0]))
+    assert rmse.get()[1] == pytest.approx(np.sqrt(12.5))
+    ce = metrics.create("ce")
+    ce.update(np.array([0]), np.array([[0.5, 0.5]]))
+    assert ce.get()[1] == pytest.approx(-np.log(0.5))
+    comp = metrics.CompositeEvalMetric([metrics.Accuracy(), metrics.F1()])
+    comp.update(np.array([1, 0]), np.array([1, 0]))
+    names, vals = comp.get()
+    assert names == ["accuracy", "f1"] and vals == [1.0, 1.0]
+    with pytest.raises(ValueError, match="unknown metric"):
+        metrics.create("bleu")
+
+
+def test_trainer_fit_and_evaluate():
+    """Trainer handles the full ceremony: configure, fit, evaluate."""
+    from geomx_tpu.data import ShardedIterator, synthetic_classification
+    from geomx_tpu.models import create_model_state
+    from geomx_tpu.training import Trainer
+
+    sim = Simulation(Config(topology=Topology(num_parties=1,
+                                              workers_per_party=1)))
+    try:
+        model, params, grad_fn = create_model_state(
+            "mlp", jax.random.PRNGKey(0), input_shape=(1, 8, 8, 1))
+        x, y = synthetic_classification(n=256, shape=(8, 8, 1), seed=0)
+        kv = sim.worker(0, 0)
+        trainer = Trainer(kv, params, grad_fn, model=model,
+                          optimizer={"type": "adam", "lr": 0.01})
+        it = ShardedIterator(x, y, 32, 0, 1)
+        hist = trainer.fit(it, steps=15)
+        assert len(hist) == 15
+        assert hist[-1][0] < hist[0][0]  # loss fell
+        name, val = trainer.evaluate(ShardedIterator(x, y, 64, 0, 1), 3)
+        assert name == "accuracy" and val > 0.5  # learnable templates
+    finally:
+        sim.shutdown()
+
+
+def test_topk_clamps_to_class_count():
+    topk = metrics.TopKAccuracy(top_k=5)
+    topk.update(np.array([1, 0]), np.array([[0.9, 0.1], [0.2, 0.8]]))
+    assert topk.get()[1] == 1.0  # k > classes → every label in top-k
+
+
+def test_trainer_rejects_hfa_mismatch():
+    from geomx_tpu.training import Trainer
+
+    sim = Simulation(Config(topology=Topology(num_parties=1,
+                                              workers_per_party=1)))
+    try:
+        with pytest.raises(ValueError, match="use_hfa"):
+            Trainer(sim.worker(0, 0), {}, lambda *a: None, hfa_k1=2)
+    finally:
+        sim.shutdown()
+
+
+def test_trainer_evaluate_cross_entropy_gets_probabilities():
+    """evaluate() softmaxes logits, so CrossEntropy values are sane
+    (positive, bounded by -log(eps))."""
+    from geomx_tpu.data import ShardedIterator, synthetic_classification
+    from geomx_tpu.models import create_model_state
+    from geomx_tpu.training import Trainer
+
+    sim = Simulation(Config(topology=Topology(num_parties=1,
+                                              workers_per_party=1)))
+    try:
+        model, params, grad_fn = create_model_state(
+            "mlp", jax.random.PRNGKey(0), input_shape=(1, 8, 8, 1))
+        x, y = synthetic_classification(n=64, shape=(8, 8, 1), seed=0)
+        t = Trainer(sim.worker(0, 0), params, grad_fn, model=model)
+        name, ce = t.evaluate(ShardedIterator(x, y, 32, 0, 1), 2,
+                              metric=metrics.create("ce"))
+        assert name == "cross-entropy" and 0.0 < ce < 30.0
+    finally:
+        sim.shutdown()
+
+
+def test_trainer_evaluate_requires_model():
+    from geomx_tpu.training import Trainer
+
+    sim = Simulation(Config(topology=Topology(num_parties=1,
+                                              workers_per_party=1)))
+    try:
+        t = Trainer(sim.worker(0, 0), {}, lambda *a: None)
+        with pytest.raises(ValueError, match="needs the model"):
+            t.evaluate(iter([]), 1)
+    finally:
+        sim.shutdown()
