@@ -1,3 +1,7 @@
 from geomx_tpu.ops.quantize import (  # noqa: F401
     quantize_2bit_tpu, dequantize_2bit_tpu, dgc_update_tpu,
 )
+from geomx_tpu.ops.int8 import (  # noqa: F401
+    dequantize, int8_matmul, make_quantized_mlp_apply,
+    quantize_dense_tree, quantize_symmetric,
+)

@@ -1,0 +1,102 @@
+"""INT8 post-training quantization for inference.
+
+The reference ships an INT8 quantization subsystem (ref:
+src/operator/quantization/ — quantize/dequantize/quantized_fully_connected
+/quantized_conv with calibration) targeting VNNI/cuDNN int8 paths.  The
+TPU-native equivalent targets the MXU's int8 systolic mode: weights are
+quantized ahead of time (symmetric per-output-channel int8 + f32 scales),
+activations dynamically per batch (symmetric per-tensor), and the matmul
+runs int8×int8→int32 via ``lax.dot_general`` with
+``preferred_element_type=int32`` — exactly the layout XLA lowers onto the
+MXU — then dequantizes into f32.
+
+Everything is functional and jit-friendly: no Python branching on data,
+static shapes throughout.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def quantize_symmetric(x: jax.Array, axis=None) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 quantization: returns (q, scale) with
+    ``x ≈ q * scale``.  ``axis`` keeps independent scales along that axis
+    (per-output-channel for weight matrices); None = per-tensor."""
+    absmax = jnp.max(jnp.abs(x), axis=axis, keepdims=axis is not None)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize(q: jax.Array, scale: jax.Array) -> jax.Array:
+    return q.astype(jnp.float32) * scale
+
+
+def int8_matmul(x: jax.Array, w_q: jax.Array, w_scale: jax.Array
+                ) -> jax.Array:
+    """``x @ w`` with dynamically-quantized activations.
+
+    x: [..., K] float; w_q: [K, N] int8 with per-column scales
+    w_scale: [1, N].  Accumulates in int32 (the MXU-native int8 path),
+    dequantizes with the product of both scales.
+    """
+    x_q, x_scale = quantize_symmetric(x)
+    acc = lax.dot_general(
+        x_q, w_q,
+        dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return acc.astype(jnp.float32) * x_scale * w_scale
+
+
+def quantize_dense_tree(params):
+    """Post-training quantization of a flax param tree: every 2-D kernel
+    becomes (int8 kernel, per-column scale); biases and the tree layout
+    are unchanged (ref: the calibration-then-convert flow of
+    quantization.py quantize_model).
+
+    Returns a tree of the same structure where each quantized kernel
+    leaf is a dict {"q": int8 [K,N], "scale": f32 [1,N]}."""
+
+    def convert(leaf):
+        if getattr(leaf, "ndim", None) == 2:  # jax OR numpy kernels
+            q, scale = quantize_symmetric(jnp.asarray(leaf), axis=0)
+            return {"q": q, "scale": scale}
+        return leaf
+
+    return jax.tree_util.tree_map(convert, params)
+
+
+def make_quantized_mlp_apply():
+    """Quantized-inference forward for the zoo MLP family.
+
+    The layout is the MLP's by construction — flatten, then
+    ``Dense_0..Dense_{n-1}`` with ReLU between (see
+    geomx_tpu/models/zoo.py MLP); every Dense runs through int8_matmul.
+
+    Usage::
+
+        _, params, _ = create_mlp_state(rng)
+        qtree = quantize_dense_tree(params)
+        q_apply = make_quantized_mlp_apply()
+        logits = q_apply(qtree, x)
+    """
+
+    def apply(qparams, x):
+        layers = qparams["params"]
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        n = len(layers)
+        for i in range(n):
+            lyr = layers[f"Dense_{i}"]
+            x = int8_matmul(x, lyr["kernel"]["q"], lyr["kernel"]["scale"])
+            x = x + lyr["bias"].astype(jnp.float32)
+            if i < n - 1:
+                x = jax.nn.relu(x)
+        return x
+
+    return apply
