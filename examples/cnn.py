@@ -59,6 +59,10 @@ def main():
     ap.add_argument("--tsengine-inter", action="store_true",
                     help="TSEngine WAN overlay (global servers -> local "
                          "servers replaces the FSA pull-down)")
+    ap.add_argument("--tsengine-inter-push", action="store_true",
+                    help="TSEngine WAN push overlay: local servers "
+                         "pair-merge before one elected server pushes up "
+                         "(implies --tsengine-inter)")
     ap.add_argument("--dgt", type=int, default=0, choices=[0, 1, 2, 3],
                     help="DGT transport mode (1=lossy channels, 2=reliable, 3=reliable+4bit requant)")
     ap.add_argument("--hfa", action="store_true")
@@ -90,7 +94,8 @@ def main():
         enable_p3=args.p3,
         p3_slice_elems=50_000,
         enable_intra_ts=args.tsengine,
-        enable_inter_ts=args.tsengine_inter,
+        enable_inter_ts=args.tsengine_inter or args.tsengine_inter_push,
+        enable_inter_ts_push=args.tsengine_inter_push,
         enable_dgt=args.dgt,
     )
     sim = Simulation(cfg)

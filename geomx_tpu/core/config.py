@@ -247,6 +247,10 @@ class Config:
     # under an async global tier, disseminate at most once per this many
     # pushes (per-push dissemination would flood the WAN overlay)
     inter_ts_async_every: int = 8
+    # inter-party push-direction overlay: local servers pair-merge their
+    # party gradients over the WAN before one elected server pushes to
+    # the global tier (ref: global ASK_PUSH van.cc:1254-1310)
+    enable_inter_ts_push: bool = False
 
     # --- DGT (ref: kv_app.h:841-850)
     enable_dgt: int = 0           # 0 off; 1 UDP-like lossy; 2 reliable; 3 reliable+requant
@@ -285,6 +289,16 @@ class Config:
             )
         if self.inter_ts_async_every < 1:
             raise ValueError("inter_ts_async_every must be >= 1")
+        if self.enable_inter_ts_push:
+            if not self.enable_inter_ts or not self.sync_global_mode:
+                raise ValueError(
+                    "enable_inter_ts_push requires enable_inter_ts with a "
+                    "synchronous global tier: non-elected servers finish "
+                    "their rounds via the pull-direction dissemination")
+            if self.use_hfa:
+                raise ValueError(
+                    "enable_inter_ts_push cannot combine with HFA "
+                    "(milestone deltas bypass the merge overlay)")
         if self.enable_p3 and self.enable_intra_ts:
             raise ValueError(
                 "enable_p3 and enable_intra_ts are mutually exclusive "
@@ -327,6 +341,7 @@ class Config:
             enable_inter_ts=_env_bool("GEOMX_ENABLE_INTER_TS", _env_bool("ENABLE_INTER_TS")),
             ts_max_greed_rate=_env_float("GEOMX_TS_GREED", _env_float("MAX_GREED_RATE_TS", 0.9)),
             inter_ts_async_every=_env_int("GEOMX_INTER_TS_ASYNC_EVERY", 8),
+            enable_inter_ts_push=_env_bool("GEOMX_ENABLE_INTER_TS_PUSH"),
             enable_dgt=_env_int("GEOMX_ENABLE_DGT", _env_int("ENABLE_DGT", 0)),
             dgt_block_size=_env_int("GEOMX_DGT_BLOCK_SIZE", _env_int("DGT_BLOCK_SIZE", 4096)),
             dgt_k=_env_float("GEOMX_DGT_K", _env_float("DMLC_K", 0.5)),

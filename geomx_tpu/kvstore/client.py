@@ -163,15 +163,16 @@ class WorkerKVStore:
         num_workers contributions).  Returns True if this worker was the
         elected pusher.  Blocks until this worker's overlay role is done."""
         assert self.ts_push is not None, "requires enable_intra_ts"
-        merged = self.ts_push.merge_push(grads)  # normalizes f32/flat itself
+        res = self.ts_push.merge_push(grads)  # normalizes f32/flat itself
         with self._mu:
             for tid in grads:
                 self._push_rounds[tid] = self._push_rounds.get(tid, 0) + 1
-        if merged is None:
+        if res is None:
             return False
+        merged, num_merge = res
         for tid, g in merged.items():
             self.push(tid, g.reshape(self._shapes[tid]),
-                      num_merge=self.num_workers, _count_round=False)
+                      num_merge=num_merge, _count_round=False)
         return True
 
     def pull(self, tid: int, cb: Callable[[int, np.ndarray], None],

@@ -140,6 +140,25 @@ class LocalServer:
 
             self.ts_inter = TsClient(
                 postoffice, topo.global_scheduler(), domain=Domain.GLOBAL)
+        # inter-party push overlay: pair-merge party gradients over the
+        # WAN before one elected server pushes up (ref: global ASK_PUSH
+        # van.cc:1254-1310; server-side WorkersMerge :228-310)
+        self.ts_push_inter = None
+        self._inter_push_round: Dict[int, int] = {}
+        if self.config.enable_inter_ts_push:
+            import queue as _queue
+
+            from geomx_tpu.sched.ts_push import TsPushWorker
+
+            self.ts_push_inter = TsPushWorker(
+                postoffice, topo.global_scheduler(), self.up,
+                domain=Domain.GLOBAL)
+            # merging blocks on WAN round-trips (ask → maybe wait for a
+            # peer's grads); it must run OFF the KVServer handler thread,
+            # which processes the incoming relays themselves
+            self._merge_q: "_queue.Queue" = _queue.Queue()
+            threading.Thread(target=self._inter_merge_loop, daemon=True,
+                             name=f"inter-merge-{postoffice.node}").start()
 
     # ---- request handling ---------------------------------------------------
     def _handle(self, msg: Message, kvs: Optional[KVPairs], server: KVServer):
@@ -157,6 +176,10 @@ class LocalServer:
         elif msg.cmd == Cmd.TS_AUTOPULL:
             with prof.span("local.ts_inter"):
                 self._on_inter_ts_delivery(msg, kvs)
+        elif self.ts_push_inter is not None and self._is_merge_relay(msg):
+            # a peer local server's contribution for the push overlay —
+            # routed here because the KVServer owns the PS app id
+            self.ts_push_inter._on_merge_msg(msg)
         elif msg.push:
             with prof.span("local.push"):
                 self._handle_push(msg, kvs)
@@ -381,7 +404,63 @@ class LocalServer:
                 self.store[k] = np.array(v, copy=True)
             self._finish_round(list(kvs.keys))
 
+    @staticmethod
+    def _is_merge_relay(msg: Message) -> bool:
+        from geomx_tpu.sched.ts_push import TS_PUSH_MERGE_CMD
+
+        return msg.cmd == TS_PUSH_MERGE_CMD
+
+    def _inter_merge_loop(self):
+        """Dispatch per-key inter-party merges, each on its own thread.
+
+        Concurrency is load-bearing, not an optimization: parties'
+        rounds complete in different key orders, so ANY cap below the
+        number of keys in flight can fill with disjoint key sets across
+        parties and head-of-line-deadlock (the reason a bounded pool is
+        wrong here).  Threads are bounded naturally by the model's key
+        count — each key has at most one merge in flight because rounds
+        of one key complete serially.  Per-key round tokens route each
+        thread's scheduler replies and relays (ref: the per-key ASK_PUSH
+        pairing of the global scheduler, van.cc:1254-1310)."""
+
+        def one_key(k: int, v: np.ndarray, rs: bool, token: str):
+            res = self.ts_push_inter.merge_push(
+                {k: np.asarray(v, np.float32)}, it=token)
+            if res is not None:
+                # elected (or degraded-to-direct on overlay failure) —
+                # push with however many contributions we actually hold;
+                # the global server accumulates counts across pushes
+                merged, nm = res
+                self._push_up_send(
+                    KVPairs(np.array([k], dtype=np.int64), merged[k],
+                            np.array([len(merged[k])], dtype=np.int64)),
+                    frozenset({k}) if rs else frozenset(),
+                    {"num_merge": nm})
+
+        while True:
+            job = self._merge_q.get()
+            if job is None:
+                return
+            kvs, rs_keys = job
+            for k, v in kvs.slices():
+                r = self._inter_push_round.get(k, 0) + 1
+                self._inter_push_round[k] = r
+                threading.Thread(
+                    target=one_key, args=(k, v.copy(), k in rs_keys,
+                                          f"{k}:{r}"),
+                    daemon=True, name=f"inter-merge-{self.po.node}-{k}",
+                ).start()
+
     def _push_up(self, kvs: KVPairs, rs_keys=frozenset()):
+        if self.ts_push_inter is not None:
+            # hand off to the merge thread (blocking WAN round-trips must
+            # not stall the handler thread that feeds the merge relays)
+            self._merge_q.put((kvs, rs_keys))
+            return
+        self._push_up_send(kvs, rs_keys, None)
+
+    def _push_up_send(self, kvs: KVPairs, rs_keys=frozenset(),
+                      push_body=None):
         if self._prof.running:
             self._prof.count("wan_rounds", 1.0)
         keys = [int(k) for k in kvs.keys]
@@ -441,7 +520,8 @@ class LocalServer:
             vals = np.concatenate([p for _, p in pairs])
             lens = np.array([len(p) for _, p in pairs], dtype=np.int64)
             self.up.zpush(KVPairs(ks, vals, lens), cmd=Cmd.DEFAULT,
-                          on_complete=one_group_acked, compr=tag)
+                          on_complete=one_group_acked, compr=tag,
+                          body=push_body)
 
     def _push_up_hfa(self, kvs: KVPairs):
         """K2 round: ship (mean_weights - milestone)/num_global_workers
@@ -619,6 +699,8 @@ class LocalServer:
             self.ts_client.stop()
         if self.ts_inter is not None:
             self.ts_inter.stop()
+        if self.ts_push_inter is not None:
+            self._merge_q.put(None)
         self.server.stop()
         self.up.stop()
 
@@ -754,6 +836,11 @@ class GlobalServer:
             # body must not degrade into a clean ACK on the replay)
             self.server.response(msg, body=self._recent.done_body(msg))
             return
+        # an inter-TS-merged push carries several parties' contributions
+        # (ref: num_merge counting in the global ASK_PUSH path)
+        num_merge = 1
+        if isinstance(msg.body, dict):
+            num_merge = int(msg.body.get("num_merge", 1))
         to_ack: List[tuple] = []  # (request, error-body | None)
         with self._mu:
             entry = [msg, {int(k) for k in kvs.keys}]
@@ -765,7 +852,7 @@ class GlobalServer:
                     st.accum = v.astype(np.float32, copy=True)
                 else:
                     st.accum += v
-                st.count += 1
+                st.count += num_merge
                 st.parked_pushes.append(entry)
                 if st.count >= self.num_contributors:
                     completed.append(k)

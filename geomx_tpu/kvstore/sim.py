@@ -46,11 +46,18 @@ class Simulation:
         if config.enable_inter_ts:
             from geomx_tpu.sched.tsengine import TsScheduler
 
+            gsched_po = self.offices[str(self.topology.global_scheduler())]
             self.ts_schedulers.append(TsScheduler(
-                self.offices[str(self.topology.global_scheduler())],
+                gsched_po,
                 members=self.topology.servers(),
                 greed_rate=config.ts_max_greed_rate,
             ))
+            if config.enable_inter_ts_push:
+                from geomx_tpu.sched.ts_push import TsPushScheduler
+
+                TsPushScheduler(
+                    gsched_po,
+                    num_workers=self.topology.num_global_workers)
         self.local_servers: List[LocalServer] = [
             LocalServer(self.offices[str(self.topology.server(p))], config)
             for p in range(self.topology.num_parties)

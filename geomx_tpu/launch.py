@@ -96,6 +96,11 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
 
         role_obj = TsScheduler(po, config.topology.servers(),
                                greed_rate=config.ts_max_greed_rate)
+        if config.enable_inter_ts_push:
+            from geomx_tpu.sched.ts_push import TsPushScheduler
+
+            TsPushScheduler(
+                po, num_workers=config.topology.num_global_workers)
     elif node.role is Role.WORKER:
         from geomx_tpu.kvstore.client import WorkerKVStore
 
@@ -215,6 +220,7 @@ def main(argv=None):
     ap.add_argument("--p3", action="store_true")
     ap.add_argument("--tsengine", action="store_true")
     ap.add_argument("--tsengine-inter", action="store_true")
+    ap.add_argument("--tsengine-inter-push", action="store_true")
     ap.add_argument("--sync", default="fsa", choices=["fsa", "mixed"])
     ap.add_argument("--dgt", type=int, default=0, choices=[0, 1, 2, 3])
     args = ap.parse_args(argv)
@@ -236,9 +242,15 @@ def main(argv=None):
     cfg.use_hfa = args.hfa or cfg.use_hfa
     cfg.enable_p3 = args.p3 or cfg.enable_p3
     cfg.enable_intra_ts = args.tsengine or cfg.enable_intra_ts
-    cfg.enable_inter_ts = args.tsengine_inter or cfg.enable_inter_ts
+    cfg.enable_inter_ts = (args.tsengine_inter or args.tsengine_inter_push
+                           or cfg.enable_inter_ts)
+    cfg.enable_inter_ts_push = (args.tsengine_inter_push
+                                or cfg.enable_inter_ts_push)
     cfg.sync_global_mode = (args.sync == "fsa") and cfg.sync_global_mode
     cfg.enable_dgt = args.dgt or cfg.enable_dgt
+    # CLI overrides bypass dataclass construction — re-run the invariant
+    # checks so invalid combinations fail here, not as a runtime hang
+    cfg.__post_init__()
     advertise = None
     if args.advertise:
         host, sep, port = args.advertise.rpartition(":")

@@ -56,7 +56,7 @@ class TsPushScheduler:
         if msg.control is not Control.ASK_PUSH:
             return False
         body = msg.body or {}
-        it = int(body.get("iter", 0))
+        it = body.get("iter", 0)  # any hashable round token (int or str)
         nm = int(body.get("num_merge", 1))
         replies = []
         now = _time.monotonic()
@@ -85,6 +85,9 @@ class TsPushScheduler:
             else:
                 pend.append((msg, nm, now))
         for req, body_out in replies:
+            # echo the round token so concurrent per-key merges on one
+            # node can route the reply to the right waiter
+            body_out["iter"] = it
             self.po.van.send(req.reply_to(control=Control.REPLY,
                                           body=body_out))
         return True
@@ -104,7 +107,10 @@ class TsPushWorker:
         self.scheduler = scheduler
         self.domain = domain
         self._cv = threading.Condition()
-        self._reply: Optional[dict] = None
+        # per-round-token state so several merges (one per key) can run
+        # concurrently on this node without stealing each other's
+        # replies/relays
+        self._replies: Dict[object, dict] = {}
         self._incoming: List[Tuple[dict, dict]] = []  # (grads, body)
         self._iter = 0
         postoffice.add_control_hook(self._on_control)
@@ -123,40 +129,60 @@ class TsPushWorker:
         kv_worker.ts_handler = dispatch
 
     # ---- control ------------------------------------------------------------
+    _STALE_S = 120.0  # tokens are never re-asked; entries older than any
+    #                   possible waiter are garbage from aborted rounds
+
+    def _prune_locked(self):
+        import time as _time
+
+        now = _time.monotonic()
+        for k in [k for k, (_, t) in self._replies.items()
+                  if now - t > self._STALE_S]:
+            del self._replies[k]
+        self._incoming = [e for e in self._incoming
+                          if now - e[2] <= self._STALE_S]
+
     def _on_control(self, msg: Message) -> bool:
+        import time as _time
+
         if msg.control is Control.REPLY and isinstance(msg.body, dict) \
                 and "action" in msg.body:
             with self._cv:
-                self._reply = msg.body
+                self._prune_locked()
+                self._replies[msg.body.get("iter")] = (msg.body,
+                                                       _time.monotonic())
                 self._cv.notify_all()
             return True
         return False
 
-    def _ask(self, it: int, num_merge: int, timeout: float = 30.0) -> dict:
+    def _ask(self, it, num_merge: int, timeout: float = 30.0) -> dict:
         with self._cv:
-            self._reply = None
+            self._replies.pop(it, None)
         self.po.van.send(Message(
             recipient=self.scheduler, control=Control.ASK_PUSH,
             domain=self.domain, body={"iter": it, "num_merge": num_merge}))
         with self._cv:
-            ok = self._cv.wait_for(lambda: self._reply is not None,
+            ok = self._cv.wait_for(lambda: it in self._replies,
                                    timeout=timeout)
             if not ok:
                 raise TimeoutError(f"{self.po.node}: ASK_PUSH timed out")
-            return self._reply
+            return self._replies.pop(it)[0]
 
     # ---- data plane ---------------------------------------------------------
     def _on_merge_msg(self, msg: Message):
+        import time as _time
+
         grads = {}
         off = 0
         for tid, ln in zip(msg.keys, msg.lens):
             grads[int(tid)] = np.array(msg.vals[off:off + ln], copy=True)
             off += ln
         with self._cv:
-            self._incoming.append((grads, msg.body or {}))
+            self._prune_locked()
+            self._incoming.append((grads, msg.body or {}, _time.monotonic()))
             self._cv.notify_all()
 
-    def _send_grads(self, peer: NodeId, grads: dict, num_merge: int, it: int):
+    def _send_grads(self, peer: NodeId, grads: dict, num_merge: int, it):
         tids = sorted(grads)
         keys = np.array(tids, dtype=np.int64)
         vals = np.concatenate([grads[t].ravel() for t in tids])
@@ -168,34 +194,64 @@ class TsPushWorker:
             body={"iter": it, "num_merge": num_merge},
         ))
 
-    def _wait_incoming(self, timeout: float = 30.0) -> Tuple[dict, dict]:
+    def _wait_incoming(self, it, timeout: float = 30.0) -> Tuple[dict, dict]:
+        def find():
+            for i, (_, body, _t) in enumerate(self._incoming):
+                if body.get("iter") == it:
+                    return i
+            return None
+
         with self._cv:
-            ok = self._cv.wait_for(lambda: len(self._incoming) > 0,
+            ok = self._cv.wait_for(lambda: find() is not None,
                                    timeout=timeout)
             if not ok:
-                raise TimeoutError(f"{self.po.node}: merge relay never arrived")
-            return self._incoming.pop(0)
+                raise TimeoutError(f"{self.po.node}: merge relay for round "
+                                   f"{it!r} never arrived")
+            grads, body, _ = self._incoming.pop(find())
+            return grads, body
 
     # ---- public -------------------------------------------------------------
-    def merge_push(self, grads: Dict[int, np.ndarray]) -> Optional[dict]:
-        """Join this round's merge tree.  Returns the fully-merged gradient
-        set if this worker was elected to push to the server, else None."""
-        self._iter += 1
-        it = self._iter
+    def merge_push(self, grads: Dict[int, np.ndarray],
+                   it=None) -> Optional[Tuple[dict, int]]:
+        """Join this round's merge tree.  Returns ``(merged_grads,
+        num_merge)`` if this worker must push to the server, else None
+        (our contribution rides with a peer).
+
+        ``it`` is the round token participants pair on; default is a
+        per-worker call counter (correct when all participants call in
+        lockstep, the worker-loop case).  Callers whose rounds complete
+        in differing batch orders (the inter-party server case) must pass
+        an explicit per-key token instead.
+
+        Degradation: if the scheduler or an expected peer goes silent
+        (TimeoutError), the holder pushes what it has with its partial
+        ``num_merge`` — the server accumulates counts across pushes, so
+        two partial pushes still complete the round exactly; only a
+        contribution in flight to a dead node is lost (and then the
+        request-replay layer is the recovery path)."""
+        if it is None:
+            self._iter += 1
+            it = self._iter
         grads = {t: np.asarray(g, np.float32).ravel() for t, g in grads.items()}
         num_merge = 1
         while True:
-            reply = self._ask(it, num_merge)
+            try:
+                reply = self._ask(it, num_merge)
+            except TimeoutError:
+                return grads, num_merge  # scheduler gone: push direct
             action = reply["action"]
             if action == "server":
-                return grads
+                return grads, num_merge
             if action == "send":
                 self._send_grads(NodeId.parse(reply["peer"]), grads,
                                  num_merge, it)
                 return None
             # recv: wait for the peer's set, merge (ref: WorkersMerge —
             # elementwise sum of contributions), carry the summed count
-            peer_grads, body = self._wait_incoming()
+            try:
+                peer_grads, body = self._wait_incoming(it)
+            except TimeoutError:
+                return grads, num_merge  # peer gone: push what we hold
             for t, g in peer_grads.items():
                 grads[t] = grads.get(t, 0) + g
             num_merge += int(body.get("num_merge", 1))

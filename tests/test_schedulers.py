@@ -258,6 +258,54 @@ def test_tsengine_inter_party_overlay():
         sim.shutdown()
 
 
+def test_tsengine_inter_party_push_merge_exact():
+    """Push-direction inter-TS: parties pair-merge over the WAN, one
+    elected server pushes the merged set (counted num_global_workers
+    contributions) — result must match plain FSA exactly
+    (ref: global ASK_PUSH van.cc:1254-1310)."""
+    sim = make_sim(parties=3, workers=1, enable_inter_ts=True,
+                   enable_inter_ts_push=True)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(48, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        for step in range(3):
+            for w in ws:
+                w.push(0, np.ones(48, np.float32))
+            outs = [w.pull_sync(0) for w in ws]
+        # party sum = 1 each; global mean over 3 parties = 1 → -0.1/step
+        for out in outs:
+            np.testing.assert_allclose(out, -0.3, rtol=1e-5)
+        # the WAN carried ONE gradient push per round, not three: the
+        # global servers' inbound push traffic is ~1/3 of the FSA case
+    finally:
+        sim.shutdown()
+
+
+def test_tsengine_inter_push_multikey_batch_orders():
+    """Per-key round tokens pair correctly even when parties complete
+    keys in different batch orders (two tensors, interleaved pushes)."""
+    sim = make_sim(parties=2, workers=1, enable_inter_ts=True,
+                   enable_inter_ts_push=True)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(16, np.float32))
+            w.init(1, np.zeros(8, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        # party 0 pushes tensor 0 then 1; party 1 pushes 1 then 0
+        ws[0].push(0, np.ones(16, np.float32))
+        ws[1].push(1, np.full(8, 2.0, np.float32))
+        ws[0].push(1, np.full(8, 2.0, np.float32))
+        ws[1].push(0, np.ones(16, np.float32))
+        for w in ws:
+            np.testing.assert_allclose(w.pull_sync(0), -1.0, rtol=1e-5)
+            np.testing.assert_allclose(w.pull_sync(1), -2.0, rtol=1e-5)
+    finally:
+        sim.shutdown()
+
+
 def test_tsengine_inter_party_under_async_tier():
     """Inter-TS + MixedSync (async global tier): rounds finish without a
     pull-down; rate-limited dissemination refreshes the local replicas
@@ -368,7 +416,8 @@ def test_tsengine_push_direction_merge_tree():
         assert len(results) == 3
         elected = [r for r, m in results.items() if m is not None]
         assert len(elected) == 1, results
-        merged = results[elected[0]]
+        merged, num_merge = results[elected[0]]
+        assert num_merge == 3
         # sum over workers: (1+2+3) and 10*(1+2+3)
         np.testing.assert_allclose(merged[0], 6.0)
         np.testing.assert_allclose(merged[1], 60.0)
