@@ -31,10 +31,17 @@ _DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
 
 
 class RecordWriter:
-    """Append-only record file writer (cold path — plain Python)."""
+    """Append-only record file writer (cold path — plain Python).
+    Accepts a path or an open binary file object (not closed on exit —
+    the atomic_write context manages it)."""
 
-    def __init__(self, path: str):
-        self._f = open(path, "wb")
+    def __init__(self, path_or_file):
+        if hasattr(path_or_file, "write"):
+            self._f = path_or_file
+            self._owns = False
+        else:
+            self._f = open(path_or_file, "wb")
+            self._owns = True
 
     def write(self, payload: bytes) -> None:
         if len(payload) > _LEN_MASK:
@@ -46,7 +53,8 @@ class RecordWriter:
             self._f.write(b"\x00" * pad)
 
     def close(self) -> None:
-        self._f.close()
+        if self._owns:
+            self._f.close()
 
     def __enter__(self):
         return self
@@ -147,12 +155,9 @@ def write_array_dataset(path: str, x: np.ndarray, y: np.ndarray) -> None:
     The write is atomic (temp file + rename): an interrupted or
     concurrent writer can never leave a truncated file at ``path`` for
     later runs to trip over."""
-    tmp = f"{path}.tmp-{os.getpid()}"
-    try:
-        with RecordWriter(tmp) as w:
-            for xi, yi in zip(x, y):
-                w.write(pack_array(xi, float(yi)))
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+    from geomx_tpu.utils.io import atomic_write
+
+    with atomic_write(path) as f:
+        w = RecordWriter(f)
+        for xi, yi in zip(x, y):
+            w.write(pack_array(xi, float(yi)))

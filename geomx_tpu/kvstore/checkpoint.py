@@ -12,13 +12,12 @@ good checkpoint.
 
 from __future__ import annotations
 
-import io
-import os
 import pickle
-import tempfile
 from typing import Dict
 
 import numpy as np
+
+from geomx_tpu.utils.io import atomic_write
 
 
 def save_server_state(path: str, store: Dict[int, np.ndarray],
@@ -30,17 +29,8 @@ def save_server_state(path: str, store: Dict[int, np.ndarray],
         pickle.dumps(optimizer_state, protocol=4), dtype=np.uint8)
     payload["__meta__"] = np.frombuffer(
         pickle.dumps(meta, protocol=4), dtype=np.uint8)
-    d = os.path.dirname(os.path.abspath(path)) or "."
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **payload)
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    with atomic_write(path) as f:
+        np.savez(f, **payload)
 
 
 def load_server_state(path: str):

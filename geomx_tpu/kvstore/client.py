@@ -101,7 +101,8 @@ class WorkerKVStore:
             self._pending.append(ts)
 
     # ---- public API ---------------------------------------------------------
-    def init(self, tid: int, value: np.ndarray, barrier: bool = False):
+    def init(self, tid: int, value: np.ndarray, barrier: bool = False,
+             overwrite: bool = False):
         """Initialize a tensor. Call on every worker; rank-0 of each party
         does the actual send (ref: kvstore_dist.h:300-330 InitImpl — only
         rank 0 pushes init, others wait on barrier).
@@ -109,15 +110,46 @@ class WorkerKVStore:
         Unlike the reference (where each worker is an OS process and
         InitImpl always barriers), the barrier is opt-in: single-threaded
         simulations drive all workers from one thread and must skip it;
-        threaded/multi-process workers should pass ``barrier=True``."""
+        threaded/multi-process workers should pass ``barrier=True``.
+
+        ``overwrite`` replaces the servers' value even if the key exists
+        (checkpoint restore onto a live cluster).  Only call it between
+        rounds — an overwrite racing an in-flight aggregation round
+        mixes old- and new-weight gradients."""
         value = np.asarray(value)
         self._shapes[tid] = value.shape
         self._dtypes[tid] = value.dtype
         if self.rank == 0:
             flat = value.astype(np.float32).ravel()
-            self.worker.zpush(self._encode(tid, flat), cmd=Cmd.INIT, wait=True)
+            body = {"overwrite": True} if overwrite else None
+            self.worker.zpush(self._encode(tid, flat), cmd=Cmd.INIT,
+                              wait=True, body=body)
         if barrier:
             self.barrier()
+
+    def init_all(self, values: Dict[int, np.ndarray],
+                 overwrite: bool = False):
+        """Batch init of many tensors in ONE request per server — used by
+        checkpoint restore so a 50-leaf model costs one round trip (and
+        one server-side compressor rebuild / baseline checkpoint), not
+        fifty."""
+        pairs = []  # (ps_key, payload)
+        for tid in sorted(values):
+            v = np.asarray(values[tid])
+            self._shapes[tid] = v.shape
+            self._dtypes[tid] = v.dtype
+            if self.rank == 0:
+                kvs = self._encode(tid, v.astype(np.float32).ravel())
+                pairs.extend((int(k), np.array(p)) for k, p in kvs.slices())
+        if self.rank != 0 or not pairs:
+            return
+        pairs.sort(key=lambda p: p[0])
+        body = {"overwrite": True} if overwrite else None
+        self.worker.zpush(KVPairs(
+            np.array([k for k, _ in pairs], dtype=np.int64),
+            np.concatenate([p for _, p in pairs]),
+            np.array([len(p) for _, p in pairs], dtype=np.int64),
+        ), cmd=Cmd.INIT, wait=True, body=body)
 
     def _on_ts_relay(self, msg):
         """Receive an overlay relay: buffer the model, confirm delivery,

@@ -71,7 +71,7 @@ class _KeyState:
     """Per-ps-key aggregation state on the local server."""
 
     __slots__ = ("accum", "count", "parked_pulls", "in_flight", "version",
-                 "round", "row_sparse")
+                 "round", "row_sparse", "epoch")
 
     def __init__(self):
         self.accum: Optional[np.ndarray] = None
@@ -81,6 +81,9 @@ class _KeyState:
         self.version = 0         # completed rounds (local or global)
         self.round = 0           # completed aggregation rounds (HFA K2 gate)
         self.row_sparse = False  # merged grad is mostly-zero rows
+        self.epoch = 0           # bumped by overwrite-inits: a pull-down
+        #                          from before the bump must not clobber
+        #                          the restored value of THIS key
 
 
 class LocalServer:
@@ -190,27 +193,53 @@ class LocalServer:
                 self._handle_pull(msg, kvs)
 
     def _handle_init(self, msg: Message, kvs: KVPairs):
+        # replay dedup: a replayed overwrite-init re-applied after
+        # training resumed would silently revert the store (plain init
+        # replay was idempotent; overwrite replay is destructive)
+        state = self._recent.check(msg)
+        if state == "pending":
+            return
+        if state == "done":
+            self.server.response(msg, body=self._recent.done_body(msg))
+            return
+        overwrite = bool(isinstance(msg.body, dict)
+                         and msg.body.get("overwrite"))
         with self._mu:
             fresh = []
             for k, v in kvs.slices():
-                if k not in self.store:
+                if k not in self.store or overwrite:
                     self.store[k] = np.array(v, copy=True)
                     self._milestone[k] = np.array(v, copy=True)
                     st = self._keys.setdefault(k, _KeyState())
+                    if overwrite:
+                        # abort THIS key's in-flight round: drop the
+                        # aggregation state, and invalidate any pull-down
+                        # still in flight for the old weights (epoch)
+                        st.accum = None
+                        st.count = 0
+                        st.in_flight = False
+                        st.epoch += 1
                     fresh.append((k, v))
             # pulls that raced ahead of init can be servable now
             for k, _ in fresh:
                 self._drain_parked_locked(self._keys[k])
         if fresh:
-            # forward first-seen inits up; ack the worker once tier 2 has them
+            # forward first-seen (or overwritten) inits up; ack the
+            # worker once tier 2 has them
             ks = np.array([k for k, _ in fresh], dtype=np.int64)
             vals = np.concatenate([v for _, v in fresh])
             lens = np.array([len(v) for _, v in fresh], dtype=np.int64)
+            def ack():
+                self._recent.mark_done(msg)
+                self.server.response(msg)
+
             self.up.zpush(
                 KVPairs(ks, vals, lens), cmd=Cmd.INIT,
-                on_complete=lambda: self.server.response(msg),
+                on_complete=ack,
+                body=msg.body if overwrite else None,
             )
         else:
+            self._recent.mark_done(msg)
             self.server.response(msg)
 
     def _handle_push(self, msg: Message, kvs: KVPairs):
@@ -465,6 +494,10 @@ class LocalServer:
             self._prof.count("wan_rounds", 1.0)
         keys = [int(k) for k in kvs.keys]
 
+        with self._mu:
+            epochs = {k: self._keys[k].epoch for k in keys
+                      if k in self._keys}
+
         def pull_down():
             # all global shards applied the update → pull fresh weights
             # (ref: DataHandlePushResponseDefault :941-957).  Under
@@ -477,7 +510,8 @@ class LocalServer:
                     with self._mu:
                         self._finish_round(keys)
                 return
-            self.up.zpull(keys, cb=self._on_pull_down)
+            self.up.zpull(keys,
+                          cb=lambda kvs: self._on_pull_down(kvs, epochs))
 
         # group keys by wire codec so each message has a uniform payload
         # dtype + compr tag (ref: PushCompressed kvstore_dist.h:530-563)
@@ -541,20 +575,30 @@ class LocalServer:
             out = KVPairs(np.array(ks, dtype=np.int64), np.concatenate(vs),
                           np.array(ls, dtype=np.int64))
         keys = [int(k) for k in out.keys]
+        with self._mu:
+            epochs = {k: self._keys[k].epoch for k in keys
+                      if k in self._keys}
 
         def on_acked():
-            self.up.zpull(keys, cb=self._on_pull_down_hfa, cmd=Cmd.HFA_DELTA)
+            self.up.zpull(keys,
+                          cb=lambda kvs: self._on_pull_down_hfa(kvs, epochs),
+                          cmd=Cmd.HFA_DELTA)
 
         self.up.zpush(out, cmd=Cmd.HFA_DELTA, on_complete=on_acked)
 
-    def _on_pull_down_hfa(self, kvs: KVPairs):
+    def _on_pull_down_hfa(self, kvs: KVPairs, epochs: Optional[dict] = None):
         tags = kvs.tags or {}
         with self._mu:
+            live = []
             for k, v in kvs.slices():
+                if (epochs is not None and k in self._keys
+                        and self._keys[k].epoch != epochs.get(k)):
+                    continue  # aborted by a restore
                 new_w = self._decode_pull_value(k, v, tags.get(k, ""))
                 self.store[k] = new_w
                 self._milestone[k] = np.array(new_w, copy=True)
-            self._finish_round([int(k) for k in kvs.keys])
+                live.append(k)
+            self._finish_round(live)
 
     def _decode_pull_value(self, k: int, v: np.ndarray, tag: str) -> np.ndarray:
         """Decode one pull-down slab into the new full weight vector.
@@ -571,14 +615,23 @@ class LocalServer:
             return np.ascontiguousarray(v).view(np.float16).astype(np.float32)
         return np.array(v, copy=True)
 
-    def _on_pull_down(self, kvs: KVPairs):
+    def _on_pull_down(self, kvs: KVPairs, epochs: Optional[dict] = None):
         """Updated weights arrived from tier 2 — possibly compressed
-        (ref: DataHandlePullResponseDefault :974-1169)."""
+        (ref: DataHandlePullResponseDefault :974-1169).  Keys whose
+        epoch moved since the round started were checkpoint-restored
+        mid-flight: skip them (their round was aborted and their parked
+        pulls already drained); the rest finish normally."""
         tags = kvs.tags or {}
         with self._mu:
+            live = []
             for k, v in kvs.slices():
+                if (epochs is not None
+                        and k in self._keys
+                        and self._keys[k].epoch != epochs.get(k)):
+                    continue  # aborted by a restore
                 self.store[k] = self._decode_pull_value(k, v, tags.get(k, ""))
-            self._finish_round([int(k) for k in kvs.keys])
+                live.append(k)
+            self._finish_round(live)
 
     def _finish_round(self, keys: List[int]):
         """Unblock keys and retry their parked pulls; must hold self._mu."""
@@ -774,21 +827,54 @@ class GlobalServer:
     def _handle_inner(self, msg: Message, kvs: Optional[KVPairs],
                       server: KVServer):
         if msg.cmd == Cmd.INIT:
+            state = self._recent.check(msg)
+            if state == "pending":
+                return
+            if state == "done":
+                server.response(msg, body=self._recent.done_body(msg))
+                return
+            overwrite = bool(isinstance(msg.body, dict)
+                             and msg.body.get("overwrite"))
+            stale_acks: List[Message] = []
             with self._mu:
                 fresh = False
                 for k, v in kvs.slices():
-                    if k not in self.store:
+                    if k not in self.store or overwrite:
                         fresh = True
                         self.store[k] = np.array(v, copy=True)
-                        self._keys[k] = _GlobalKeyState()
-                        if self.pull_comp is not None:
-                            self.pull_comp.ensure_base(int(k), v)
+                        st = self._keys.setdefault(k, _GlobalKeyState())
+                        if overwrite:
+                            # a restore ABORTS in-flight rounds: drop the
+                            # aggregation state AND the abandoned
+                            # optimizer trajectory (momentum/Adam moments
+                            # from the discarded run would drag the
+                            # restored weights right back), and ack any
+                            # parked pushers so no party wedges waiting
+                            # for a round that will never complete
+                            st.accum = None
+                            st.count = 0
+                            self.optimizer.state.pop(k, None)
+                            for ent in st.parked_pushes:
+                                ent[1].discard(k)
+                                if not ent[1]:
+                                    stale_acks.append(ent[0])
+                            st.parked_pushes.clear()
                         # init may race ahead of early pulls
                         self._serve_parked_pulls_locked(int(k))
+                if fresh and overwrite and self.pull_comp is not None:
+                    # subscriber base views track the OLD weights — rebuild
+                    self._apply_compression_locked(self.compression)
+                elif fresh and self.pull_comp is not None:
+                    for k, v in kvs.slices():
+                        self.pull_comp.ensure_base(int(k), v)
                 if fresh:
                     # force a baseline checkpoint: a crash before the
                     # first periodic one must still restore the key set
                     self._auto_ckpt_locked(force=True)
+            for req in stale_acks:
+                self._recent.mark_done(req)
+                self.server.response(req)
+            self._recent.mark_done(msg)
             server.response(msg)
             return
         if msg.push and msg.compr and kvs is not None:

@@ -18,6 +18,29 @@ import numpy as np
 from geomx_tpu.kvstore.client import WorkerKVStore
 
 
+def save_params(path: str, params) -> None:
+    """Client-side parameter checkpoint (ref: gluon save_parameters /
+    Module save_checkpoint — python/mxnet/gluon/block.py,
+    module/module.py).  Atomic write; msgpack via flax serialization, so
+    the tree structure restores without a template."""
+    from flax import serialization
+
+    from geomx_tpu.utils.io import atomic_write
+
+    data = serialization.msgpack_serialize(
+        jax.tree_util.tree_map(np.asarray, params))
+    with atomic_write(path) as f:
+        f.write(data)
+
+
+def load_params(path: str):
+    """Inverse of :func:`save_params`."""
+    from flax import serialization
+
+    with open(path, "rb") as f:
+        return serialization.msgpack_restore(f.read())
+
+
 def flatten_params(params) -> Tuple[List[np.ndarray], object]:
     leaves, treedef = jax.tree_util.tree_flatten(params)
     return [np.asarray(x) for x in leaves], treedef
@@ -132,6 +155,26 @@ class Trainer:
         if "params" in captured:
             self.params = captured["params"]
         return hist
+
+    def save(self, path: str) -> None:
+        """Persist the current params (ref: Module save_checkpoint)."""
+        save_params(path, self.params)
+
+    def load(self, path: str) -> None:
+        """Restore params AND propagate them to the servers (overwrite
+        init) — on an already-initialized cluster a local-only load
+        would be silently discarded at the first sync.
+
+        Call collectively on every worker of every party, between fits
+        (fit() completes all its rounds before returning, so nothing is
+        in flight then).  The barrier is party-local; across parties the
+        overwrites commute because every party restores the same file —
+        the worst cross-party race discards one racing round's gradient
+        (equivalent to joining that round one step late)."""
+        self.params = load_params(path)
+        leaves, _ = flatten_params(self.params)
+        self.kv.init_all(dict(enumerate(leaves)), overwrite=True)
+        self.kv.barrier()
 
     def evaluate(self, data_iter: Iterable, batches: int, metric=None):
         """Forward `batches` batches through the model, streaming

@@ -1,0 +1,26 @@
+"""Shared filesystem helpers."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+
+
+@contextlib.contextmanager
+def atomic_write(path: str, mode: str = "wb"):
+    """Write-then-rename: the file at ``path`` is either the previous
+    version or the complete new one, never a torn write.  Creates parent
+    directories.  Used by every on-disk artifact (checkpoints, param
+    saves, record datasets)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, mode) as f:
+            yield f
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
