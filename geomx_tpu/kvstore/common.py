@@ -42,7 +42,11 @@ class RecentRequests:
 
     @staticmethod
     def _key(msg):
-        return (str(msg.sender), msg.app_id, msg.customer_id, msg.timestamp)
+        # boot = sender incarnation nonce: a replaced node's timestamps
+        # restart at 0; without it the replacement's fresh requests would
+        # be re-acked as replays of its predecessor's (advisor r1)
+        return (str(msg.sender), msg.boot, msg.app_id, msg.customer_id,
+                msg.timestamp)
 
     def check(self, msg) -> str:
         k = self._key(msg)

@@ -1050,11 +1050,20 @@ class GlobalServer:
         st = self._keys.get(key)
         if not st:
             return
-        ready = [m for m in st.parked_pulls
-                 if all(int(k) in self.store for k in m.keys)]
-        for m in ready:
-            st.parked_pulls.remove(m)
-            self._respond_pull(m)
+        pending, st.parked_pulls = st.parked_pulls, []
+        for m in pending:
+            missing = next((int(k) for k in m.keys
+                            if int(k) not in self.store), None)
+            if missing is None:
+                self._respond_pull(m)
+            else:
+                # still blocked: re-park under a key that is MISSING NOW.
+                # Leaving it under the original (now-present) key would
+                # orphan it — later INITs only rescan their own key's list
+                # (advisor r1: zpull([a,b]) before INIT of both hung when
+                # a and b arrived in separate INITs)
+                self._keys.setdefault(
+                    missing, _GlobalKeyState()).parked_pulls.append(m)
 
     def _respond_pull(self, req: Message):
         # HFA K2 pulls must come back dense: the subscriber's replica just

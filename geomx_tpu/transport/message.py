@@ -92,6 +92,12 @@ class Message:
     # resender bookkeeping (ref: resender.h)
     msg_sig: int = -1
 
+    # sender incarnation nonce, stamped by the Van at send time.  Replay
+    # dedup keys on it so a replaced node (ADDR_UPDATE recovery) whose
+    # Customer timestamps restart at 0 can't have fresh requests
+    # misclassified as replays of its predecessor's (advisor r1)
+    boot: int = 0
+
     _nbytes_cache: Optional[int] = dataclasses.field(
         default=None, repr=False, compare=False
     )
@@ -132,7 +138,7 @@ class Message:
         return Message(**kw)
 
     # ---- binary serialization (for the TCP van) -----------------------------
-    _HDR = struct.Struct("<B B i i q B B B i i q q q q q B q q")
+    _HDR = struct.Struct("<B B i i q B B B i i q q q q q B q q q")
 
     def to_bytes(self) -> bytes:
         buf = io.BytesIO()
@@ -157,6 +163,7 @@ class Message:
             self.timestamp, flags, 0, 0, self.cmd, self.priority,
             self.first_key, self.seq, self.seq_begin, self.seq_end,
             self.total_bytes, self.channel, self.val_bytes, self.msg_sig,
+            self.boot,
         )
         buf.write(struct.pack("<i", len(hdr)))
         buf.write(hdr)
@@ -172,7 +179,7 @@ class Message:
         fields = cls._HDR.unpack_from(data, off); off += hlen
         (control, domain, app_id, customer_id, timestamp, flags, _, _, cmd,
          priority, first_key, seq, seq_begin, seq_end, total_bytes, channel,
-         val_bytes, msg_sig) = fields
+         val_bytes, msg_sig, boot) = fields
         blobs = []
         for _ in range(4):
             (blen,) = struct.unpack_from("<q", data, off); off += 8
@@ -194,5 +201,5 @@ class Message:
             keys=arrs[0], vals=arrs[1], lens=arrs[2],
             first_key=first_key, seq=seq, seq_begin=seq_begin, seq_end=seq_end,
             channel=channel, total_bytes=total_bytes, val_bytes=val_bytes,
-            compr=meta["compr"], msg_sig=msg_sig,
+            compr=meta["compr"], msg_sig=msg_sig, boot=boot,
         )

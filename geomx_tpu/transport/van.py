@@ -21,6 +21,7 @@ from __future__ import annotations
 import collections
 import heapq
 import itertools
+import os
 import queue
 import random
 import threading
@@ -185,6 +186,12 @@ class Van:
         self.node = node
         self.fabric = fabric
         self.config = config or Config()
+        # incarnation nonce: one per Van instance, so a restarted /
+        # replaced node (whose Customer timestamps restart at 0) is
+        # distinguishable from its predecessor in replay-dedup windows
+        # (advisor r1; cf. the reference's lack of one — silent replay
+        # misclassification after recovery)
+        self.boot = int.from_bytes(os.urandom(6), "little") | 1
         self._box = fabric.register(node)
         self._receiver: Optional[Callable[[Message], None]] = None
         self._recv_thread: Optional[threading.Thread] = None
@@ -244,6 +251,7 @@ class Van:
     # ---- send path ----------------------------------------------------------
     def send(self, msg: Message, priority: Optional[int] = None):
         msg.sender = self.node
+        msg.boot = self.boot
         if priority is not None:
             msg.priority = priority
         if self.use_priority_queue and msg.control is Control.EMPTY:
@@ -349,7 +357,10 @@ class Van:
                 # guarded: an ACK to a vanished peer must not kill the
                 # receive thread
                 self._deliver_guarded(ack)
-                dedup_key = (str(msg.sender), msg.msg_sig)
+                # boot in the key: a replacement node restarts its sig
+                # counter, so without the incarnation its first reliable
+                # sends would be suppressed as its predecessor's duplicates
+                dedup_key = (str(msg.sender), msg.boot, msg.msg_sig)
                 if dedup_key in self._seen_sigs:
                     continue  # duplicate suppression (ref: resender.h:60-77)
                 self._seen_sigs.add(dedup_key)

@@ -306,3 +306,61 @@ def test_hfa_gating_reduces_wan_traffic():
     finally:
         sim_plain.shutdown()
         sim_hfa.shutdown()
+
+
+def test_multikey_pull_across_separate_inits():
+    """A multi-key pull parked before INIT must be served once the LAST
+    key arrives, even when the keys are INITed in separate messages
+    (advisor r1: the message used to stay orphaned under the first
+    missing key's parked list and hang forever)."""
+    import numpy as np
+
+    from geomx_tpu.ps.kv_app import KVPairs
+    from geomx_tpu.transport.message import Message
+
+    sim = make_sim(parties=1, workers=1)
+    try:
+        gs = sim.global_servers[0]
+        served = []
+        gs._respond_pull = lambda req: served.append(req)  # capture, no wire
+
+        keys = np.array([5, 9], dtype=np.int64)
+        msg = Message(keys=keys, pull=True, request=True)
+        gs._pull(msg, KVPairs(keys, np.zeros(0, np.float32),
+                              np.array([0, 0], dtype=np.int64)))
+        assert served == []
+        with gs._mu:
+            gs.store[5] = np.zeros(4, np.float32)
+            gs._serve_parked_pulls_locked(5)
+        assert served == []  # key 9 still missing; must now be parked on 9
+        with gs._mu:
+            assert any(m is msg for m in gs._keys[9].parked_pulls)
+            gs.store[9] = np.zeros(4, np.float32)
+            gs._serve_parked_pulls_locked(9)
+        assert served == [msg]
+    finally:
+        sim.shutdown()
+
+
+def test_replay_dedup_keyed_on_incarnation():
+    """A replacement node whose Customer timestamps restart at 0 must not
+    have fresh requests misclassified as replays of its predecessor's
+    (advisor r1: dedup key had no boot/incarnation nonce)."""
+    from geomx_tpu.kvstore.common import RecentRequests
+    from geomx_tpu.transport.message import Message
+
+    rr = RecentRequests()
+    old = Message(sender=None, app_id=0, customer_id=0, timestamp=0, boot=111)
+    new = Message(sender=None, app_id=0, customer_id=0, timestamp=0, boot=222)
+    assert rr.check(old) == "new"
+    rr.mark_done(old)
+    assert rr.check(new) == "new"       # NOT "done": different incarnation
+    assert rr.check(old) == "done"      # the true replay still dedups
+
+
+def test_boot_nonce_survives_wire_roundtrip():
+    from geomx_tpu.transport.message import Message
+
+    m = Message(app_id=1, customer_id=2, timestamp=3, boot=0xABCDEF)
+    m2 = Message.from_bytes(m.to_bytes())
+    assert m2.boot == 0xABCDEF

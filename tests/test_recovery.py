@@ -23,11 +23,12 @@ from geomx_tpu.transport import InProcFabric, Message
 
 
 class _Msg:
-    def __init__(self, sender, ts, app_id=0, customer_id=0):
+    def __init__(self, sender, ts, app_id=0, customer_id=0, boot=0):
         self.sender = sender
         self.timestamp = ts
         self.app_id = app_id
         self.customer_id = customer_id
+        self.boot = boot
 
 
 def test_recent_requests_window():

@@ -166,3 +166,38 @@ def test_config_from_env(monkeypatch):
     assert cfg.use_hfa and cfg.hfa_k2 == 4
     assert cfg.enable_p3
     assert abs(cfg.drop_rate - 0.1) < 1e-9
+
+
+def test_van_dedup_keyed_on_incarnation():
+    """A restarted sender Van (fresh sig counter, new boot nonce) must not
+    have its first reliable messages suppressed as its predecessor's
+    duplicates (same (sender, sig), different incarnation)."""
+    fab = InProcFabric()
+    a, b = NodeId(Role.WORKER, 0, 0), NodeId(Role.SERVER, 0, 0)
+    cfg = Config(resend_timeout_ms=200)
+    got = []
+    van_b = Van(b, fab, cfg)
+    van_b.start(lambda m: got.append(float(m.vals[0])))
+    van_a1 = Van(a, fab, cfg)
+    van_a1.start(lambda m: None)
+    van_a1.send(_mk([1.0], recipient=b))
+    _wait(lambda: len(got) == 1)
+    van_a1.stop()
+    # replacement: same node id, sig counter restarts at 1
+    van_a2 = Van(a, fab, cfg)
+    van_a2.start(lambda m: None)
+    assert van_a2.boot != van_a1.boot
+    van_a2.send(_mk([2.0], recipient=b))
+    _wait(lambda: len(got) == 2)
+    assert got == [1.0, 2.0]
+    van_a2.stop(); van_b.stop()
+
+
+def _wait(pred, timeout=5.0):
+    import time as _t
+    deadline = _t.monotonic() + timeout
+    while _t.monotonic() < deadline:
+        if pred():
+            return
+        _t.sleep(0.01)
+    assert pred()
