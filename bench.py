@@ -1,64 +1,370 @@
 #!/usr/bin/env python
-"""Benchmark: CIFAR-10-shape CNN training throughput (images/sec/chip).
+"""Benchmark harness. Prints ONE JSON line:
+{"metric", "value", "unit", "vs_baseline", ...extras}.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Round-1 failure mode (BENCH_r01.json rc=1, parsed null): the axon TPU
+tunnel flaked during backend init and one exception killed the run.
+This harness therefore:
 
-``--wan`` runs the second BASELINE.md metric instead: WAN bytes/step of
-the geo-distributed stack per codec config (vanilla/fp16/2bit/bsc/mpq),
-a hardware-independent measure of the WAN-optimization value (the
-reference's headline is WAN-traffic reduction, README.md:21-45).  One
-JSON line: {"metric": "wan_bytes_per_step", "value": <vanilla>,
-"configs": {...}, "reduction": {...}}; vs_baseline is null — there is
-no published reference number to compare against.
+- runs every device benchmark in a **subprocess** with a hard timeout
+  and retry/backoff, so a hung backend init (observed: jax.devices()
+  blocking >2 min) can never wedge the whole bench;
+- always runs the CPU-only WAN codec benchmark, so even a dead tunnel
+  still yields a real number (the reference's headline is WAN-traffic
+  reduction, README.md:21-45);
+- on TPU failure emits the WAN figure as the primary metric plus an
+  "error" field — never rc!=0, never an empty line.
 
-The north-star target (BASELINE.md) is >=0.9x the per-chip throughput of an
-A100 running the reference CUDA build on the same CNN.  No A100 is
-reachable from this environment, so ``A100_REF_IMAGES_PER_SEC`` is a
-provisional estimate for the reference 2-conv/3-dense CNN at batch 1024
-(small CNNs are input/launch-bound on big accelerators; revise when a
-measured number lands in BASELINE.json's `published`).  vs_baseline =
-value / (0.9 * A100_REF) so 1.0 means "met the >=0.9x target".
+Benchmarks:
+- **cnn**   CIFAR-10-shape CNN images/sec/chip (BASELINE.md metric #1).
+  The step loop runs on-device via lax.scan — one dispatch per
+  measurement — because the axon tunnel adds O(100ms) per Python
+  dispatch, which would measure the tunnel, not the chip.
+- **mfu**   flagship transformer (models/transformer.py) fwd+bwd+adam,
+  bf16: achieved TFLOP/s vs the chip's peak (VERDICT r1 item 1).
+- **quant** on-chip pallas 2-bit quantization throughput vs the host
+  C++/numpy codec (VERDICT r1 item 2).
+- **wan**   WAN bytes/step per codec config on the full two-tier stack
+  (CPU, in-proc sim).
+
+vs_baseline: BASELINE.md's north star is >=0.9x the per-chip throughput
+of an A100 running the reference CUDA build on the same CNN.  No A100
+is reachable (zero egress), so the A100 reference is **derived**, not
+measured: images/sec = EFF_A100 * A100_PEAK_BF16 / CNN_FLOPS_PER_IMAGE,
+with the assumed efficiency stated in the output.  For the tiny
+2-conv/3-dense CNN the honest statement is that both chips are
+launch/input-bound; the FLOP-derived bound with a generous efficiency
+is an upper estimate of the reference, making vs_baseline conservative.
 """
 
+import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent))
+ROOT = Path(__file__).resolve().parent
+sys.path.insert(0, str(ROOT))
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-
-from geomx_tpu.core.platform import apply_platform_from_env
-from geomx_tpu.models import create_cnn_state
-
-apply_platform_from_env()
-
-# Provisional A100 reference for this tiny CNN at batch 1024: the workload
-# is input/launch-bound, so an A100 (312 bf16 TFLOPs) and a v5e chip land
-# in the same range; assume parity (~400k img/s) until BASELINE.json gains
-# a measured number.  vs_baseline ~1.0 therefore means "at the 0.9x-A100
-# target".  NOTE: the workload (BATCH/STEPS) and this constant are pinned
-# together — changing one without re-estimating the other corrupts
-# vs_baseline comparability across rounds.
-A100_REF_IMAGES_PER_SEC = 400_000.0
 BATCH = 1024
-STEPS = 50
+STEPS = 32          # per on-device scan segment
+A100_PEAK_BF16 = 312e12
+EFF_A100 = 0.20     # assumed FLOP efficiency of the CUDA reference on this
+#                     small CNN (generous: small convs at batch 1024 rarely
+#                     exceed ~20% on A100; stated in output for audit)
+V5E_PEAK_BF16 = 197e12  # TPU v5e (device reports "TPU v5 lite")
 
 
-def wan_bench():
-    """WAN bytes/step per codec config on the full two-tier stack
-    (in-proc sim, 2 parties x 1 worker — topology doesn't change the
-    per-party WAN payload, codecs do)."""
+# --------------------------------------------------------------------------
+# children (each runs in its own subprocess; prints one JSON line)
+# --------------------------------------------------------------------------
+
+def _cnn_flops_per_image():
+    """Analytic fwd FLOPs/image of models/cnn.py's CNN at 32x32x3; the
+    train step is ~3x fwd (fwd + 2x in bwd)."""
+    f = 0.0
+    # conv1: 32x32x3 -> 32x32x32, 3x3;  conv2: pool-> 16x16x64, 3x3
+    f += 2 * 32 * 32 * 32 * (3 * 3 * 3)
+    f += 2 * 16 * 16 * 64 * (3 * 3 * 32)
+    # dense: flatten 8*8*64=4096 -> 128 -> 64 -> 10 (models/cnn.py)
+    f += 2 * (8 * 8 * 64) * 128 + 2 * 128 * 64 + 2 * 64 * 10
+    return 3.0 * f
+
+
+def child_cnn():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from geomx_tpu.models import create_cnn_state
+
+    rng = jax.random.PRNGKey(0)
+    model, params, _ = create_cnn_state(
+        rng, input_shape=(BATCH, 32, 32, 3), num_classes=10)
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, x, y):
+        logits = model.apply(p, x)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    def step(carry, _):
+        p, s = carry
+        loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+        updates, s = tx.update(grads, s, p)
+        return (optax.apply_updates(p, updates), s), loss
+
+    @jax.jit
+    def run_steps(p, s):
+        (p, s), losses = jax.lax.scan(step, (p, s), None, length=STEPS)
+        return p, s, losses[-1]
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (BATCH, 32, 32, 3), dtype=np.float32))
+    y = jnp.asarray(np.random.default_rng(1).integers(
+        0, 10, BATCH, dtype=np.int32))
+
+    # compile + warmup; scalar readback is the sync point (on the remote
+    # tunnel block_until_ready can return before execution finishes)
+    params, opt_state, loss = run_steps(params, opt_state)
+    _ = float(loss)
+
+    best_dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        params, opt_state, loss = run_steps(params, opt_state)
+        _ = float(loss)
+        best_dt = min(best_dt, time.perf_counter() - t0)
+
+    ips = BATCH * STEPS / best_dt
+    a100_ref = EFF_A100 * A100_PEAK_BF16 / _cnn_flops_per_image()
+    print(json.dumps({
+        "images_per_sec": round(ips, 1),
+        "vs_baseline": round(ips / (0.9 * a100_ref), 3),
+        "a100_ref_derivation": {
+            "a100_images_per_sec": round(a100_ref, 1),
+            "method": "EFF_A100 * A100_PEAK_BF16 / CNN_FLOPS_PER_IMAGE",
+            "eff_a100": EFF_A100,
+            "cnn_train_flops_per_image": _cnn_flops_per_image(),
+        },
+        "timing": "best_of_3_min, 32-step on-device scan",
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }))
+
+
+# flagship MFU config: MXU-friendly shapes, fits v5e 16 GB with adam
+MFU_CFG = dict(vocab=8192, d_model=2048, n_heads=16, n_layers=8,
+               d_ff=8192, max_seq=2048)
+MFU_BATCH = 2
+MFU_STEPS = 8
+
+
+def _transformer_train_flops_per_step(cfg, batch, seq):
+    """Standard 6*N*T + attention-matmul term (12*L*T*seq*d_model*3 for
+    fwd+bwd), counting the train step (fwd + 2x bwd)."""
+    n_params = (cfg["vocab"] * cfg["d_model"]          # embed (tied head)
+                + cfg["max_seq"] * cfg["d_model"]      # pos
+                + cfg["n_layers"] * 12 * cfg["d_model"] ** 2)
+    tokens = batch * seq
+    dense = 6.0 * n_params * tokens
+    attn = 12.0 * cfg["n_layers"] * tokens * seq * cfg["d_model"]
+    return dense + attn, n_params
+
+
+def child_mfu():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from geomx_tpu.models.transformer import (
+        TransformerConfig, init_params, lm_loss, make_apply)
+
+    cfg = TransformerConfig(**MFU_CFG)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    apply_fn = make_apply(cfg)
+    tx = optax.adam(1e-4)
+    opt_state = tx.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (MFU_BATCH, MFU_CFG["max_seq"]), 0,
+        MFU_CFG["vocab"], dtype=jnp.int32)
+
+    def step(carry, _):
+        p, s = carry
+        loss, grads = jax.value_and_grad(
+            lambda p_: lm_loss(apply_fn, p_, tokens))(p)
+        updates, s = tx.update(grads, s, p)
+        return (optax.apply_updates(p, updates), s), loss
+
+    @jax.jit
+    def run_steps(p, s):
+        (p, s), losses = jax.lax.scan(step, (p, s), None, length=MFU_STEPS)
+        return p, s, losses[-1]
+
+    params, opt_state, loss = run_steps(params, opt_state)
+    _ = float(loss)
+    best_dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        params, opt_state, loss = run_steps(params, opt_state)
+        _ = float(loss)
+        best_dt = min(best_dt, time.perf_counter() - t0)
+
+    flops_per_step, n_params = _transformer_train_flops_per_step(
+        MFU_CFG, MFU_BATCH, MFU_CFG["max_seq"])
+    achieved = flops_per_step * MFU_STEPS / best_dt
+    platform = jax.devices()[0].platform
+    peak = V5E_PEAK_BF16 if platform in ("tpu", "axon") else None
+    print(json.dumps({
+        "achieved_tflops": round(achieved / 1e12, 2),
+        "peak_tflops": peak and peak / 1e12,
+        "mfu": peak and round(achieved / peak, 4),
+        "model": (f"transformer d{MFU_CFG['d_model']} L{MFU_CFG['n_layers']} "
+                  f"ff{MFU_CFG['d_ff']} seq{MFU_CFG['max_seq']} "
+                  f"batch{MFU_BATCH} bf16 ({n_params/1e6:.0f}M params)"),
+        "tokens_per_sec": round(
+            MFU_BATCH * MFU_CFG["max_seq"] * MFU_STEPS / best_dt, 1),
+        "platform": platform,
+    }))
+
+
+QUANT_MB = 64
+
+
+def child_quant():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from geomx_tpu.ops.quantize import dequantize_2bit_tpu, quantize_2bit_tpu
+
+    n = QUANT_MB * (1 << 20) // 4
+    g = jnp.asarray(np.random.default_rng(0).standard_normal(n).astype(np.float32))
+    r = jnp.zeros_like(g)
+
+    packed, newr = quantize_2bit_tpu(g, r)          # compile + correctness
+    out = dequantize_2bit_tpu(packed, n)
+    _ = float(out[0]); _ = float(newr[0])
+    # spot-check round-trip semantics on-device
+    gi = np.asarray(g[:4096]); oi = np.asarray(out[:4096])
+    expect = np.where(gi > 0.5, 0.5, np.where(gi < -0.5, -0.5, 0.0))
+    if not np.allclose(oi, expect):
+        raise AssertionError("on-chip 2bit round-trip mismatch")
+
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        packed, r = quantize_2bit_tpu(g, r)
+    _ = float(packed[0])
+    dev_dt = (time.perf_counter() - t0) / reps
+
+    # host codec throughput for comparison
+    from geomx_tpu.compression.codecs import TwoBitCodec
+    codec = TwoBitCodec(threshold=0.5)
+    gh = np.asarray(g)
+    codec.compress(0, gh)                            # residual warmup
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        codec.compress(0, gh)
+    host_dt = (time.perf_counter() - t0) / reps
+
+    print(json.dumps({
+        "tpu_quant_mbps": round(QUANT_MB / dev_dt, 1),
+        "host_quant_mbps": round(QUANT_MB / host_dt, 1),
+        "payload_mb": QUANT_MB,
+        "platform": jax.devices()[0].platform,
+        "roundtrip": "ok",
+    }))
+
+
+def child_overlap():
+    """P3 staged-overlap vs BSP step time under a serialized WAN uplink
+    (in-proc sim; VERDICT r1 item 3).  Reports the speedup ratio."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.kvstore import Simulation
+    from geomx_tpu.overlap import StagedModel, run_worker_overlapped
+    from geomx_tpu.training import run_worker
+    from geomx_tpu.transport.van import FaultPolicy
+
+    stages, n, steps = 6, 192_000, 3
+    fwd_s, bwd_s = 0.012, 0.024
+    fault = dict(wan_bandwidth_bps=20e6, wan_latency_s=0.005)
+
+    def build():
+        fns, params = [], []
+        key = jax.random.PRNGKey(0)
+        for i in range(stages):
+            k1, key = jax.random.split(key)
+            params.append({"w": jax.random.normal(k1, (192, 192)) / 14.0,
+                           "big": jnp.zeros((n,), jnp.float32)})
+            last = i == stages - 1
+
+            def fn(p, x, last=last):
+                h = x @ p["w"] + 1e-9 * jnp.sum(p["big"])
+                return h if last else jax.nn.relu(h)
+
+            fns.append(fn)
+        return fns, params
+
+    def ce(logits, y):
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+        return loss, jnp.mean(logits)
+
+    data = [(jnp.zeros((16, 192)), jnp.zeros(16, jnp.int32))] * steps
+
+    def timed(overlapped: bool) -> float:
+        sim = Simulation(Config(
+            topology=Topology(num_parties=1, workers_per_party=1),
+            enable_p3=True), fault=FaultPolicy(**fault))
+        try:
+            kv = sim.all_workers()[0]
+            kv.set_optimizer({"type": "sgd", "lr": 0.01})
+            fns, params = build()
+            if overlapped:
+                model = StagedModel(fns, ce)
+                for i in range(model.n):
+                    f0, b0 = model._fwd[i], model._bwd[i]
+                    model._fwd[i] = (lambda p, x, f0=f0:
+                                     (time.sleep(fwd_s), f0(p, x))[1])
+                    model._bwd[i] = (lambda p, x, g, b0=b0:
+                                     (time.sleep(bwd_s), b0(p, x, g))[1])
+                run_worker_overlapped(kv, model, params, data[:1], 1,
+                                      barrier_init=False)
+                t0 = time.perf_counter()
+                run_worker_overlapped(kv, model, params, data, steps,
+                                      barrier_init=False)
+                return time.perf_counter() - t0
+
+            def grad_fn(ps, x, y):
+                time.sleep(stages * (fwd_s + bwd_s))
+
+                def composed(ps):
+                    h = x
+                    for f, p in zip(fns, ps):
+                        h = f(p, h)
+                    return ce(h, y)
+                (loss, aux), grads = jax.value_and_grad(
+                    composed, has_aux=True)(ps)
+                return loss, aux, grads
+
+            run_worker(kv, params, grad_fn, data[:1], 1, barrier_init=False)
+            t0 = time.perf_counter()
+            run_worker(kv, params, grad_fn, data, steps, barrier_init=False)
+            return time.perf_counter() - t0
+        finally:
+            sim.shutdown()
+
+    bsp = timed(False)
+    ovl = timed(True)
+    print(json.dumps({
+        "bsp_s_per_step": round(bsp / steps, 4),
+        "overlap_s_per_step": round(ovl / steps, 4),
+        "speedup": round(bsp / ovl, 3),
+        "setting": (f"{stages} stages x {n * 4 // 1024}KB, WAN "
+                    f"{fault['wan_bandwidth_bps'] / 1e6:.0f}MB/s uplink, "
+                    f"{fault['wan_latency_s'] * 1000:.0f}ms latency, "
+                    f"modeled compute {(fwd_s + bwd_s) * stages * 1000:.0f}"
+                    "ms/step"),
+    }))
+
+
+def child_wan():
+    """WAN bytes/step per codec config (in-proc sim, 2 parties x 1 worker —
+    topology doesn't change the per-party WAN payload, codecs do)."""
+    import numpy as np
+
     from geomx_tpu.core.config import Config, Topology
     from geomx_tpu.kvstore import Simulation
 
-    # one big tensor (BSC regime) + one small tensor (below MPQ's
-    # size_bound) so the MPQ split actually exercises both branches and
-    # its number differs from pure BSC
     N_BIG, N_SMALL = 400_000, 50_000
     STEPS_W = 4
     configs = {
@@ -80,15 +386,12 @@ def wan_bench():
                 w.init(1, np.zeros(N_SMALL, np.float32))
             ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
             if comp is not None:
-                # rank-0 of EACH party configures its party server
-                # (ref semantics — one party left unconfigured would keep
-                # pushing dense)
                 for p in range(2):
                     sim.worker(p, 0).set_gradient_compression(comp)
             base = sim.wan_bytes()["wan_send_bytes"]
             for _ in range(STEPS_W):
-                for tid, n in ((0, N_BIG), (1, N_SMALL)):
-                    g = rng.standard_normal(n).astype(np.float32)
+                for tid, nel in ((0, N_BIG), (1, N_SMALL)):
+                    g = rng.standard_normal(nel).astype(np.float32)
                     for w in ws:
                         w.push(tid, g)
                 for w in ws:
@@ -98,67 +401,143 @@ def wan_bench():
         finally:
             sim.shutdown()
     print(json.dumps({
-        "metric": "wan_bytes_per_step",
-        "value": round(out["vanilla"], 1),
-        "unit": "bytes/step (vanilla; see configs)",
-        "vs_baseline": None,  # no published reference WAN number
-        "configs": {k: round(v, 1) for k, v in out.items()},
+        "bytes_per_step": {k: round(v, 1) for k, v in out.items()},
         "reduction": {k: round(out["vanilla"] / v, 2)
                       for k, v in out.items() if v > 0},
     }))
 
 
+# --------------------------------------------------------------------------
+# orchestrator
+# --------------------------------------------------------------------------
+
+def _run_child(name: str, timeout: float, env_extra=None):
+    env = dict(os.environ)
+    env.pop("BENCH_CHILD", None)
+    if env_extra:
+        env.update(env_extra)
+    try:
+        p = subprocess.run(
+            [sys.executable, str(ROOT / "bench.py"), "--child", name],
+            capture_output=True, text=True, timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout:.0f}s"
+    if p.returncode != 0:
+        tail = (p.stderr or p.stdout or "").strip().splitlines()[-6:]
+        return None, f"rc={p.returncode}: " + " | ".join(tail)
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            return json.loads(line), None
+        except json.JSONDecodeError:
+            continue
+    return None, "no JSON in child output"
+
+
+def _run_tpu_child(name: str, timeout: float, attempts: int = 2,
+                   backoff: float = 20.0):
+    err = None
+    for i in range(attempts):
+        if i:
+            time.sleep(backoff)
+        res, err = _run_child(name, timeout)
+        if res is not None:
+            return res, None
+    return None, err
+
+
 def main():
-    rng = jax.random.PRNGKey(0)
-    model, params, _ = create_cnn_state(
-        rng, input_shape=(BATCH, 32, 32, 3), num_classes=10)
-    tx = optax.adam(1e-3)
-    opt_state = tx.init(params)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child",
+                    choices=["cnn", "mfu", "quant", "wan", "overlap"])
+    ap.add_argument("--wan", action="store_true",
+                    help="legacy: run only the WAN codec benchmark")
+    ap.add_argument("--skip-tpu", action="store_true")
+    args = ap.parse_args()
 
-    def loss_fn(p, x, y):
-        logits = model.apply(p, x)
-        logp = jax.nn.log_softmax(logits)
-        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+    if args.child:
+        # route a CPU request through jax.config: the sandbox's
+        # sitecustomize imports jax at interpreter start, so the env var
+        # alone is too late and a dead TPU tunnel would hang the child
+        from geomx_tpu.core.platform import apply_platform_from_env
+        apply_platform_from_env()
+        {"cnn": child_cnn, "mfu": child_mfu, "quant": child_quant,
+         "wan": child_wan, "overlap": child_overlap}[args.child]()
+        return
 
-    @jax.jit
-    def train_step(p, opt_state, x, y):
-        loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
-        updates, opt_state = tx.update(grads, opt_state, p)
-        return optax.apply_updates(p, updates), opt_state, loss
+    cpu_env = {"JAX_PLATFORMS": "cpu", "JAX_PLATFORM_NAME": "cpu"}
+    wan, wan_err = _run_child("wan", timeout=300, env_extra=cpu_env)
 
-    x = jnp.asarray(np.random.default_rng(0).standard_normal(
-        (BATCH, 32, 32, 3), dtype=np.float32))
-    y = jnp.asarray(np.random.default_rng(1).integers(0, 10, BATCH, dtype=np.int32))
+    if args.wan:  # legacy single-benchmark mode: WAN codec numbers only
+        print(json.dumps({
+            "metric": "wan_bytes_per_step",
+            "value": wan and wan["bytes_per_step"]["vanilla"],
+            "unit": "bytes/step (vanilla; see configs)",
+            "vs_baseline": None,
+            "configs": wan and wan["bytes_per_step"],
+            "reduction": wan and wan["reduction"],
+            "error": wan_err,
+        }))
+        return
 
-    # compile + warmup.  NOTE: a scalar readback (float(loss)) is the sync
-    # point — on remote-execution backends block_until_ready can return
-    # before the computation actually ran, inflating throughput ~100x.
-    params, opt_state, loss = train_step(params, opt_state, x, y)
-    _ = float(loss)
+    overlap, overlap_err = _run_child("overlap", timeout=300,
+                                      env_extra=cpu_env)
 
-    # best-of-3: the remote-tunnel transport adds run-to-run variance on
-    # the order of 20%; peak throughput is the stable device capability
-    best_dt = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(STEPS):
-            params, opt_state, loss = train_step(params, opt_state, x, y)
-        _ = float(loss)  # chained deps: forces all STEPS to completion
-        best_dt = min(best_dt, time.perf_counter() - t0)
+    errors = {}
+    cnn = mfu = quant = None
+    if not args.skip_tpu:
+        # preflight: is the tunnel alive at all?  jax.devices() has been
+        # observed to hang for minutes when it isn't — probe cheaply first
+        # (the mfu child doubles as the probe with its own timeout)
+        cnn, err = _run_tpu_child("cnn", timeout=420)
+        if err:
+            errors["cnn"] = err
+        mfu, err = _run_tpu_child("mfu", timeout=600)
+        if err:
+            errors["mfu"] = err
+        quant, err = _run_tpu_child("quant", timeout=420)
+        if err:
+            errors["quant"] = err
+    if wan_err:
+        errors["wan"] = wan_err
+    if overlap_err:
+        errors["overlap"] = overlap_err
 
-    ips = BATCH * STEPS / best_dt
-    print(json.dumps({
-        "metric": "cifar10_cnn_images_per_sec_per_chip",
-        "value": round(ips, 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(ips / (0.9 * A100_REF_IMAGES_PER_SEC), 3),
-        "timing": "best_of_3_min",  # methodology: round-over-round numbers
-                                    # are only comparable with equal timing
-    }))
+    if cnn is not None:
+        record = {
+            "metric": "cifar10_cnn_images_per_sec_per_chip",
+            "value": cnn["images_per_sec"],
+            "unit": "images/sec/chip",
+            "vs_baseline": cnn["vs_baseline"],
+            "a100_ref_derivation": cnn["a100_ref_derivation"],
+            "device": cnn.get("device"),
+        }
+    elif mfu is not None:
+        record = {
+            "metric": "transformer_achieved_tflops",
+            "value": mfu["achieved_tflops"],
+            "unit": "TFLOP/s",
+            "vs_baseline": None,
+        }
+    else:
+        record = {
+            "metric": "wan_bytes_per_step",
+            "value": wan and wan["bytes_per_step"]["vanilla"],
+            "unit": "bytes/step (vanilla; see configs)",
+            "vs_baseline": None,
+            "error": "TPU benchmarks unavailable (see errors)",
+        }
+    if mfu:
+        record["mfu"] = mfu
+    if quant:
+        record["quantize"] = quant
+    if wan:
+        record["wan"] = wan
+    if overlap:
+        record["overlap"] = overlap
+    if errors:
+        record["errors"] = errors
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":
-    if "--wan" in sys.argv:
-        wan_bench()
-    else:
-        main()
+    main()

@@ -17,6 +17,9 @@ Layer map (bottom → top), mirroring the reference architecture
                    hierarchical server, sync modes (FSA/MixedSync/HFA).
 - ``compression``— wire codecs: FP16, 2-bit quant, Bi-Sparse top-k, MPQ.
 - ``sched``      — P3 priority propagation, TSEngine overlay, DGT.
+- ``overlap``    — staged worker loop: per-stage push during backward /
+                   per-stage pull gating in forward (the reference's
+                   engine-driven compute/comm overlap, rebuilt for XLA).
 - ``parallel``   — TPU mesh parallelism: DP/TP/SP shardings, ring attention.
 - ``models``     — reference workloads (CNN) + flagship transformer.
 - ``optim``      — optimizers including DCASGD.

@@ -71,7 +71,7 @@ class _KeyState:
     """Per-ps-key aggregation state on the local server."""
 
     __slots__ = ("accum", "count", "parked_pulls", "in_flight", "version",
-                 "round", "row_sparse", "epoch")
+                 "round", "row_sparse", "epoch", "priority")
 
     def __init__(self):
         self.accum: Optional[np.ndarray] = None
@@ -84,6 +84,11 @@ class _KeyState:
         self.epoch = 0           # bumped by overwrite-inits: a pull-down
         #                          from before the bump must not clobber
         #                          the restored value of THIS key
+        self.priority = 0        # P3: workers' push priority, inherited by
+        #                          this key's WAN push-up and pull-down so
+        #                          shallow layers outrank deep ones on the
+        #                          server uplinks too (ref: P3_ZPush
+        #                          priority propagation kv_app.h:204-259)
 
 
 class LocalServer:
@@ -269,6 +274,7 @@ class LocalServer:
                     st.accum += v
                 st.count += num_merge
                 st.in_flight = True
+                st.priority = msg.priority
                 if st.count >= self.num_workers:
                     completed.append(k)
         if not self.sync_mode:
@@ -497,6 +503,9 @@ class LocalServer:
         with self._mu:
             epochs = {k: self._keys[k].epoch for k in keys
                       if k in self._keys}
+            # P3: the WAN hops inherit the workers' per-layer priority
+            prio = max((self._keys[k].priority for k in keys
+                        if k in self._keys), default=0)
 
         def pull_down():
             # all global shards applied the update → pull fresh weights
@@ -511,7 +520,8 @@ class LocalServer:
                         self._finish_round(keys)
                 return
             self.up.zpull(keys,
-                          cb=lambda kvs: self._on_pull_down(kvs, epochs))
+                          cb=lambda kvs: self._on_pull_down(kvs, epochs),
+                          priority=prio)
 
         # group keys by wire codec so each message has a uniform payload
         # dtype + compr tag (ref: PushCompressed kvstore_dist.h:530-563)
@@ -539,6 +549,25 @@ class LocalServer:
                          else self.push_codec)
                 groups.setdefault(codec.name, []).append(
                     (k, codec.compress(k, v)))
+        # P3 piggyback on the WAN tier: combined push_pull saves the
+        # per-round ack -> pull-request chain (2 messages + 2 latencies
+        # per key per round); the global server replies with the updated
+        # values once the round completes.  Not combinable with the
+        # inter-TS overlay (which replaces the pull-down entirely) or
+        # merged pushes (num_merge body).
+        use_piggyback = (self.config.enable_p3 and push_body is None
+                         and self.ts_inter is None)
+        if use_piggyback:
+            for tag, pairs in groups.items():
+                ks = np.array([k for k, _ in pairs], dtype=np.int64)
+                vals = np.concatenate([p for _, p in pairs])
+                lens = np.array([len(p) for _, p in pairs], dtype=np.int64)
+                self.up.push_pull(
+                    KVPairs(ks, vals, lens), cmd=Cmd.DEFAULT,
+                    cb=lambda kvs: self._on_pull_down(kvs, epochs),
+                    compr=tag, priority=prio)
+            return
+
         remaining = [len(groups)]
         lock = threading.Lock()
 
@@ -555,7 +584,7 @@ class LocalServer:
             lens = np.array([len(p) for _, p in pairs], dtype=np.int64)
             self.up.zpush(KVPairs(ks, vals, lens), cmd=Cmd.DEFAULT,
                           on_complete=one_group_acked, compr=tag,
-                          body=push_body)
+                          body=push_body, priority=prio)
 
     def _push_up_hfa(self, kvs: KVPairs):
         """K2 round: ship (mean_weights - milestone)/num_global_workers
@@ -919,8 +948,15 @@ class GlobalServer:
             return  # replay of a push already in this round's accumulator
         if state == "done":
             # the original ACK was lost — repeat it, same body (an error
-            # body must not degrade into a clean ACK on the replay)
-            self.server.response(msg, body=self._recent.done_body(msg))
+            # body must not degrade into a clean ACK on the replay).  A
+            # piggybacked push_pull re-serves the values: a bare re-ack
+            # would leave the puller waiting forever
+            body = self._recent.done_body(msg)
+            if body is None and msg.pull:
+                with self._mu:
+                    self._respond_pull(msg)
+            else:
+                self.server.response(msg, body=body)
             return
         # an inter-TS-merged push carries several parties' contributions
         # (ref: num_merge counting in the global ASK_PUSH path)
@@ -982,7 +1018,15 @@ class GlobalServer:
                 dissem = None
         for req, err in to_ack:
             self._recent.mark_done(req, err)
-            self.server.response(req, body=err)
+            if err is None and req.pull:
+                # P3 piggyback on the WAN tier: the push response carries
+                # the updated values, eliminating the ack -> pull-request
+                # chain per key (ref: server replies with values in the
+                # push response, kvstore_dist_server.h:1149-1165,1255-1267)
+                with self._mu:
+                    self._respond_pull(req)
+            else:
+                self.server.response(req, body=err)
         if dissem is not None:
             self.ts_inter.disseminate_async(*dissem, Cmd.TS_AUTOPULL)
 
@@ -1006,10 +1050,21 @@ class GlobalServer:
     # ---- async tier (MixedSync, ref :1519-1698) -----------------------------
     def _push_async(self, msg: Message, kvs: KVPairs):
         state = self._recent.check(msg)
-        if state != "new":
-            # async pushes apply immediately, so any replay means the ACK
-            # was lost — re-ack without re-applying the gradient
-            self.server.response(msg, body=self._recent.done_body(msg))
+        if state == "pending":
+            # the original is still being applied — drop silently (a bare
+            # ack here would consume the puller's response slot and the
+            # real values response would then be discarded as a duplicate)
+            return
+        if state == "done":
+            # the ACK was lost — re-ack without re-applying the gradient
+            # (with values again if the original was a piggybacked
+            # push_pull)
+            body = self._recent.done_body(msg)
+            if body is None and msg.pull:
+                with self._mu:
+                    self._respond_pull(msg)
+            else:
+                self.server.response(msg, body=body)
             return
         dissem = None
         with self._mu:
@@ -1032,7 +1087,11 @@ class GlobalServer:
                     self._ts_async_dirty.clear()
                     dissem = self._build_dissem_locked(ks)
         self._recent.mark_done(msg)
-        self.server.response(msg)
+        if msg.pull:
+            with self._mu:
+                self._respond_pull(msg)  # piggybacked push_pull (P3)
+        else:
+            self.server.response(msg)
         if dissem is not None:
             self.ts_inter.disseminate_async(*dissem, Cmd.TS_AUTOPULL)
 

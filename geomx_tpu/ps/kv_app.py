@@ -361,10 +361,10 @@ class KVWorker(_App):
         return ts
 
     def push_pull(self, kvs: KVPairs, cb=None, cmd: int = 0, priority: int = 0,
-                  wait: bool = False) -> int:
+                  wait: bool = False, on_complete=None, **msg_fields) -> int:
         """Combined push+pull in one round trip (response carries values)."""
         parts = self._slice(kvs)
-        ts = self.customer.new_request(len(parts))
+        ts = self.customer.new_request(len(parts), on_complete=on_complete)
         with self._mu:
             self._pull_bufs[ts] = []
             self._pull_expected[ts] = len(parts)
@@ -375,6 +375,7 @@ class KVWorker(_App):
             app_id=self.customer.app_id, customer_id=self.customer.customer_id,
             timestamp=ts, request=True, push=True, pull=True, cmd=cmd,
             priority=priority, keys=part.keys, vals=part.vals, lens=part.lens,
+            **msg_fields,
         ) for sid, part in parts.items()]
         self._track(ts, msgs)  # before sending (response could race)
         for m in msgs:

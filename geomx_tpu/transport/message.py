@@ -133,6 +133,10 @@ class Message:
             push=self.push,
             pull=self.pull,
             cmd=self.cmd,
+            # responses inherit the request's priority so P3 ordering
+            # holds on the return path (pull-downs / piggybacked values
+            # contend on the server's uplink too)
+            priority=self.priority,
         )
         kw.update(overrides)
         return Message(**kw)

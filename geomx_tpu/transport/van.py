@@ -53,12 +53,20 @@ class FaultPolicy:
         channel_drop_rate: float = 0.0,
         latency_s: float = 0.0,
         wan_latency_s: Optional[float] = None,
+        lan_bandwidth_bps: float = 0.0,
+        wan_bandwidth_bps: float = 0.0,
         seed: int = 0,
     ):
         self.drop_rate = drop_rate
         self.channel_drop_rate = channel_drop_rate
         self.latency_s = latency_s
         self.wan_latency_s = wan_latency_s if wan_latency_s is not None else latency_s
+        # bytes/sec uplink capacity per (sender, domain) link; 0 = infinite.
+        # Bandwidth serialization is what makes priority scheduling (P3)
+        # and contribution-ranked channels (DGT) *measurable* in the sim:
+        # with latency alone, concurrent messages never contend
+        self.lan_bandwidth_bps = lan_bandwidth_bps
+        self.wan_bandwidth_bps = wan_bandwidth_bps
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
 
@@ -73,6 +81,10 @@ class FaultPolicy:
 
     def latency(self, msg: Message) -> float:
         return self.wan_latency_s if msg.domain is Domain.GLOBAL else self.latency_s
+
+    def bandwidth(self, msg: Message) -> float:
+        return (self.wan_bandwidth_bps if msg.domain is Domain.GLOBAL
+                else self.lan_bandwidth_bps)
 
     @classmethod
     def from_config(cls, config: Config, seed: int = 0) -> "FaultPolicy":
@@ -103,6 +115,7 @@ class InProcFabric:
         self._cv = threading.Condition()
         self._stop = False
         self._timer: Optional[threading.Thread] = None
+        self._link_free: Dict[tuple, float] = {}  # (sender, domain) -> t
         self.dropped = 0  # observability for loss-injection tests
 
     def register(self, node: NodeId) -> _Mailbox:
@@ -116,6 +129,25 @@ class InProcFabric:
             self.dropped += 1
             return False
         delay = self.fault.latency(msg)
+        bw = self.fault.bandwidth(msg)
+        if bw > 0.0 and msg.control is Control.EMPTY:
+            # serialize transmissions on the sender's uplink: the link is
+            # busy for nbytes/bw; a message starts transmitting when the
+            # link frees.  Delivery = transmission end + propagation
+            # latency.  The sender BLOCKS until its transmission ends —
+            # the backpressure a real socket applies — so a Van's
+            # priority send queue actually reorders: later high-priority
+            # messages jump transmissions still queued behind a busy
+            # link.  Without blocking, the queue drains instantly and P3
+            # ordering can never matter (the round-1 'P3 is inert' gap).
+            link = (str(msg.sender), msg.domain)
+            now = time.monotonic()
+            with self._lock:
+                free = self._link_free.get(link, now)
+                start = max(now, free)
+                end = start + msg.nbytes / bw
+                self._link_free[link] = end
+            time.sleep(max(0.0, end - now))
         if delay <= 0.0:
             self._put(msg)
         else:
@@ -199,6 +231,16 @@ class Van:
         self._pq: "queue.PriorityQueue" = queue.PriorityQueue()
         self._pq_tie = itertools.count()
         self.use_priority_queue = use_priority_queue
+        # bandwidth-limited fabrics apply backpressure by SLEEPING in
+        # deliver(); that must happen on a dedicated drain thread, never
+        # on an app/handler thread that may hold server state locks
+        # (a server sleeping a full transmission inside its mutex would
+        # serialize every party's requests).  P3 additionally wants the
+        # drain so its priority queue actually reorders under contention.
+        fp = getattr(fabric, "fault", None)
+        self._use_send_thread = bool(use_priority_queue or (
+            fp is not None and (getattr(fp, "lan_bandwidth_bps", 0)
+                                or getattr(fp, "wan_bandwidth_bps", 0))))
         self._running = False
         # byte accounting (ref: van.h:180-181); wan_* counts GLOBAL-domain only
         self.send_bytes = 0
@@ -228,7 +270,7 @@ class Van:
             target=self._recv_loop, name=f"van-recv-{self.node}", daemon=True
         )
         self._recv_thread.start()
-        if self.use_priority_queue:
+        if self._use_send_thread:
             self._send_thread = threading.Thread(
                 target=self._send_loop, name=f"van-send-{self.node}", daemon=True
             )
@@ -243,7 +285,7 @@ class Van:
         self._running = False
         stopper = Message(sender=self.node, recipient=self.node, control=Control.TERMINATE)
         self._box.q.put(stopper)
-        if self.use_priority_queue:
+        if self._use_send_thread:
             self._pq.put((0, next(self._pq_tie), None))
         if self._recv_thread:
             self._recv_thread.join(timeout=5)
@@ -254,7 +296,7 @@ class Van:
         msg.boot = self.boot
         if priority is not None:
             msg.priority = priority
-        if self.use_priority_queue and msg.control is Control.EMPTY:
+        if self._use_send_thread and msg.control is Control.EMPTY:
             # negative: PriorityQueue pops smallest first, we want highest first
             self._pq.put((-msg.priority, next(self._pq_tie), msg))
         else:
