@@ -263,98 +263,16 @@ def child_quant():
 
 def child_overlap():
     """P3 staged-overlap vs BSP step time under a serialized WAN uplink
-    (in-proc sim; VERDICT r1 item 3).  Reports the speedup ratio."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+    (in-proc sim; VERDICT r1 item 3).  Thin wrapper over the shared
+    harness in geomx_tpu.overlap — the regression test runs the same
+    code, so benchmark and test cannot drift apart."""
+    from geomx_tpu.overlap import overlap_vs_bsp_benchmark
 
-    from geomx_tpu.core.config import Config, Topology
-    from geomx_tpu.kvstore import Simulation
-    from geomx_tpu.overlap import StagedModel, run_worker_overlapped
-    from geomx_tpu.training import run_worker
-    from geomx_tpu.transport.van import FaultPolicy
-
-    stages, n, steps = 6, 192_000, 3
-    fwd_s, bwd_s = 0.012, 0.024
-    fault = dict(wan_bandwidth_bps=20e6, wan_latency_s=0.005)
-
-    def build():
-        fns, params = [], []
-        key = jax.random.PRNGKey(0)
-        for i in range(stages):
-            k1, key = jax.random.split(key)
-            params.append({"w": jax.random.normal(k1, (192, 192)) / 14.0,
-                           "big": jnp.zeros((n,), jnp.float32)})
-            last = i == stages - 1
-
-            def fn(p, x, last=last):
-                h = x @ p["w"] + 1e-9 * jnp.sum(p["big"])
-                return h if last else jax.nn.relu(h)
-
-            fns.append(fn)
-        return fns, params
-
-    def ce(logits, y):
-        logp = jax.nn.log_softmax(logits)
-        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
-        return loss, jnp.mean(logits)
-
-    data = [(jnp.zeros((16, 192)), jnp.zeros(16, jnp.int32))] * steps
-
-    def timed(overlapped: bool) -> float:
-        sim = Simulation(Config(
-            topology=Topology(num_parties=1, workers_per_party=1),
-            enable_p3=True), fault=FaultPolicy(**fault))
-        try:
-            kv = sim.all_workers()[0]
-            kv.set_optimizer({"type": "sgd", "lr": 0.01})
-            fns, params = build()
-            if overlapped:
-                model = StagedModel(fns, ce)
-                for i in range(model.n):
-                    f0, b0 = model._fwd[i], model._bwd[i]
-                    model._fwd[i] = (lambda p, x, f0=f0:
-                                     (time.sleep(fwd_s), f0(p, x))[1])
-                    model._bwd[i] = (lambda p, x, g, b0=b0:
-                                     (time.sleep(bwd_s), b0(p, x, g))[1])
-                run_worker_overlapped(kv, model, params, data[:1], 1,
-                                      barrier_init=False)
-                t0 = time.perf_counter()
-                run_worker_overlapped(kv, model, params, data, steps,
-                                      barrier_init=False)
-                return time.perf_counter() - t0
-
-            def grad_fn(ps, x, y):
-                time.sleep(stages * (fwd_s + bwd_s))
-
-                def composed(ps):
-                    h = x
-                    for f, p in zip(fns, ps):
-                        h = f(p, h)
-                    return ce(h, y)
-                (loss, aux), grads = jax.value_and_grad(
-                    composed, has_aux=True)(ps)
-                return loss, aux, grads
-
-            run_worker(kv, params, grad_fn, data[:1], 1, barrier_init=False)
-            t0 = time.perf_counter()
-            run_worker(kv, params, grad_fn, data, steps, barrier_init=False)
-            return time.perf_counter() - t0
-        finally:
-            sim.shutdown()
-
-    bsp = timed(False)
-    ovl = timed(True)
-    print(json.dumps({
-        "bsp_s_per_step": round(bsp / steps, 4),
-        "overlap_s_per_step": round(ovl / steps, 4),
-        "speedup": round(bsp / ovl, 3),
-        "setting": (f"{stages} stages x {n * 4 // 1024}KB, WAN "
-                    f"{fault['wan_bandwidth_bps'] / 1e6:.0f}MB/s uplink, "
-                    f"{fault['wan_latency_s'] * 1000:.0f}ms latency, "
-                    f"modeled compute {(fwd_s + bwd_s) * stages * 1000:.0f}"
-                    "ms/step"),
-    }))
+    res = overlap_vs_bsp_benchmark()
+    res["bsp_s_per_step"] = round(res["bsp_s_per_step"], 4)
+    res["overlap_s_per_step"] = round(res["overlap_s_per_step"], 4)
+    res["speedup"] = round(res["speedup"], 3)
+    print(json.dumps(res))
 
 
 def child_wan():
@@ -485,9 +403,9 @@ def main():
     errors = {}
     cnn = mfu = quant = None
     if not args.skip_tpu:
-        # preflight: is the tunnel alive at all?  jax.devices() has been
-        # observed to hang for minutes when it isn't — probe cheaply first
-        # (the mfu child doubles as the probe with its own timeout)
+        # the cnn child runs first and doubles as the tunnel probe:
+        # jax.devices() has been observed to hang for minutes when the
+        # tunnel is down, and the subprocess timeout contains that
         cnn, err = _run_tpu_child("cnn", timeout=420)
         if err:
             errors["cnn"] = err

@@ -29,13 +29,21 @@ from typing import Optional
 
 
 class Role(enum.Enum):
-    """Node roles (ref: ps-lite/include/ps/internal/message.h:74)."""
+    """Node roles (ref: ps-lite/include/ps/internal/message.h:74; the
+    master worker is env-designated, ref: DMLC_ROLE_MASTER_WORKER
+    postoffice.cc:32-33)."""
 
     WORKER = "worker"
     SERVER = "server"                    # local server (tier-1 aggregator)
     SCHEDULER = "scheduler"              # per-party local scheduler
     GLOBAL_SERVER = "global_server"      # tier-2, runs the optimizer
     GLOBAL_SCHEDULER = "global_scheduler"
+    MASTER_WORKER = "master_worker"      # central-party control-plane
+    #                                      driver: configures optimizer /
+    #                                      sync modes / compression, then
+    #                                      returns before training (ref:
+    #                                      examples/cnn.py:96,
+    #                                      DMLC_ENABLE_CENTRAL_WORKER)
 
     @property
     def is_scheduler(self) -> bool:
@@ -115,6 +123,12 @@ class Topology:
     workers_per_party: int = 1
     num_global_servers: int = 1
     central_party: int = 0  # which party hosts the global tier
+    central_worker: bool = False  # add a dedicated master worker to the
+    #                               central party (ref:
+    #                               DMLC_ENABLE_CENTRAL_WORKER,
+    #                               postoffice.cc:32-33) — a control-
+    #                               plane-only node that configures the
+    #                               cluster and returns before training
 
     def __post_init__(self):
         if self.num_parties < 1 or self.workers_per_party < 1:
@@ -144,6 +158,14 @@ class Topology:
     def global_scheduler(self) -> NodeId:
         return NodeId(Role.GLOBAL_SCHEDULER, 0)
 
+    def master_worker(self) -> Optional[NodeId]:
+        """The central party's control-plane driver, when enabled
+        (ref: master worker lives in the central party and drives
+        init/optimizer/compression, postoffice.cc:32-33)."""
+        if not self.central_worker:
+            return None
+        return NodeId(Role.MASTER_WORKER, 0, self.central_party)
+
     def all_nodes(self):
         nodes = []
         for p in range(self.num_parties):
@@ -152,6 +174,9 @@ class Topology:
             nodes.extend(self.workers(p))
         nodes.append(self.global_scheduler())
         nodes.extend(self.global_servers())
+        mw = self.master_worker()
+        if mw is not None:
+            nodes.append(mw)
         return nodes
 
     @property
@@ -263,6 +288,10 @@ class Config:
 
     # --- fault injection / reliability (ref: van.cc:497-533 PS_DROP_MSG, PS_RESEND)
     drop_rate: float = 0.0
+    channel_drop_rate: float = 0.0  # loss injection for DGT's lossy
+    #                                 channels (>=1) — deterministic loss
+    #                                 for tests where real UDP on
+    #                                 loopback would rarely drop
     resend_timeout_ms: int = 0    # 0 = resender off
 
     # --- elastic recovery (improvement over the reference, whose recovery
@@ -286,6 +315,12 @@ class Config:
             raise ValueError(
                 f"drop_rate must be a fraction in [0,1], got {self.drop_rate} "
                 "(note: the GEOMX_DROP_MSG / PS_DROP_MSG env vars are percents)"
+            )
+        if not 0.0 <= self.channel_drop_rate <= 1.0:
+            raise ValueError(
+                "channel_drop_rate must be a fraction in [0,1], got "
+                f"{self.channel_drop_rate} (note: GEOMX_CHANNEL_DROP_MSG "
+                "is a percent)"
             )
         if self.inter_ts_async_every < 1:
             raise ValueError("inter_ts_async_every must be >= 1")
@@ -319,6 +354,10 @@ class Config:
             ),
             num_global_servers=_env_int(
                 "GEOMX_NUM_GLOBAL_SERVERS", _env_int("DMLC_NUM_GLOBAL_SERVER", 1)
+            ),
+            central_worker=_env_bool(
+                "GEOMX_ENABLE_CENTRAL_WORKER",
+                _env_bool("DMLC_ENABLE_CENTRAL_WORKER"),
             ),
         )
         return Config(
@@ -360,6 +399,7 @@ class Config:
             # both names follow the legacy percent convention (PS_DROP_MSG=10
             # means 10%, ref: van.cc:497-499)
             drop_rate=_env_float("GEOMX_DROP_MSG", _env_float("PS_DROP_MSG", 0.0)) / 100.0,
+            channel_drop_rate=_env_float("GEOMX_CHANNEL_DROP_MSG", 0.0) / 100.0,
             resend_timeout_ms=_env_int(
                 "GEOMX_RESEND_TIMEOUT_MS",
                 _env_int("PS_RESEND_TIMEOUT", 1000) if _env_bool("PS_RESEND") else 0,

@@ -495,3 +495,85 @@ class WorkerKVStore:
 
     def stop(self):
         self.worker.stop()
+
+
+class MasterWorker:
+    """The central party's control-plane-only client.
+
+    Mirrors the reference master worker (ref: DMLC_ROLE_MASTER_WORKER
+    postoffice.cc:32-33; DMLC_ENABLE_CENTRAL_WORKER): it drives cluster
+    configuration — optimizer to the global tier, the global sync mode,
+    WAN compression — and returns before training begins
+    (ref: examples/cnn.py:96 — the master returns right after setup).
+    It never pushes gradients and does not count toward any worker
+    group's barriers.
+
+    Cross-party control commands travel the GLOBAL domain (they cross
+    the WAN from the central party).
+    """
+
+    def __init__(self, postoffice: Postoffice, config: Optional[Config] = None):
+        self.po = postoffice
+        self.config = config or postoffice.config
+        topo = postoffice.topology
+        assert postoffice.node.role.value == "master_worker"
+        # one endpoint toward the global servers; commands to party
+        # servers address them directly over the GLOBAL domain
+        self.worker = KVWorker(
+            APP_PS, 99, postoffice,
+            targets=topo.global_servers(),
+            key_ranges=split_range(topo.num_global_servers),
+            domain=Domain.GLOBAL,
+        )
+
+    def set_optimizer(self, opt_config: dict):
+        """Ship the optimizer to every global server (the master worker's
+        defining job, ref: kvstore.py:452-499 → kController command)."""
+        for gs in self.po.topology.global_servers():
+            self.worker.send_cmd(gs, Ctrl.SET_OPTIMIZER, body=opt_config,
+                                 domain=Domain.GLOBAL)
+
+    def set_sync_global_mode(self, sync: bool):
+        """ref: kvstore.cc:56-63 — the master worker sends kSyncGlobalMode."""
+        for gs in self.po.topology.global_servers():
+            self.worker.send_cmd(gs, Ctrl.SET_SYNC_GLOBAL_MODE,
+                                 body={"sync": sync}, domain=Domain.GLOBAL)
+
+    def set_gradient_compression(self, comp_config: dict):
+        """Configure WAN compression everywhere: every party's local
+        server plus every global server — the central-driver alternative
+        to each party's rank-0 worker configuring its own party."""
+        defaults = {
+            "ratio": self.config.bsc_ratio,
+            "momentum": self.config.bsc_momentum,
+            "sample_rate": self.config.bsc_sample_rate,
+            "threshold": self.config.twobit_threshold,
+            "size_bound": self.config.mpq_size_bound,
+        }
+        comp_config = {**defaults, **comp_config}
+        targets = [(s, Domain.GLOBAL) for s in self.po.topology.servers()]
+        targets += [(gs, Domain.GLOBAL)
+                    for gs in self.po.topology.global_servers()]
+        for node, domain in targets:
+            reply = self.worker.send_cmd(node, Ctrl.SET_COMPRESSION,
+                                         body=comp_config, domain=domain)
+            if isinstance(reply, dict) and "error" in reply:
+                raise ValueError(reply["error"])
+
+    def query_stats(self) -> dict:
+        """Aggregate WAN counters across the global tier.  Numeric stats
+        sum; boolean stats AND (``optimizer_configured`` must mean EVERY
+        shard is configured, or MultiGPS would silently mix optimizers)."""
+        out: Dict[str, object] = {}
+        for gs in self.po.topology.global_servers():
+            stats = self.worker.send_cmd(gs, Ctrl.QUERY_STATS,
+                                         domain=Domain.GLOBAL) or {}
+            for k, v in stats.items():
+                if isinstance(v, bool):
+                    out[k] = bool(out.get(k, True)) and v
+                elif isinstance(v, (int, float)):
+                    out[k] = out.get(k, 0) + v
+        return out
+
+    def stop(self):
+        self.worker.stop()

@@ -77,7 +77,12 @@ class _KeyState:
         self.accum: Optional[np.ndarray] = None
         self.count = 0
         self.parked_pulls: List[Message] = []
-        self.in_flight = False   # a round is between first-push and weights-back
+        self.in_flight = 0       # rounds between push-up and weights-back.
+        #                          A COUNTER, not a bit: back-to-back
+        #                          pushes launch overlapping WAN rounds of
+        #                          one key, and round r's completion must
+        #                          not serve pulls parked behind round r+1
+        #                          with stale weights
         self.version = 0         # completed rounds (local or global)
         self.round = 0           # completed aggregation rounds (HFA K2 gate)
         self.row_sparse = False  # merged grad is mostly-zero rows
@@ -222,7 +227,7 @@ class LocalServer:
                         # still in flight for the old weights (epoch)
                         st.accum = None
                         st.count = 0
-                        st.in_flight = False
+                        st.in_flight = 0
                         st.epoch += 1
                     fresh.append((k, v))
             # pulls that raced ahead of init can be servable now
@@ -273,7 +278,6 @@ class LocalServer:
                 else:
                     st.accum += v
                 st.count += num_merge
-                st.in_flight = True
                 st.priority = msg.priority
                 if st.count >= self.num_workers:
                     completed.append(k)
@@ -287,7 +291,7 @@ class LocalServer:
                     st = self._keys[int(k)]
                     st.accum = None
                     st.count = 0
-                    st.in_flight = False
+                    st.in_flight = 0
                 if msg.pull:
                     self._try_serve_pull_locked(msg)
             if not msg.pull:
@@ -338,7 +342,7 @@ class LocalServer:
             # async: no accumulation round — densify once and forward
             with self._mu:
                 st = self._keys.setdefault(key, _KeyState())
-                st.in_flight = False
+                st.in_flight = 0
                 dense = np.zeros_like(self.store[key], dtype=np.float32)
                 np.add.at(dense.reshape(-1, cols), row_ids, rows)
                 self._drain_parked_locked(st)
@@ -356,7 +360,6 @@ class LocalServer:
                 st.accum = np.zeros_like(self.store[key], dtype=np.float32)
             np.add.at(st.accum.reshape(-1, cols), row_ids, rows)
             st.count += 1
-            st.in_flight = True
             st.row_sparse = True
             if st.count >= self.num_workers:
                 completed.append(key)
@@ -412,6 +415,7 @@ class LocalServer:
                     ls.append(len(st.accum))
                     st.accum = None
                     st.count = 0
+                    st.in_flight += 1  # round launched; finish decrements
                     if st.row_sparse:
                         rs_keys.add(k)
                         st.row_sparse = False  # describes this round only
@@ -667,7 +671,7 @@ class LocalServer:
         to_retry: List[Message] = []
         for k in keys:
             st = self._keys[k]
-            st.in_flight = False
+            st.in_flight = max(0, st.in_flight - 1)
             st.version += 1
             to_retry.extend(st.parked_pulls)
             st.parked_pulls.clear()
@@ -702,7 +706,10 @@ class LocalServer:
             st = self._keys.get(k)
             if st is None:
                 st = self._keys.setdefault(k, _KeyState())
-            if k not in self.store or st.in_flight:
+            # blocked while any WAN round is in flight OR a round is
+            # accumulating (count > 0): both mean fresher weights than
+            # the store's are already owed to this party
+            if k not in self.store or st.in_flight > 0 or st.count > 0:
                 st.parked_pulls.append(req)
                 return False
         if req.cmd == Cmd.ROW_SPARSE_PULL:
@@ -813,6 +820,9 @@ class GlobalServer:
         self._keys: Dict[int, _GlobalKeyState] = {}
         self._mu = threading.RLock()
         self.optimizer: ServerOptimizer = Sgd()
+        self._optimizer_configured = False  # flips on SET_OPTIMIZER; a
+        #                                     central-worker deployment
+        #                                     gates training on it
         self.sync_mode = self.config.sync_global_mode
         self.compression: dict = {"type": "none"}
         self.pull_comp = None  # BroadcastCompressor under bsc/mpq
@@ -1274,6 +1284,7 @@ class GlobalServer:
             # ref: master worker pickles the optimizer, executes on the
             # global server (kvstore.py:452-499, kvstore_dist_server.h:357-364)
             self.optimizer = make_optimizer(body)
+            self._optimizer_configured = True
         elif msg.cmd == Ctrl.SET_COMPRESSION:
             from geomx_tpu.compression import make_push_codec
 
@@ -1315,6 +1326,11 @@ class GlobalServer:
             self.server.reply_cmd(msg, body={
                 "wan_send_bytes": van.wan_send_bytes,
                 "wan_recv_bytes": van.wan_recv_bytes,
+                # lets a central-worker deployment confirm configuration
+                # landed before training starts (the reference sequences
+                # this through the master worker finishing first)
+                "optimizer": type(self.optimizer).__name__.lower(),
+                "optimizer_configured": self._optimizer_configured,
             })
             return
         elif msg.cmd == Ctrl.PROFILER:

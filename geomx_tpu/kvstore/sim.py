@@ -70,6 +70,12 @@ class Simulation:
         for p in range(self.topology.num_parties):
             for w in self.topology.workers(p):
                 self.workers[str(w)] = WorkerKVStore(self.offices[str(w)], config)
+        self.master: Optional["MasterWorker"] = None
+        mw = self.topology.master_worker()
+        if mw is not None:
+            from geomx_tpu.kvstore.client import MasterWorker
+
+            self.master = MasterWorker(self.offices[str(mw)], config)
 
     def worker(self, party: int, rank: int) -> WorkerKVStore:
         return self.workers[str(NodeId.parse(f"worker:{rank}@p{party}"))]
@@ -86,6 +92,8 @@ class Simulation:
         return {"wan_send_bytes": send, "wan_recv_bytes": recv}
 
     def shutdown(self):
+        if self.master is not None:
+            self.master.stop()
         for w in self.workers.values():
             w.stop()
         for s in self.local_servers:

@@ -105,6 +105,10 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
         from geomx_tpu.kvstore.client import WorkerKVStore
 
         role_obj = WorkerKVStore(po, config)
+    elif node.role is Role.MASTER_WORKER:
+        from geomx_tpu.kvstore.client import MasterWorker
+
+        role_obj = MasterWorker(po, config)
     return po, role_obj, stop_ev
 
 
@@ -183,10 +187,34 @@ def _worker_demo(po, kv, args):
     _, params, grad_fn = create_cnn_state(
         jax.random.PRNGKey(0), input_shape=(1, 12, 12, 1))
     widx = kv.party * kv.num_workers + kv.rank
-    if kv.party == 0 and kv.rank == 0:
-        kv.set_optimizer({"type": "adam", "lr": 0.01})
-    if kv.rank == 0 and args.compression != "none":
-        kv.set_gradient_compression({"type": args.compression})
+    topo = po.topology
+    if topo.central_worker:
+        # central-worker deployment: the MASTER drives configuration
+        # (ref: DMLC_ENABLE_CENTRAL_WORKER); workers only gate training
+        # on it having landed, so the first round can't race the default
+        # optimizer
+        from geomx_tpu.kvstore.common import Ctrl
+        from geomx_tpu.transport.message import Domain
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            # EVERY shard must be configured — with MultiGPS a partially
+            # configured tier would silently mix optimizers across keys
+            ok = all((kv.worker.send_cmd(gs, Ctrl.QUERY_STATS,
+                                         domain=Domain.GLOBAL) or {}
+                      ).get("optimizer_configured")
+                     for gs in topo.global_servers())
+            if ok:
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("master worker never configured the "
+                               "optimizer")
+    else:
+        if kv.party == 0 and kv.rank == 0:
+            kv.set_optimizer({"type": args.optimizer, "lr": 0.01})
+        if kv.rank == 0 and args.compression != "none":
+            kv.set_gradient_compression({"type": args.compression})
     kv.barrier()
     it = ShardedIterator(x, y, args.batch, widx, kv.num_all_workers)
     hist = run_worker(kv, params, grad_fn, it, args.steps, barrier_init=True)
@@ -223,6 +251,11 @@ def main(argv=None):
     ap.add_argument("--tsengine-inter-push", action="store_true")
     ap.add_argument("--sync", default="fsa", choices=["fsa", "mixed"])
     ap.add_argument("--dgt", type=int, default=0, choices=[0, 1, 2, 3])
+    ap.add_argument("--central-worker", action="store_true",
+                    help="topology includes a dedicated master worker in "
+                         "the central party (ref: DMLC_ENABLE_CENTRAL_WORKER)")
+    ap.add_argument("--optimizer", default="adam",
+                    choices=["sgd", "adam", "dcasgd"])
     args = ap.parse_args(argv)
     if not args.role:
         ap.error("--role or GEOMX_ROLE required")
@@ -235,9 +268,13 @@ def main(argv=None):
     # env supplies the full documented knob surface (drop injection,
     # resend, heartbeats, tuning — docs/env-vars.md); CLI flags override
     cfg = Config.from_env()
+    central = (args.central_worker
+               or cfg.topology.central_worker
+               or node.role is Role.MASTER_WORKER)
     cfg.topology = Topology(num_parties=args.parties,
                             workers_per_party=args.workers,
-                            num_global_servers=args.global_servers)
+                            num_global_servers=args.global_servers,
+                            central_worker=central)
     cfg.compression = args.compression
     cfg.use_hfa = args.hfa or cfg.use_hfa
     cfg.enable_p3 = args.p3 or cfg.enable_p3
@@ -262,9 +299,28 @@ def main(argv=None):
     print(f"{node}: up", flush=True)
     if node.role is Role.WORKER:
         _worker_demo(po, role_obj, args)
+    elif node.role is Role.MASTER_WORKER:
+        # the master worker's whole life: configure, then return before
+        # training (ref: examples/cnn.py:96 — master returns after setup)
+        role_obj.set_optimizer({"type": args.optimizer, "lr": 0.01})
+        role_obj.set_sync_global_mode(args.sync == "fsa")
+        if args.compression != "none":
+            role_obj.set_gradient_compression({"type": args.compression})
+        print(f"{node}: configured (optimizer={args.optimizer}, "
+              f"sync={args.sync}, compression={args.compression}); "
+              "returning before training", flush=True)
     else:
         stop_ev.wait()
         print(f"{node}: terminating", flush=True)
+    fab = po.van.fabric
+    udp_tx = getattr(fab, "udp_datagrams_sent", 0)
+    udp_rx = getattr(fab, "udp_datagrams_recv", 0)
+    udp_drop = getattr(fab, "udp_dropped", 0)
+    if udp_tx or udp_rx or udp_drop:
+        # observability for DGT acceptance runs: proves the lossy
+        # channels actually rode UDP datagrams, not the reliable conn
+        print(f"{node}: udp_tx={udp_tx} udp_rx={udp_rx} "
+              f"udp_dropped={udp_drop}", flush=True)
     po.stop()
     return 0
 

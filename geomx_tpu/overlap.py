@@ -212,13 +212,119 @@ def run_worker_overlapped(
             log_fn(step, float(loss), float(acc))
 
     # drain the final round so callers observe the synced params
-    for i in range(n):
-        tracker.wait(i, round_no)
-        leaves = [pulled[t].astype(np.float32)
-                  for t in stage_tids[i]]
-        stage_params[i] = jax.tree_util.tree_unflatten(
-            treedefs[i], [jax.numpy.asarray(a) for a in leaves])
+    # (round_no == 0 means the iterator yielded nothing: no pulls exist)
+    if round_no > 0:
+        for i in range(n):
+            tracker.wait(i, round_no)
+            leaves = [pulled[t].astype(np.float32)
+                      for t in stage_tids[i]]
+            stage_params[i] = jax.tree_util.tree_unflatten(
+                treedefs[i], [jax.numpy.asarray(a) for a in leaves])
     kv.wait_all()
     if params_out is not None:
         params_out["params"] = list(stage_params)
     return history
+
+
+def overlap_vs_bsp_benchmark(stages: int = 6, n: int = 192_000,
+                             steps: int = 3, fwd_s: float = 0.012,
+                             bwd_s: float = 0.024,
+                             wan_bandwidth_bps: float = 20e6,
+                             wan_latency_s: float = 0.005) -> dict:
+    """Measure the staged loop against BSP under a serialized WAN uplink.
+
+    The single source of truth for the P3-overlap perf claim — used by
+    both ``bench.py --child overlap`` and the regression test, so the
+    benchmark and the test can never silently measure different things.
+
+    Per-stage device compute is modeled with deterministic host sleeps
+    (machine-dependent matmul times would be noise); both loops carry
+    identical total compute — only the schedule differs.
+    """
+    import time
+
+    import jax.numpy as jnp
+
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.kvstore import Simulation
+    from geomx_tpu.training import run_worker
+    from geomx_tpu.transport.van import FaultPolicy
+
+    def build():
+        fns, params = [], []
+        key = jax.random.PRNGKey(0)
+        for i in range(stages):
+            k1, key = jax.random.split(key)
+            params.append({"w": jax.random.normal(k1, (192, 192)) / 14.0,
+                           "big": jnp.zeros((n,), jnp.float32)})
+            last = i == stages - 1
+
+            def fn(p, x, last=last):
+                h = x @ p["w"] + 1e-9 * jnp.sum(p["big"])
+                return h if last else jax.nn.relu(h)
+
+            fns.append(fn)
+        return fns, params
+
+    def ce(logits, y):
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+        return loss, jnp.mean(logits)
+
+    data = [(jnp.zeros((16, 192)), jnp.zeros(16, jnp.int32))] * steps
+    fault = dict(wan_bandwidth_bps=wan_bandwidth_bps,
+                 wan_latency_s=wan_latency_s)
+
+    def timed(overlapped: bool) -> float:
+        sim = Simulation(Config(
+            topology=Topology(num_parties=1, workers_per_party=1),
+            enable_p3=True), fault=FaultPolicy(**fault))
+        try:
+            kv = sim.all_workers()[0]
+            kv.set_optimizer({"type": "sgd", "lr": 0.01})
+            fns, params = build()
+            if overlapped:
+                model = StagedModel(fns, ce)
+                for i in range(model.n):
+                    f0, b0 = model._fwd[i], model._bwd[i]
+                    model._fwd[i] = (lambda p, x, f0=f0:
+                                     (time.sleep(fwd_s), f0(p, x))[1])
+                    model._bwd[i] = (lambda p, x, g, b0=b0:
+                                     (time.sleep(bwd_s), b0(p, x, g))[1])
+                run_worker_overlapped(kv, model, params, data[:1], 1,
+                                      barrier_init=False)
+                t0 = time.perf_counter()
+                run_worker_overlapped(kv, model, params, data, steps,
+                                      barrier_init=False)
+                return time.perf_counter() - t0
+
+            def grad_fn(ps, x, y):
+                time.sleep(stages * (fwd_s + bwd_s))
+
+                def composed(ps):
+                    h = x
+                    for f, p in zip(fns, ps):
+                        h = f(p, h)
+                    return ce(h, y)
+                (loss, aux), grads = jax.value_and_grad(
+                    composed, has_aux=True)(ps)
+                return loss, aux, grads
+
+            run_worker(kv, params, grad_fn, data[:1], 1, barrier_init=False)
+            t0 = time.perf_counter()
+            run_worker(kv, params, grad_fn, data, steps, barrier_init=False)
+            return time.perf_counter() - t0
+        finally:
+            sim.shutdown()
+
+    bsp = timed(False)
+    ovl = timed(True)
+    return {
+        "bsp_s_per_step": bsp / steps,
+        "overlap_s_per_step": ovl / steps,
+        "speedup": bsp / ovl,
+        "setting": (f"{stages} stages x {n * 4 // 1024}KB, WAN "
+                    f"{wan_bandwidth_bps / 1e6:.0f}MB/s uplink, "
+                    f"{wan_latency_s * 1000:.0f}ms latency, modeled "
+                    f"compute {(fwd_s + bwd_s) * stages * 1000:.0f}ms/step"),
+    }

@@ -53,7 +53,25 @@ def plan_from_env(topology: Topology) -> Dict[str, Tuple[str, int]]:
 
 class TcpFabric:
     """One per process. Only the local node(s) register; deliver() dials
-    the static plan."""
+    the static plan.
+
+    DGT's lossy channels (``msg.channel >= 1``) travel as real **UDP
+    datagrams** to the peer's port (the reference's raw UDP sockets with
+    DSCP/TOS marks, ref: zmq_van.h:95-193): no connection, no
+    retransmission, genuinely lossy — a dropped datagram is simply a
+    zero-filled chunk at the reassembler.  Each lossy channel sends from
+    its own TOS-marked socket (descending priority, ref: the tos ladder
+    in zmq_van.h); oversized payloads fall back to the reliable TCP conn
+    (the reference sizes DGT blocks for UDP, kv_app.h:841-850 — the
+    fallback keeps misconfigured block sizes correct, just not lossy).
+    """
+
+    UDP_MAX = 60_000  # payloads above this ride TCP (IP fragmentation
+    #                   would turn one lost fragment into a lost chunk
+    #                   anyway; 60k stays under the 64k datagram limit)
+
+    # descending DSCP ladder for channels 1..n (ref: zmq_van.h TOS marks)
+    _TOS = (0x88, 0x68, 0x48, 0x28)
 
     def __init__(self, plan: Dict[str, Tuple[str, int]],
                  fault: Optional[FaultPolicy] = None,
@@ -73,8 +91,16 @@ class TcpFabric:
         self._accepted: list = []
         self._established: set = set()
         self._dial_window: Dict[str, float] = {}
+        self._udp_send: Dict[int, socket.socket] = {}  # channel -> socket
+        self._udp_recv: list = []
         self._stop = False
         self.dropped = 0
+        self.udp_datagrams_sent = 0
+        self.udp_datagrams_recv = 0
+        self.udp_dropped = 0  # lossy-channel losses only (injected or
+        #                       sendto failures), distinct from `dropped`
+        #                       which also counts reliable-channel
+        #                       drop injection
 
     # ---- local side ---------------------------------------------------------
     def register(self, node: NodeId) -> _Mailbox:
@@ -105,11 +131,66 @@ class TcpFabric:
         except OSError:
             srv.close()  # a retried register() must not find a dead box
             raise
+        # UDP receiver on the same port number for DGT's lossy channels.
+        # Bound BEFORE the box/threads are installed so a bind failure
+        # leaves no half-registered node (a retried register() finding a
+        # mailbox with no UDP receiver would silently zero-fill every
+        # lossy chunk forever).  Deliberately no SO_REUSEADDR: UDP has no
+        # TIME_WAIT to work around, and on Linux it would let two live
+        # incarnations share the port and split inbound datagrams.
+        udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            deadline = time.monotonic() + 5.0
+            while True:
+                try:
+                    udp.bind(("0.0.0.0", port))
+                    break
+                except OSError as e:
+                    if (e.errno != errno.EADDRINUSE
+                            or time.monotonic() >= deadline):
+                        raise
+                    time.sleep(0.1)
+        except OSError:
+            udp.close()
+            srv.close()
+            raise
         self._boxes[s] = box
         self._listeners.append(srv)
         threading.Thread(target=self._accept_loop, args=(srv, box),
                          name=f"tcp-accept-{s}", daemon=True).start()
+        self._udp_recv.append(udp)
+        threading.Thread(target=self._udp_recv_loop, args=(udp, box),
+                         name=f"udp-recv-{s}", daemon=True).start()
         return box
+
+    def _udp_recv_loop(self, sock: socket.socket, box: _Mailbox):
+        while not self._stop:
+            try:
+                data, _ = sock.recvfrom(65535)
+            except OSError:
+                return
+            try:
+                msg = Message.from_bytes(data)
+            except Exception:
+                continue  # truncated/corrupt datagram: lossy by design
+            with self._registry_mu:
+                self.udp_datagrams_recv += 1
+            box.q.put(msg)
+
+    def _udp_sock(self, channel: int) -> socket.socket:
+        with self._registry_mu:
+            if self._stop:  # lost the race against shutdown()
+                raise OSError(errno.ESHUTDOWN, "fabric shut down")
+            s = self._udp_send.get(channel)
+            if s is None:
+                s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                tos = self._TOS[min(channel - 1, len(self._TOS) - 1)]
+                try:
+                    s.setsockopt(socket.IPPROTO_IP, socket.IP_TOS, tos)
+                except OSError:
+                    pass  # TOS is advisory; some sandboxes deny it
+                self._udp_send[channel] = s
+            return s
 
     def _accept_loop(self, srv: socket.socket, box: _Mailbox):
         while not self._stop:
@@ -159,7 +240,13 @@ class TcpFabric:
     # ---- send side ----------------------------------------------------------
     def deliver(self, msg: Message) -> bool:
         if self.fault.should_drop(msg):
-            self.dropped += 1
+            with self._registry_mu:
+                self.dropped += 1
+                if msg.channel >= 1:
+                    # separate ledger: DGT acceptance metrics must not
+                    # conflate lossy-channel loss with reliable-channel
+                    # drop injection
+                    self.udp_dropped += 1
             return False
         dest = str(msg.recipient)
         box = self._boxes.get(dest)
@@ -169,6 +256,20 @@ class TcpFabric:
         if dest not in self.plan:
             raise KeyError(f"no mailbox for {msg.recipient}")
         data = msg.to_bytes()
+        if msg.channel >= 1 and len(data) <= self.UDP_MAX:
+            # lossy DGT channel: one best-effort datagram, no dial, no
+            # retransmit; send failures are losses by design
+            host, port = self.plan[dest]
+            try:
+                self._udp_sock(msg.channel).sendto(data, (host, port))
+            except OSError:
+                with self._registry_mu:
+                    self.dropped += 1
+                    self.udp_dropped += 1
+                return False
+            with self._registry_mu:
+                self.udp_datagrams_sent += 1
+            return True
         frame = struct.pack("<q", len(data)) + data
         with self._registry_mu:
             mu = self._conn_mus.setdefault(dest, threading.Lock())
@@ -269,11 +370,25 @@ class TcpFabric:
                 srv.close()
             except OSError:
                 pass
+        # wake UDP recv loops blocked in recvfrom: close() alone does not
+        # release the port while the syscall holds the open file
+        # description (the UDP analog of the listener-shutdown note
+        # above; shutdown() on an unconnected UDP socket is ENOTCONN on
+        # Linux, so poke it with a self-datagram instead)
+        for sock in list(self._udp_recv):
+            try:
+                port = sock.getsockname()[1]
+                sock.sendto(b"", ("127.0.0.1", port))
+            except OSError:
+                pass
         with self._registry_mu:
-            for c in list(self._conns.values()) + self._accepted:
+            for c in (list(self._conns.values()) + self._accepted
+                      + self._udp_recv + list(self._udp_send.values())):
                 try:
                     c.close()
                 except OSError:
                     pass
             self._conns.clear()
             self._accepted.clear()
+            self._udp_recv.clear()
+            self._udp_send.clear()

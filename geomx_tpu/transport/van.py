@@ -88,8 +88,9 @@ class FaultPolicy:
 
     @classmethod
     def from_config(cls, config: Config, seed: int = 0) -> "FaultPolicy":
-        """Honor the PS_DROP_MSG-equivalent knob (ref: van.cc:497-499)."""
-        return cls(drop_rate=config.drop_rate, seed=seed)
+        """Honor the PS_DROP_MSG-equivalent knobs (ref: van.cc:497-499)."""
+        return cls(drop_rate=config.drop_rate,
+                   channel_drop_rate=config.channel_drop_rate, seed=seed)
 
 
 class _Mailbox:

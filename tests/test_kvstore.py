@@ -364,3 +364,36 @@ def test_boot_nonce_survives_wire_roundtrip():
     m = Message(app_id=1, customer_id=2, timestamp=3, boot=0xABCDEF)
     m2 = Message.from_bytes(m.to_bytes())
     assert m2.boot == 0xABCDEF
+
+
+def test_master_worker_drives_configuration():
+    """Central-worker deployment (ref: DMLC_ENABLE_CENTRAL_WORKER,
+    postoffice.cc:32-33): the MASTER configures the optimizer and WAN
+    compression; plain workers only train.  FSA invariant holds."""
+    from geomx_tpu.core.config import Role
+
+    cfg = Config(topology=Topology(num_parties=2, workers_per_party=1,
+                                   central_worker=True))
+    assert any(n.role is Role.MASTER_WORKER
+               for n in cfg.topology.all_nodes())
+    sim = Simulation(cfg)
+    try:
+        assert sim.master is not None
+        sim.master.set_optimizer({"type": "sgd", "lr": 0.1})
+        sim.master.set_gradient_compression({"type": "fp16"})
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(64, np.float32))
+        for _ in range(2):
+            for w in ws:
+                w.push(0, np.ones(64, np.float32))
+            for w in ws:
+                w.wait_all()
+        outs = [w.pull_sync(0) for w in ws]
+        # sgd lr=0.1, grad mean = 1 per round, 2 rounds -> -0.2
+        for out in outs:
+            np.testing.assert_allclose(out, -0.2, rtol=1e-3)
+        stats = sim.master.query_stats()
+        assert stats.get("optimizer_configured")
+    finally:
+        sim.shutdown()

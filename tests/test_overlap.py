@@ -181,101 +181,16 @@ def test_overlap_beats_bsp_under_bandwidth():
     serialized communication every step (ref: engine-scheduled per-layer
     push, include/mxnet/engine.h:153-263; VERDICT r1 'P3 is inert').
 
-    Per-stage device compute is modeled with deterministic host sleeps
-    (the CPU's actual matmul time is machine-dependent noise); both loops
-    carry identical total compute, only the schedule differs."""
-    stages = 6
-    n = 192_000  # ~0.77 MB per stage weight dominates the wire size
-    steps = 3
-    fwd_s, bwd_s = 0.012, 0.024  # modeled per-stage fwd/bwd device time
-    fault = dict(wan_bandwidth_bps=20e6, wan_latency_s=0.005)
+    Runs the SAME harness as ``bench.py --child overlap``
+    (overlap_vs_bsp_benchmark), so the benchmark and this regression
+    can't drift apart.  One retry absorbs machine-load flake — the
+    margin is structural, but wall-clock timing under a loaded CI box
+    is not."""
+    from geomx_tpu.overlap import overlap_vs_bsp_benchmark
 
-    def build():
-        fns = []
-        params = []
-        key = jax.random.PRNGKey(0)
-        for i in range(stages):
-            k1, key = jax.random.split(key)
-            params.append({
-                "w": jax.random.normal(k1, (192, 192)) / 14.0,
-                # dominant wire payload: 192k floats ≈ 0.77 MB
-                "big": jnp.zeros((n,), jnp.float32),
-            })
-            last = i == stages - 1
-
-            def fn(p, x, last=last):
-                h = x @ p["w"] + 1e-9 * jnp.sum(p["big"])
-                return h if last else jax.nn.relu(h)
-
-            fns.append(fn)
-        return fns, params
-
-    data = [(jnp.asarray(np.random.default_rng(7).standard_normal(
-        (16, 192), dtype=np.float32)),
-        jnp.asarray(np.zeros(16, np.int32)))] * steps
-
-    def timed_bsp():
-        sim = Simulation(Config(
-            topology=Topology(num_parties=1, workers_per_party=1),
-            enable_p3=True),
-            fault=FaultPolicy(**fault))
-        try:
-            kv = sim.all_workers()[0]
-            kv.set_optimizer({"type": "sgd", "lr": 0.01})
-            fns, params = build()
-
-            def grad_fn(ps, x, y):
-                # same total modeled compute as the staged loop
-                time.sleep(stages * (fwd_s + bwd_s))
-
-                def composed(ps):
-                    h = x
-                    for f, p in zip(fns, ps):
-                        h = f(p, h)
-                    return _ce_loss(h, y)
-                (loss, acc), grads = jax.value_and_grad(
-                    composed, has_aux=True)(ps)
-                return loss, acc, grads
-
-            # warmup round (compile + init) then timed steps
-            run_worker(kv, params, grad_fn, data[:1], 1,
-                       barrier_init=False)
-            t0 = time.perf_counter()
-            run_worker(kv, params, grad_fn, data, steps,
-                       barrier_init=False)
-            return time.perf_counter() - t0
-        finally:
-            sim.shutdown()
-
-    def timed_overlap():
-        sim = Simulation(Config(
-            topology=Topology(num_parties=1, workers_per_party=1),
-            enable_p3=True),
-            fault=FaultPolicy(**fault))
-        try:
-            kv = sim.all_workers()[0]
-            kv.set_optimizer({"type": "sgd", "lr": 0.01})
-            fns, params = build()
-            model = StagedModel(fns, _ce_loss)
-            for i in range(model.n):
-                f0, b0 = model._fwd[i], model._bwd[i]
-                model._fwd[i] = (
-                    lambda p, x, f0=f0: (time.sleep(fwd_s), f0(p, x))[1])
-                model._bwd[i] = (
-                    lambda p, x, g, b0=b0: (time.sleep(bwd_s),
-                                            b0(p, x, g))[1])
-            run_worker_overlapped(kv, model, params, data[:1], 1,
-                                  barrier_init=False)
-            t0 = time.perf_counter()
-            run_worker_overlapped(kv, model, params, data, steps,
-                                  barrier_init=False)
-            return time.perf_counter() - t0
-        finally:
-            sim.shutdown()
-
-    bsp = timed_bsp()
-    ovl = timed_overlap()
-    # structural margin: BSP serializes 2*stages transmissions per step
-    # on the WAN links; the staged loop pipelines them against compute
-    # and per-stage gating.  Require a conservative 25% win.
-    assert ovl < 0.75 * bsp, f"overlap {ovl:.3f}s vs bsp {bsp:.3f}s"
+    last = None
+    for _ in range(2):
+        last = overlap_vs_bsp_benchmark()
+        if last["speedup"] > 1.0 / 0.75:
+            return
+    assert last["speedup"] > 1.0 / 0.75, last
