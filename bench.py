@@ -275,6 +275,54 @@ def child_overlap():
     print(json.dumps(res))
 
 
+def child_stress():
+    """Server merge throughput at scale (VERDICT r1 item 5): one party of
+    4 workers pushing a 50M-element tensor (200 MB) through the two-tier
+    stack; reports merged GB/s per local server and the native threaded
+    axpy's raw rate."""
+    import numpy as np
+
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.kvstore import Simulation
+    from geomx_tpu.native import bindings
+
+    N = 50_000_000
+    rounds = 2
+    sim = Simulation(Config(topology=Topology(num_parties=1,
+                                              workers_per_party=4)))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(N, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        g = np.ones(N, np.float32)
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            for w in ws:
+                w.push(0, g)
+            ws[0].pull_sync(0)
+            for w in ws:
+                w.wait_all()
+        dt = time.perf_counter() - t0
+
+        # native threaded axpy microbenchmark (the merge hot loop)
+        acc = np.zeros(N, np.float32)
+        t1 = time.perf_counter()
+        bindings.accumulate(acc, g)
+        axpy_dt = time.perf_counter() - t1
+        print(json.dumps({
+            "tensor_elems": N,
+            "rounds": rounds,
+            "round_s": round(dt / rounds, 3),
+            "server_merged_gb_per_s": round(
+                len(ws) * (N * 4 / 1e9) * rounds / dt, 3),
+            "native_axpy_gb_per_s": round((N * 4 / 1e9) / axpy_dt, 2),
+            "native_available": bindings.available(),
+        }))
+    finally:
+        sim.shutdown()
+
+
 def child_wan():
     """WAN bytes/step per codec config (in-proc sim, 2 parties x 1 worker —
     topology doesn't change the per-party WAN payload, codecs do)."""
@@ -366,7 +414,8 @@ def _run_tpu_child(name: str, timeout: float, attempts: int = 2,
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--child",
-                    choices=["cnn", "mfu", "quant", "wan", "overlap"])
+                    choices=["cnn", "mfu", "quant", "wan", "overlap",
+                             "stress"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -379,7 +428,8 @@ def main():
         from geomx_tpu.core.platform import apply_platform_from_env
         apply_platform_from_env()
         {"cnn": child_cnn, "mfu": child_mfu, "quant": child_quant,
-         "wan": child_wan, "overlap": child_overlap}[args.child]()
+         "wan": child_wan, "overlap": child_overlap,
+         "stress": child_stress}[args.child]()
         return
 
     cpu_env = {"JAX_PLATFORMS": "cpu", "JAX_PLATFORM_NAME": "cpu"}
@@ -399,6 +449,8 @@ def main():
 
     overlap, overlap_err = _run_child("overlap", timeout=300,
                                       env_extra=cpu_env)
+    stress, stress_err = _run_child("stress", timeout=600,
+                                    env_extra=cpu_env)
 
     errors = {}
     cnn = mfu = quant = None
@@ -419,6 +471,8 @@ def main():
         errors["wan"] = wan_err
     if overlap_err:
         errors["overlap"] = overlap_err
+    if stress_err:
+        errors["stress"] = stress_err
 
     if cnn is not None:
         record = {
@@ -452,6 +506,8 @@ def main():
         record["wan"] = wan
     if overlap:
         record["overlap"] = overlap
+    if stress:
+        record["stress"] = stress
     if errors:
         record["errors"] = errors
     print(json.dumps(record))

@@ -276,6 +276,13 @@ class Config:
     # party gradients over the WAN before one elected server pushes to
     # the global tier (ref: global ASK_PUSH van.cc:1254-1310)
     enable_inter_ts_push: bool = False
+    # overlay timeouts (VERDICT r1: previously hard-coded — a wedged
+    # overlay stalled a worker 2 minutes before erroring).
+    # pair TTL must stay BELOW the ask timeout: a pairing that outlives
+    # the partner's patience would merge with a peer that already gave up
+    ts_relay_wait_s: float = 120.0   # worker wait on the relay buffer
+    ts_ask_timeout_s: float = 30.0   # scheduler ask / merge-wait timeout
+    ts_push_pair_ttl_s: float = 25.0
 
     # --- DGT (ref: kv_app.h:841-850)
     enable_dgt: int = 0           # 0 off; 1 UDP-like lossy; 2 reliable; 3 reliable+requant
@@ -306,6 +313,22 @@ class Config:
     #                               optimizer updates (key-rounds)
 
     # --- misc runtime
+    deterministic: bool = False  # NaiveEngine-analog debug mode (ref:
+    #                              src/engine/naive_engine.cc,
+    #                              MXNET_ENGINE_TYPE): ONE dispatcher
+    #                              thread processes every node's inbound
+    #                              messages in global FIFO order and
+    #                              customers handle inline, so a race
+    #                              reproduces identically run-to-run.
+    #                              In-proc sim only; latency injection is
+    #                              ignored in this mode
+    server_merge_threads: int = 0  # native threads per server merge of a
+    #                                big tensor (0 = one per core; 1 =
+    #                                single-threaded).  Parallelism lives
+    #                                INSIDE each merge (native axpy) so
+    #                                the per-key state machines stay
+    #                                single-writer (ref: engine-pool
+    #                                merge, kvstore_dist_server.h:1277-1296)
     heartbeat_interval_s: float = 0.0   # 0 = off
     heartbeat_timeout_s: float = 10.0
     verbose: int = 0
@@ -407,6 +430,8 @@ class Config:
             request_retry_s=_env_float("GEOMX_REQUEST_RETRY_S", 0.0),
             checkpoint_dir=os.environ.get("GEOMX_CHECKPOINT_DIR", ""),
             auto_ckpt_updates=_env_int("GEOMX_AUTO_CKPT_UPDATES", 0),
+            deterministic=_env_bool("GEOMX_DETERMINISTIC"),
+            server_merge_threads=_env_int("GEOMX_SERVER_MERGE_THREADS", 0),
             heartbeat_interval_s=_env_float(
                 "GEOMX_HEARTBEAT_INTERVAL", _env_float("PS_HEARTBEAT_INTERVAL", 0.0)
             ),

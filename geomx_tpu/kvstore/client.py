@@ -225,7 +225,7 @@ class WorkerKVStore:
                 ok = self._ts_cv.wait_for(
                     lambda: all(self._ts_count.get(k, 0) >= want
                                 for k in parts),
-                    timeout=120.0)
+                    timeout=self.config.ts_relay_wait_s)
                 if not ok:
                     raise TimeoutError(
                         f"{self.po.node}: TS overlay never delivered t{tid}")

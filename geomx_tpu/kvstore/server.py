@@ -36,6 +36,7 @@ import numpy as np
 
 from geomx_tpu.core.config import Config, Group, NodeId, Topology
 from geomx_tpu.kvstore.common import APP_PS, Cmd, Ctrl, RecentRequests
+from geomx_tpu.native.bindings import accumulate as _native_accumulate
 from geomx_tpu.optim import DCASGD, ServerOptimizer, Sgd, make_optimizer
 from geomx_tpu.ps import KVPairs, KVServer, KVWorker, Postoffice
 from geomx_tpu.ps.postoffice import split_range
@@ -274,18 +275,25 @@ class LocalServer:
             for k, v in kvs.slices():
                 st = self._keys.setdefault(k, _KeyState())
                 if st.accum is None:
-                    st.accum = v.astype(np.float32, copy=True)
+                    acc = np.ascontiguousarray(v, dtype=np.float32)
+                    if np.may_share_memory(acc, v):
+                        acc = acc.copy()  # never alias the wire buffer
+                    st.accum = acc
                 else:
-                    st.accum += v
+                    # native threaded merge for big tensors (the server
+                    # hot loop; ref: kvstore_dist_server.h:1277-1296)
+                    _native_accumulate(
+                        st.accum, np.ascontiguousarray(v, np.float32),
+                        self.config.server_merge_threads)
                 st.count += num_merge
                 st.priority = msg.priority
                 if st.count >= self.num_workers:
                     completed.append(k)
         if not self.sync_mode:
             # async local tier: no rounds — clear the aggregation state
-            # FIRST (the accumulate loop above set in_flight), then serve
-            # any piggybacked pull from the current store and forward the
-            # push upward immediately
+            # FIRST (the accumulate loop above raised st.count, which
+            # blocks pull serving), then serve any piggybacked pull from
+            # the current store and forward the push upward immediately
             with self._mu:
                 for k in kvs.keys:
                     st = self._keys[int(k)]
@@ -981,9 +989,16 @@ class GlobalServer:
                 k = int(k)
                 st = self._keys.setdefault(k, _GlobalKeyState())
                 if st.accum is None:
-                    st.accum = v.astype(np.float32, copy=True)
+                    acc = np.ascontiguousarray(v, dtype=np.float32)
+                    if np.may_share_memory(acc, v):
+                        acc = acc.copy()  # never alias the wire buffer
+                    st.accum = acc
                 else:
-                    st.accum += v
+                    # native threaded merge for big tensors (the server
+                    # hot loop; ref: kvstore_dist_server.h:1277-1296)
+                    _native_accumulate(
+                        st.accum, np.ascontiguousarray(v, np.float32),
+                        self.config.server_merge_threads)
                 st.count += num_merge
                 st.parked_pushes.append(entry)
                 if st.count >= self.num_contributors:
@@ -1269,6 +1284,10 @@ class GlobalServer:
             for k in self.store:
                 self._keys.setdefault(k, _GlobalKeyState())
             self.optimizer = opt["optimizer"]
+            # a restored optimizer IS a configured optimizer: central-
+            # worker deployments gate training on this flag, and a
+            # restarted shard reporting False would wedge them
+            self._optimizer_configured = True
             # resume under the checkpointed config, not whatever this
             # fresh process happened to default to
             self.sync_mode = meta.get("sync_mode", self.sync_mode)

@@ -60,9 +60,11 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
         return False
 
     po.add_control_hook(on_control)
-    po.start()
-    if advertise is not None:
-        announce_address(po, *advertise)
+    # NOTE: po.start() happens AFTER role construction (and after a
+    # restarted global server loads its checkpoint): starting the van
+    # first opens a window where replayed pushes reach a server whose
+    # store is still empty (observed as KeyError in the stress test's
+    # mid-run recovery)
 
     role_obj = None
     if node.role is Role.SERVER:
@@ -109,6 +111,9 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
         from geomx_tpu.kvstore.client import MasterWorker
 
         role_obj = MasterWorker(po, config)
+    po.start()
+    if advertise is not None:
+        announce_address(po, *advertise)
     return po, role_obj, stop_ev
 
 

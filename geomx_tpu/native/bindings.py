@@ -80,6 +80,8 @@ def _load() -> Optional[ctypes.CDLL]:
         if hasattr(lib, "geo_recordio_index"):
             lib.geo_recordio_index.argtypes = [_u8p, _i64, _i64, _i64p, _i64p]
             lib.geo_recordio_index.restype = _i64
+        if hasattr(lib, "geo_axpy_acc"):
+            lib.geo_axpy_acc.argtypes = [_f32p, _f32p, _i64, ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -90,3 +92,20 @@ def lib() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _load() is not None
+
+
+def accumulate(acc: np.ndarray, v: np.ndarray, threads: int = 0) -> None:
+    """acc += v with the native threaded kernel when available (the
+    server merge hot loop; ref: engine-pool-scheduled merge,
+    kvstore_dist_server.h:1277-1296).  ``threads`` 0 = one per core.
+    Falls back to numpy (single-threaded) without the library."""
+    l = _load()
+    if (l is not None and hasattr(l, "geo_axpy_acc")
+            and acc.dtype == np.float32 and v.dtype == np.float32
+            and len(acc) == len(v)
+            and acc.flags.c_contiguous and v.flags.c_contiguous):
+        if threads <= 0:
+            threads = os.cpu_count() or 1
+        l.geo_axpy_acc(acc, v, len(acc), threads)
+    else:
+        acc += v

@@ -114,3 +114,32 @@ void geo_sparse_add(float* dense, const float* vals, const int64_t* idx,
 }
 
 }  // extern "C"
+
+#include <thread>
+
+extern "C" {
+
+// Threaded dense accumulate: acc += v, split across `threads` chunks
+// (ref: the reference schedules server merges on the engine's worker
+// pool, kvstore_dist_server.h:1277-1296 — here the parallelism lives
+// INSIDE one merge so the Python per-key state machines stay
+// single-writer).  threads <= 1 degenerates to a plain loop.
+void geo_axpy_acc(float* acc, const float* v, int64_t n, int threads) {
+  if (threads <= 1 || n < (1 << 20)) {
+    for (int64_t i = 0; i < n; ++i) acc[i] += v[i];
+    return;
+  }
+  std::vector<std::thread> pool;
+  const int64_t chunk = (n + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back([acc, v, lo, hi] {
+      for (int64_t i = lo; i < hi; ++i) acc[i] += v[i];
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // extern "C"

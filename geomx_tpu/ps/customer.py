@@ -43,26 +43,33 @@ class Customer:
         self._completed: set = set()
         self._watermark = 0
         self._next_ts = 0
+        # deterministic mode (NaiveEngine analog): no handler threads —
+        # accept() processes inline on the fabric's single dispatcher,
+        # keeping one global total order of all handler executions
+        self._inline = bool(postoffice.config.deterministic)
         self._q: "queue.Queue[Optional[Message]]" = queue.Queue()
         self._pull_q: Optional["queue.Queue[Optional[Message]]"] = (
-            queue.Queue() if split_pull_queue else None
+            queue.Queue() if (split_pull_queue and not self._inline)
+            else None
         )
         self._threads = []
         postoffice.register_customer(self, owns_app=owns_app)
-        t = threading.Thread(
-            target=self._loop, args=(self._q,),
-            name=f"customer-{postoffice.node}-{app_id}.{customer_id}", daemon=True,
-        )
-        t.start()
-        self._threads.append(t)
-        if self._pull_q is not None:
-            t2 = threading.Thread(
-                target=self._loop, args=(self._pull_q,),
-                name=f"customer-pull-{postoffice.node}-{app_id}.{customer_id}",
+        if not self._inline:
+            t = threading.Thread(
+                target=self._loop, args=(self._q,),
+                name=f"customer-{postoffice.node}-{app_id}.{customer_id}",
                 daemon=True,
             )
-            t2.start()
-            self._threads.append(t2)
+            t.start()
+            self._threads.append(t)
+            if self._pull_q is not None:
+                t2 = threading.Thread(
+                    target=self._loop, args=(self._pull_q,),
+                    name=f"customer-pull-{postoffice.node}-{app_id}.{customer_id}",
+                    daemon=True,
+                )
+                t2.start()
+                self._threads.append(t2)
 
     # ---- request tracking ---------------------------------------------------
     def new_request(
@@ -144,6 +151,14 @@ class Customer:
 
     # ---- inbound ------------------------------------------------------------
     def accept(self, msg: Message):
+        if self._inline:
+            try:
+                self._handler(msg)
+            except Exception:  # pragma: no cover
+                import traceback
+
+                traceback.print_exc()
+            return
         if self._pull_q is not None and msg.request and msg.pull and not msg.push:
             self._pull_q.put(msg)
         else:

@@ -195,7 +195,7 @@ class TsClient:
 
     def send_model(self, recipient: NodeId, keys, vals, lens, it: str,
                    cmd: int, app_id: int = 0,
-                   timeout: float = 30.0) -> float:
+                   timeout: Optional[float] = None) -> float:
         """Send a model relay message; block for the receiver's
         AUTOPULL_REPLY; return the observed throughput (bytes/sec)."""
         ack_key = (str(recipient), it)
@@ -206,6 +206,8 @@ class TsClient:
             customer_id=0, timestamp=-1, request=True, push=True, cmd=cmd,
             keys=keys, vals=vals, lens=lens, body={"iter": it},
         )
+        if timeout is None:
+            timeout = self.po.config.ts_ask_timeout_s
         nbytes = msg.nbytes
         t0 = time.monotonic()
         self.po.van.send(msg)
@@ -227,8 +229,10 @@ class TsClient:
 
     def ask_receiver(self, it: str, last: Optional[str] = None,
                      throughput: Optional[float] = None,
-                     timeout: float = 30.0) -> Optional[NodeId]:
+                     timeout: Optional[float] = None) -> Optional[NodeId]:
         """Blocking: who should I send the round-``it`` model to next?"""
+        if timeout is None:
+            timeout = self.po.config.ts_ask_timeout_s
         with self._cv:
             self._seq += 1
             seq = self._seq

@@ -139,10 +139,13 @@ class Trainer:
         kv.barrier()
 
     def fit(self, data_iter: Iterable, steps: int,
-            log_fn: Optional[Callable[[int, float, float], None]] = None
+            log_fn: Optional[Callable[[int, float, float], None]] = None,
+            measure=None,
             ) -> List[Tuple[float, float]]:
         """Train; returns [(loss, acc)] per step.  Updated params stay on
-        the trainer for evaluate()/further fits."""
+        the trainer for evaluate()/further fits.  Pass a
+        ``utils.Measure`` to collect the per-phase timing report
+        (ref: examples/utils.py:120-192)."""
         captured: dict = {}
         if self.hfa_k1 is not None:
             hist = run_worker_hfa(self.kv, self.params, self.grad_fn,
@@ -151,7 +154,7 @@ class Trainer:
         else:
             hist = run_worker(self.kv, self.params, self.grad_fn,
                               data_iter, steps, log_fn=log_fn,
-                              params_out=captured)
+                              params_out=captured, measure=measure)
         if "params" in captured:
             self.params = captured["params"]
         return hist
@@ -209,12 +212,20 @@ def run_worker(
     barrier_init: bool = True,
     log_fn: Optional[Callable[[int, float, float], None]] = None,
     params_out: Optional[dict] = None,
+    measure=None,
 ) -> List[Tuple[float, float]]:
     """Train `steps` steps; returns [(loss, acc), ...] per step.
 
     Under FSA the returned params after each step are identical on every
     worker (the convergence oracle the acceptance tests assert).
+
+    ``measure`` (utils.Measure) brackets each phase — grad compute /
+    push / pull-wait — per step, the reference examples' per-phase
+    timing report (ref: examples/utils.py:120-192).
     """
+    from geomx_tpu.utils.measure import Measure
+
+    m = measure if measure is not None else Measure()
     leaves, treedef = flatten_params(params)
     for tid, leaf in enumerate(leaves):
         kv.init(tid, leaf, barrier=barrier_init)
@@ -230,30 +241,35 @@ def run_worker(
     for step, (x, y) in enumerate(data_iter):
         if step >= steps:
             break
-        loss, acc, grads = grad_fn(params, x, y)
-        g_leaves, _ = jax.tree_util.tree_flatten(grads)
-        if kv.ts_push is not None:
-            # TS push direction: worker-to-worker merge tree; the elected
-            # holder pushes the merged set once for the whole party
-            kv.ts_merge_push({tid: np.asarray(g) * scale
-                              for tid, g in enumerate(g_leaves)})
-            for tid in range(len(leaves)):
-                kv.pull(tid, lambda t, arr: buf.__setitem__(t, arr),
-                        priority=-tid)
-        elif kv.config.enable_p3:
-            # P3: sliced combined push+pull, values ride the push response
-            for tid, g in enumerate(g_leaves):
-                kv.push_pull(tid, np.asarray(g) * scale,
-                             lambda t, arr: buf.__setitem__(t, arr),
-                             priority=-tid)
-        else:
-            for tid, g in enumerate(g_leaves):
-                kv.push(tid, np.asarray(g) * scale, priority=-tid)
-            for tid in range(len(leaves)):
-                kv.pull(tid, lambda t, arr: buf.__setitem__(t, arr),
-                        priority=-tid)
-        kv.wait_all()
+        m.step_start()
+        with m.phase("grad"):
+            loss, acc, grads = grad_fn(params, x, y)
+            g_leaves, _ = jax.tree_util.tree_flatten(grads)
+        with m.phase("push"):
+            if kv.ts_push is not None:
+                # TS push direction: worker-to-worker merge tree; the
+                # elected holder pushes the merged set once for the party
+                kv.ts_merge_push({tid: np.asarray(g) * scale
+                                  for tid, g in enumerate(g_leaves)})
+                for tid in range(len(leaves)):
+                    kv.pull(tid, lambda t, arr: buf.__setitem__(t, arr),
+                            priority=-tid)
+            elif kv.config.enable_p3:
+                # P3: sliced combined push+pull, values ride the response
+                for tid, g in enumerate(g_leaves):
+                    kv.push_pull(tid, np.asarray(g) * scale,
+                                 lambda t, arr: buf.__setitem__(t, arr),
+                                 priority=-tid)
+            else:
+                for tid, g in enumerate(g_leaves):
+                    kv.push(tid, np.asarray(g) * scale, priority=-tid)
+                for tid in range(len(leaves)):
+                    kv.pull(tid, lambda t, arr: buf.__setitem__(t, arr),
+                            priority=-tid)
+        with m.phase("pull_wait"):
+            kv.wait_all()
         params = unflatten_params(treedef, buf)  # type: ignore[arg-type]
+        m.step_end()
         history.append((float(loss), float(acc)))
         if log_fn is not None:
             log_fn(step, float(loss), float(acc))

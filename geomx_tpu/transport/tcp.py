@@ -242,10 +242,14 @@ class TcpFabric:
         if self.fault.should_drop(msg):
             with self._registry_mu:
                 self.dropped += 1
-                if msg.channel >= 1:
-                    # separate ledger: DGT acceptance metrics must not
-                    # conflate lossy-channel loss with reliable-channel
-                    # drop injection
+                # separate ledger: DGT acceptance metrics must not
+                # conflate lossy-channel loss with reliable-channel drop
+                # injection — and only count it as UDP loss if the
+                # message would actually have ridden the UDP path
+                # (remote destination, datagram-sized)
+                if (msg.channel >= 1
+                        and str(msg.recipient) not in self._boxes
+                        and msg.nbytes <= self.UDP_MAX):
                     self.udp_dropped += 1
             return False
         dest = str(msg.recipient)

@@ -99,16 +99,27 @@ class _Mailbox:
 
 
 class InProcFabric:
-    """In-process network: one mailbox per node + a delayed-delivery thread."""
+    """In-process network: one mailbox per node + a delayed-delivery thread.
+
+    ``serial=True`` (or ``Config.deterministic``) is the NaiveEngine
+    analog (ref: src/engine/naive_engine.cc — MXNET_ENGINE_TYPE's
+    sequential debug engine): one global FIFO queue and ONE dispatcher
+    thread process every node's inbound messages in enqueue order, so a
+    race reproduces identically run-to-run (given deterministic
+    producers).  Latency injection is ignored in serial mode — wall-clock
+    reordering would reintroduce the nondeterminism the mode removes."""
 
     def __init__(
         self,
         fault: Optional[FaultPolicy] = None,
         config: Optional[Config] = None,
+        serial: Optional[bool] = None,
     ):
         if fault is None:
             fault = FaultPolicy.from_config(config) if config else FaultPolicy()
         self.fault = fault
+        self.serial = bool(serial if serial is not None
+                           else (config.deterministic if config else False))
         self._boxes: Dict[str, _Mailbox] = {}
         self._lock = threading.Lock()
         self._heap = []  # (due, tiebreak, msg)
@@ -118,6 +129,36 @@ class InProcFabric:
         self._timer: Optional[threading.Thread] = None
         self._link_free: Dict[tuple, float] = {}  # (sender, domain) -> t
         self.dropped = 0  # observability for loss-injection tests
+        self._serial_q: "queue.Queue" = queue.Queue()
+        self._serial_receivers: Dict[str, Callable[[Message], None]] = {}
+        self._serial_thread: Optional[threading.Thread] = None
+
+    # ---- deterministic (serial) mode ------------------------------------
+    def set_serial_receiver(self, node: NodeId,
+                            cb: Callable[[Message], None]) -> None:
+        with self._lock:
+            self._serial_receivers[str(node)] = cb
+            if self._serial_thread is None:
+                self._serial_thread = threading.Thread(
+                    target=self._serial_loop, name="fabric-serial",
+                    daemon=True)
+                self._serial_thread.start()
+
+    def _serial_loop(self):
+        while True:
+            msg = self._serial_q.get()
+            if msg is None:
+                return
+            with self._lock:
+                cb = self._serial_receivers.get(str(msg.recipient))
+            if cb is None:
+                continue  # node stopped/unregistered
+            try:
+                cb(msg)
+            except Exception:  # pragma: no cover
+                import traceback
+
+                traceback.print_exc()
 
     def register(self, node: NodeId) -> _Mailbox:
         with self._lock:
@@ -129,6 +170,12 @@ class InProcFabric:
         if self.fault.should_drop(msg):
             self.dropped += 1
             return False
+        if self.serial:
+            if (msg.control is Control.TERMINATE
+                    and msg.sender == msg.recipient):
+                return True  # van self-stopper: no recv thread to stop
+            self._serial_q.put(msg)
+            return True
         delay = self.fault.latency(msg)
         bw = self.fault.bandwidth(msg)
         if bw > 0.0 and msg.control is Control.EMPTY:
@@ -197,6 +244,8 @@ class InProcFabric:
         with self._cv:
             self._stop = True
             self._cv.notify_all()
+        if self._serial_thread is not None:
+            self._serial_q.put(None)
 
 
 class Van:
@@ -267,10 +316,16 @@ class Van:
     def start(self, receiver: Callable[[Message], None]):
         self._receiver = receiver
         self._running = True
-        self._recv_thread = threading.Thread(
-            target=self._recv_loop, name=f"van-recv-{self.node}", daemon=True
-        )
-        self._recv_thread.start()
+        if getattr(self.fabric, "serial", False):
+            # deterministic mode: the fabric's single dispatcher calls
+            # _handle_inbound in global FIFO order — no recv thread
+            self.fabric.set_serial_receiver(self.node, self._handle_inbound)
+        else:
+            self._recv_thread = threading.Thread(
+                target=self._recv_loop, name=f"van-recv-{self.node}",
+                daemon=True
+            )
+            self._recv_thread.start()
         if self._use_send_thread:
             self._send_thread = threading.Thread(
                 target=self._send_loop, name=f"van-send-{self.node}", daemon=True
@@ -379,43 +434,49 @@ class Van:
             msg = self._box.q.get()
             if msg.control is Control.TERMINATE and msg.sender == self.node:
                 return
-            n = msg.nbytes
-            with self._stats_lock:
-                self.recv_bytes += n
-                if msg.domain is Domain.GLOBAL:
-                    self.wan_recv_bytes += n
-            if self.config.verbose >= 2:
-                self._log_wire("RECV", msg, n)
-            if msg.control is Control.ACK:
-                self._pending_acks.pop(msg.msg_sig, None)
-                continue
-            # ACK + dedup keyed on the *sender's* resender being active (it
-            # stamped msg_sig) — never on this receiver's own config.
-            if msg.msg_sig >= 0 and msg.control is Control.EMPTY:
-                ack = Message(
-                    sender=self.node, recipient=msg.sender, control=Control.ACK,
-                    domain=msg.domain, msg_sig=msg.msg_sig,
-                )
-                self._account_send(ack)
-                # guarded: an ACK to a vanished peer must not kill the
-                # receive thread
-                self._deliver_guarded(ack)
-                # boot in the key: a replacement node restarts its sig
-                # counter, so without the incarnation its first reliable
-                # sends would be suppressed as its predecessor's duplicates
-                dedup_key = (str(msg.sender), msg.boot, msg.msg_sig)
-                if dedup_key in self._seen_sigs:
-                    continue  # duplicate suppression (ref: resender.h:60-77)
-                self._seen_sigs.add(dedup_key)
-                self._seen_order.append(dedup_key)
-                if len(self._seen_order) > self._seen_cap:
-                    self._seen_sigs.discard(self._seen_order.popleft())
-            try:
-                self._receiver(msg)
-            except Exception:  # pragma: no cover - surfaced by tests via logs
-                import traceback
+            self._handle_inbound(msg)
 
-                traceback.print_exc()
+    def _handle_inbound(self, msg: Message):
+        """Process one inbound message: accounting, wire log, ACK/dedup,
+        then the registered receiver.  Called from the recv thread, or
+        directly by a serial fabric's dispatcher (deterministic mode)."""
+        n = msg.nbytes
+        with self._stats_lock:
+            self.recv_bytes += n
+            if msg.domain is Domain.GLOBAL:
+                self.wan_recv_bytes += n
+        if self.config.verbose >= 2:
+            self._log_wire("RECV", msg, n)
+        if msg.control is Control.ACK:
+            self._pending_acks.pop(msg.msg_sig, None)
+            return
+        # ACK + dedup keyed on the *sender's* resender being active (it
+        # stamped msg_sig) — never on this receiver's own config.
+        if msg.msg_sig >= 0 and msg.control is Control.EMPTY:
+            ack = Message(
+                sender=self.node, recipient=msg.sender, control=Control.ACK,
+                domain=msg.domain, msg_sig=msg.msg_sig,
+            )
+            self._account_send(ack)
+            # guarded: an ACK to a vanished peer must not kill the
+            # receive thread
+            self._deliver_guarded(ack)
+            # boot in the key: a replacement node restarts its sig
+            # counter, so without the incarnation its first reliable
+            # sends would be suppressed as its predecessor's duplicates
+            dedup_key = (str(msg.sender), msg.boot, msg.msg_sig)
+            if dedup_key in self._seen_sigs:
+                return  # duplicate suppression (ref: resender.h:60-77)
+            self._seen_sigs.add(dedup_key)
+            self._seen_order.append(dedup_key)
+            if len(self._seen_order) > self._seen_cap:
+                self._seen_sigs.discard(self._seen_order.popleft())
+        try:
+            self._receiver(msg)
+        except Exception:  # pragma: no cover - surfaced by tests via logs
+            import traceback
+
+            traceback.print_exc()
 
     def _resend_loop(self):
         while self._running:

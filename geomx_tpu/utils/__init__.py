@@ -1,2 +1,3 @@
 from geomx_tpu.utils.profiler import Profiler, get_profiler  # noqa: F401
+from geomx_tpu.utils.measure import Measure, aggregate_reports  # noqa: F401
 from geomx_tpu.utils import metrics  # noqa: F401

@@ -88,11 +88,34 @@ class Profiler:
         with open(path, "w") as f:
             json.dump({"traceEvents": events}, f)
 
+    def aggregate(self) -> dict:
+        """Per-span-name aggregate table (ref: the reference's aggregate
+        statistics, src/profiler/aggregate_stats.cc — one row per op
+        name: count/total/min/max/mean), in microseconds."""
+        with self._mu:
+            rows: Dict[str, dict] = {}
+            for e in self._events:
+                if e.get("ph") != "X":
+                    continue
+                r = rows.setdefault(e["name"], {
+                    "count": 0, "total_us": 0.0,
+                    "min_us": float("inf"), "max_us": 0.0,
+                })
+                r["count"] += 1
+                r["total_us"] += e["dur"]
+                r["min_us"] = min(r["min_us"], e["dur"])
+                r["max_us"] = max(r["max_us"], e["dur"])
+        for r in rows.values():
+            r["avg_us"] = r["total_us"] / r["count"]
+        return rows
+
     def stats(self) -> dict:
+        agg = self.aggregate()  # outside _mu (aggregate takes it)
         with self._mu:
             return {
                 "num_events": len(self._events),
                 "counters": dict(self._counters),
+                "aggregate": agg,
             }
 
 

@@ -109,3 +109,83 @@ def test_heartbeat_dead_node_detection():
         assert str(dead) in names
     finally:
         sim.shutdown()
+
+
+def test_measure_phase_report_and_cluster_aggregate(tmp_path):
+    """Per-phase step timing (ref: examples/utils.py:120-192 Measure)
+    + cross-node aggregation (ref: src/profiler/aggregate_stats.cc)."""
+    import json
+    import time
+
+    from geomx_tpu.utils import Measure, aggregate_reports
+
+    m = Measure()
+    for _ in range(3):
+        m.step_start()
+        with m.phase("grad"):
+            time.sleep(0.005)
+        with m.phase("push"):
+            time.sleep(0.001)
+        m.step_end()
+    rep = m.report()
+    assert rep["grad"]["count"] == 3
+    assert rep["grad"]["mean_s"] >= 0.004
+    assert rep["step"]["total_s"] >= rep["push"]["total_s"]
+    m.dump(str(tmp_path / "measure.json"))
+    loaded = json.load(open(tmp_path / "measure.json"))
+    assert loaded["steps"] == 3
+
+    agg = aggregate_reports({"worker:0@p0": loaded,
+                             "worker:1@p0": {"phases": rep}})
+    assert agg["grad"]["count"] == 6
+    assert agg["grad"]["max_node"] in ("worker:0@p0", "worker:1@p0")
+
+
+def test_run_worker_fills_measure():
+    """The worker loop brackets grad/push/pull phases when handed a
+    Measure; the profiler stats() now carries the per-span aggregate
+    table for remote collection."""
+    import numpy as np
+
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.kvstore import Simulation
+    from geomx_tpu.training import run_worker
+    from geomx_tpu.utils import Measure, get_profiler
+
+    sim = Simulation(Config(topology=Topology(num_parties=1,
+                                              workers_per_party=1)))
+    try:
+        kv = sim.all_workers()[0]
+        kv.set_optimizer({"type": "sgd", "lr": 0.1})
+        m = Measure()
+
+        def grad_fn(p, x, y):
+            import jax.numpy as jnp
+            g = {"w": jnp.ones_like(p["w"])}
+            return jnp.float32(1.0), jnp.float32(0.0), g
+
+        import jax.numpy as jnp
+        params = {"w": jnp.zeros(16)}
+        data = [(jnp.zeros(1), jnp.zeros(1))] * 3
+        run_worker(kv, params, grad_fn, data, 3, barrier_init=False,
+                   measure=m)
+        rep = m.report()
+        for phase in ("grad", "push", "pull_wait", "step"):
+            assert rep[phase]["count"] == 3, rep
+    finally:
+        sim.shutdown()
+
+
+def test_profiler_aggregate_table():
+    from geomx_tpu.utils import get_profiler
+
+    p = get_profiler("agg-test")
+    p.start()
+    import time as _t
+    for _ in range(4):
+        with p.span("merge"):
+            _t.sleep(0.001)
+    agg = p.aggregate()
+    assert agg["merge"]["count"] == 4
+    assert agg["merge"]["avg_us"] >= 900
+    assert p.stats()["aggregate"]["merge"]["count"] == 4

@@ -65,3 +65,84 @@ def test_compressed_training_survives_loss():
         assert outs[0].mean() < -0.005
     finally:
         sim.shutdown()
+
+
+@pytest.mark.slow
+def test_scale_4x4_multigps_bsc_with_midrun_recovery(tmp_path):
+    """VERDICT r1 item 5 — the scale ceiling test: 4 parties x 4 workers,
+    3 global servers, a 50M-element tensor sharded by MultiGPS, BSC on,
+    and a global-server kill+restart mid-run (checkpoint + request
+    replay).  Asserts convergence direction + cross-party FSA sync."""
+    import time
+
+    from geomx_tpu.core.config import NodeId
+    from geomx_tpu.kvstore.server import GlobalServer
+    from geomx_tpu.ps import Postoffice
+
+    N = 50_000_000  # 200 MB float32; partitions across the 3 global shards
+    cfg = Config(
+        topology=Topology(num_parties=4, workers_per_party=4,
+                          num_global_servers=3),
+        request_retry_s=2.0,
+        checkpoint_dir=str(tmp_path),
+        auto_ckpt_updates=1,
+    )
+    sim = Simulation(cfg)
+    try:
+        ws = sim.all_workers()
+        init = np.zeros(N, np.float32)
+        for w in ws:
+            w.init(0, init)
+        del init
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        for p in range(4):
+            sim.worker(p, 0).set_gradient_compression(
+                {"type": "bsc", "ratio": 0.001})
+
+        rng = np.random.default_rng(0)
+        # one shared grad buffer: in-proc pushes are zero-copy, so 16
+        # workers sharing it keeps peak memory bounded
+        g = np.abs(rng.standard_normal(N)).astype(np.float32)
+
+        t0 = time.perf_counter()
+        rounds = 3
+        for r in range(rounds):
+            for w in ws:
+                w.push(0, g)
+            if r == 1:
+                # kill global shard 0 mid-round: pushes are in flight,
+                # its parked round is lost with it.  The restart resumes
+                # from the auto-checkpoint; the local servers' replay
+                # (request_retry_s) re-sends the unanswered pushes
+                gs0 = sim.global_servers[0]
+                node = gs0.po.node
+                gs0.stop()
+                gs0.po.stop()
+                new_po = Postoffice(node, cfg.topology, sim.fabric, cfg)
+                new_gs = GlobalServer(new_po, cfg)
+                # checkpoint BEFORE the van starts: otherwise replayed
+                # pushes race the empty store (the launch.py ordering)
+                new_gs.load_checkpoint(
+                    f"{tmp_path}/global_server_{node.rank}.npz")
+                new_po.start()
+                sim.global_servers[0] = new_gs
+                sim.offices[str(node)] = new_po
+            # one puller per party bounds peak memory (4 x 200 MB)
+            outs = [sim.worker(p, 0).pull_sync(0) for p in range(4)]
+            for w in ws:
+                w.wait_all()
+        dt = time.perf_counter() - t0
+
+        # every party identical (FSA through MultiGPS shards + recovery)
+        for p in range(1, 4):
+            np.testing.assert_allclose(outs[p][:100_000], outs[0][:100_000],
+                                       atol=1e-6)
+        # BSC top-k applied SOME negative update to the largest entries
+        assert outs[0].min() < -1e-4
+        # observability: per-server merged bytes/s (16 pushes x 200 MB x
+        # rounds over 4 local servers)
+        merged_gb = 16 * (N * 4 / 1e9) * rounds / 4
+        print(f"stress: {dt:.1f}s for {rounds} rounds; "
+              f"~{merged_gb / dt:.2f} GB/s merged per local server")
+    finally:
+        sim.shutdown()

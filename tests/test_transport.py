@@ -201,3 +201,52 @@ def _wait(pred, timeout=5.0):
             return
         _t.sleep(0.01)
     assert pred()
+
+
+def test_deterministic_mode_trains_and_reproduces():
+    """NaiveEngine-analog serial mode (ref: src/engine/naive_engine.cc):
+    one dispatcher thread, inline customers — two identical runs produce
+    the IDENTICAL wire schedule (message order), and training still
+    converges with exact FSA semantics."""
+    import numpy as np
+
+    from geomx_tpu.core.config import Config as _Config, Topology as _Topo
+    from geomx_tpu.kvstore import Simulation
+    from geomx_tpu.transport import van as vanmod
+
+    def run_once():
+        order = []
+        cfg = _Config(topology=_Topo(num_parties=2, workers_per_party=2),
+                      deterministic=True)
+        sim = Simulation(cfg)
+        assert sim.fabric.serial
+        orig = vanmod.InProcFabric.deliver
+
+        def spy(self, msg, _orig=orig):
+            if msg.control is Control.EMPTY and self is sim.fabric:
+                order.append((str(msg.sender), str(msg.recipient),
+                              msg.timestamp, bool(msg.push),
+                              bool(msg.pull), msg.cmd))
+            return _orig(self, msg)
+
+        vanmod.InProcFabric.deliver = spy
+        try:
+            ws = sim.all_workers()
+            for w in ws:
+                w.init(0, np.zeros(32, np.float32))
+            ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+            for _ in range(2):
+                for w in ws:
+                    w.push(0, np.ones(32, np.float32))
+                outs = [w.pull_sync(0) for w in ws]
+            for out in outs:
+                np.testing.assert_allclose(out, -0.4, rtol=1e-6)
+            return order
+        finally:
+            vanmod.InProcFabric.deliver = orig
+            sim.shutdown()
+
+    first = run_once()
+    second = run_once()
+    assert len(first) > 10
+    assert first == second
