@@ -430,7 +430,10 @@ class Config:
             request_retry_s=_env_float("GEOMX_REQUEST_RETRY_S", 0.0),
             checkpoint_dir=os.environ.get("GEOMX_CHECKPOINT_DIR", ""),
             auto_ckpt_updates=_env_int("GEOMX_AUTO_CKPT_UPDATES", 0),
-            deterministic=_env_bool("GEOMX_DETERMINISTIC"),
+            deterministic=_env_bool(
+                "GEOMX_DETERMINISTIC",
+                os.environ.get("MXNET_ENGINE_TYPE") == "NaiveEngine",
+            ),
             server_merge_threads=_env_int("GEOMX_SERVER_MERGE_THREADS", 0),
             heartbeat_interval_s=_env_float(
                 "GEOMX_HEARTBEAT_INTERVAL", _env_float("PS_HEARTBEAT_INTERVAL", 0.0)

@@ -779,11 +779,20 @@ class LocalServer:
             self.hfa_k2 = int(body.get("k2", 1))
         elif msg.cmd == Ctrl.QUERY_STATS:
             van = self.po.van
+            with self._mu:
+                # memory accounting (the reference profiler's memory
+                # stats, ref: src/profiler/profiler.h:256-304): resident
+                # weight replicas + in-flight aggregation buffers
+                store_b = sum(a.nbytes for a in self.store.values())
+                accum_b = sum(st.accum.nbytes for st in self._keys.values()
+                              if st.accum is not None)
             self.server.reply_cmd(msg, body={
                 "wan_send_bytes": van.wan_send_bytes,
                 "wan_recv_bytes": van.wan_recv_bytes,
                 "send_bytes": van.send_bytes,
                 "recv_bytes": van.recv_bytes,
+                "store_bytes": store_b,
+                "accum_bytes": accum_b,
             })
             return
         elif msg.cmd == Ctrl.PROFILER:
@@ -1342,9 +1351,15 @@ class GlobalServer:
             self.sync_mode = bool(body["sync"])
         elif msg.cmd == Ctrl.QUERY_STATS:
             van = self.po.van
+            with self._mu:
+                store_b = sum(a.nbytes for a in self.store.values())
+                accum_b = sum(st.accum.nbytes for st in self._keys.values()
+                              if st.accum is not None)
             self.server.reply_cmd(msg, body={
                 "wan_send_bytes": van.wan_send_bytes,
                 "wan_recv_bytes": van.wan_recv_bytes,
+                "store_bytes": store_b,
+                "accum_bytes": accum_b,
                 # lets a central-worker deployment confirm configuration
                 # landed before training starts (the reference sequences
                 # this through the master worker finishing first)

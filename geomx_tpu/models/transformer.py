@@ -167,38 +167,92 @@ def make_apply(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
         B, T = tokens.shape
         x = params["embed"][tokens].astype(cd)
         x = x + params["pos"][:T][None].astype(cd)
+        shard = None
+        if use_ring:
+            shard = NamedSharding(mesh, P("dp", "sp", "tp", None))
         for i, layer in enumerate(params["layers"]):
-            h = _rms_norm(x, layer["ln1"])
-            q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(cd))
-            k = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(cd))
-            v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(cd))
-            if use_ring:
-                cons = NamedSharding(mesh, P("dp", "sp", "tp", None))
-                q = lax.with_sharding_constraint(q, cons)
-                k = lax.with_sharding_constraint(k, cons)
-                v = lax.with_sharding_constraint(v, cons)
-            a = attn_op(q, k, v)
-            x = x + jnp.einsum("bthk,hkd->btd", a, layer["wo"].astype(cd))
-            h = _rms_norm(x, layer["ln2"])
-            if cfg.is_moe(i):
-                # dense-routing MoE: every expert computes, outputs are
-                # combined by router weights (exact; experts sharded tp/ep)
-                gates = jax.nn.softmax(
-                    jnp.einsum("btd,de->bte", h.astype(jnp.float32),
-                               layer["router"]), axis=-1).astype(cd)
-                up = jnp.einsum("btd,edf->btef", h, layer["we1"].astype(cd))
-                up = jax.nn.gelu(up)
-                down = jnp.einsum("btef,efd->bted", up, layer["we2"].astype(cd))
-                x = x + jnp.einsum("bted,bte->btd", down, gates)
-            else:
-                up = jax.nn.gelu(jnp.einsum("btd,df->btf", h,
-                                            layer["w1"].astype(cd)))
-                x = x + jnp.einsum("btf,fd->btd", up, layer["w2"].astype(cd))
+            x = _layer_forward(cfg, i, layer, x, attn_op, shard)
         x = _rms_norm(x, params["ln_f"])
         logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(cd))
         return logits.astype(jnp.float32)
 
     return apply
+
+
+def _layer_forward(cfg: TransformerConfig, i: int, layer, x, attn_op,
+                   shard=None):
+    """One transformer block (attention + MLP/MoE residual)."""
+    cd = cfg.compute_dtype
+    h = _rms_norm(x, layer["ln1"])
+    q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(cd))
+    k = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(cd))
+    v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(cd))
+    if shard is not None:
+        q = lax.with_sharding_constraint(q, shard)
+        k = lax.with_sharding_constraint(k, shard)
+        v = lax.with_sharding_constraint(v, shard)
+    a = attn_op(q, k, v)
+    x = x + jnp.einsum("bthk,hkd->btd", a, layer["wo"].astype(cd))
+    h = _rms_norm(x, layer["ln2"])
+    if cfg.is_moe(i):
+        # dense-routing MoE: every expert computes, outputs are
+        # combined by router weights (exact; experts sharded tp/ep)
+        gates = jax.nn.softmax(
+            jnp.einsum("btd,de->bte", h.astype(jnp.float32),
+                       layer["router"]), axis=-1).astype(cd)
+        up = jnp.einsum("btd,edf->btef", h, layer["we1"].astype(cd))
+        up = jax.nn.gelu(up)
+        down = jnp.einsum("btef,efd->bted", up, layer["we2"].astype(cd))
+        x = x + jnp.einsum("bted,bte->btd", down, gates)
+    else:
+        up = jax.nn.gelu(jnp.einsum("btd,df->btf", h,
+                                    layer["w1"].astype(cd)))
+        x = x + jnp.einsum("btf,fd->btd", up, layer["w2"].astype(cd))
+    return x
+
+
+def make_staged(cfg: TransformerConfig, rng: jax.Array):
+    """The flagship split for the P3-overlap worker loop
+    (``geomx_tpu.overlap``): stage 0 = embedding(+pos), one stage per
+    transformer layer (dense attention — the single-chip path), final
+    stage = ln_f + UNTIED LM head.  The head must be untied because
+    tied embeddings would place one tensor in two stages, breaking
+    per-stage push/pull ownership.
+
+    Returns ``(stage_fns, stage_params)`` ready for
+    ``overlap.StagedModel`` / ``run_worker_overlapped``.
+    """
+    params = init_params(cfg, rng)
+    head = jax.random.normal(
+        jax.random.fold_in(rng, 7), (cfg.d_model, cfg.vocab),
+        jnp.float32) / np.sqrt(cfg.d_model)
+
+    def embed_fn(p, tokens):
+        cd = cfg.compute_dtype
+        x = p["embed"][tokens].astype(cd)
+        return x + p["pos"][:tokens.shape[1]][None].astype(cd)
+
+    def layer_fn(p, x, i=0):
+        return _layer_forward(cfg, i, p, x, dense_attention_causal)
+
+    def head_fn(p, x):
+        x = _rms_norm(x, p["ln_f"])
+        return jnp.einsum(
+            "btd,dv->btv", x, p["head"].astype(cfg.compute_dtype)
+        ).astype(jnp.float32)
+
+    stage_fns = [embed_fn]
+    stage_params = [{"embed": params["embed"], "pos": params["pos"]}]
+    for i, layer in enumerate(params["layers"]):
+        stage_fns.append(lambda p, x, i=i: layer_fn(p, x, i))
+        stage_params.append(layer)
+    stage_fns.append(head_fn)
+    stage_params.append({"ln_f": params["ln_f"], "head": head})
+    return stage_fns, stage_params
+
+
+def dense_attention_causal(q, k, v):
+    return dense_attention(q, k, v, causal=True)
 
 
 def lm_loss(apply_fn, params, tokens):

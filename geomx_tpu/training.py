@@ -61,6 +61,7 @@ def run_worker_hfa(
     barrier_init: bool = True,
     log_fn: Optional[Callable[[int, float, float], None]] = None,
     params_out: Optional[dict] = None,
+    measure=None,
 ) -> List[Tuple[float, float]]:
     """HFA client loop (ref: examples/cnn_hfa.py): each worker runs a LOCAL
     optimizer for k1 steps, then pushes weight/num_workers (the local server
@@ -68,6 +69,9 @@ def run_worker_hfa(
     """
     import optax
 
+    from geomx_tpu.utils.measure import Measure
+
+    m = measure if measure is not None else Measure()
     if optimizer is None:
         optimizer = optax.adam(1e-2)
     leaves, treedef = flatten_params(params)
@@ -82,20 +86,25 @@ def run_worker_hfa(
     for step, (x, y) in enumerate(data_iter):
         if step >= steps:
             break
-        loss, acc, grads = grad_fn(params, x, y)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        import optax as _optax
+        m.step_start()
+        with m.phase("grad"):
+            loss, acc, grads = grad_fn(params, x, y)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            import optax as _optax
 
-        params = _optax.apply_updates(params, updates)
+            params = _optax.apply_updates(params, updates)
         if (step + 1) % k1 == 0:
-            w_leaves, _ = jax.tree_util.tree_flatten(params)
-            for tid, w in enumerate(w_leaves):
-                kv.push(tid, np.asarray(w) / n, priority=-tid)
-            for tid in range(len(leaves)):
-                kv.pull(tid, lambda t, arr: buf.__setitem__(t, arr),
-                        priority=-tid)
-            kv.wait_all()
+            with m.phase("push"):
+                w_leaves, _ = jax.tree_util.tree_flatten(params)
+                for tid, w in enumerate(w_leaves):
+                    kv.push(tid, np.asarray(w) / n, priority=-tid)
+                for tid in range(len(leaves)):
+                    kv.pull(tid, lambda t, arr: buf.__setitem__(t, arr),
+                            priority=-tid)
+            with m.phase("pull_wait"):
+                kv.wait_all()
             params = unflatten_params(treedef, buf)  # type: ignore[arg-type]
+        m.step_end()
         history.append((float(loss), float(acc)))
         if log_fn is not None:
             log_fn(step, float(loss), float(acc))
@@ -150,7 +159,8 @@ class Trainer:
         if self.hfa_k1 is not None:
             hist = run_worker_hfa(self.kv, self.params, self.grad_fn,
                                   data_iter, steps, k1=self.hfa_k1,
-                                  log_fn=log_fn, params_out=captured)
+                                  log_fn=log_fn, params_out=captured,
+                                  measure=measure)
         else:
             hist = run_worker(self.kv, self.params, self.grad_fn,
                               data_iter, steps, log_fn=log_fn,

@@ -247,9 +247,12 @@ class TcpFabric:
                 # injection — and only count it as UDP loss if the
                 # message would actually have ridden the UDP path
                 # (remote destination, datagram-sized)
+                # nbytes underestimates the serialized frame (headers /
+                # keys / lens); leave margin so a message the real path
+                # would have sent over TCP isn't ledgered as UDP loss
                 if (msg.channel >= 1
                         and str(msg.recipient) not in self._boxes
-                        and msg.nbytes <= self.UDP_MAX):
+                        and msg.nbytes <= self.UDP_MAX - 4096):
                     self.udp_dropped += 1
             return False
         dest = str(msg.recipient)

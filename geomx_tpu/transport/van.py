@@ -144,6 +144,13 @@ class InProcFabric:
                     daemon=True)
                 self._serial_thread.start()
 
+    def remove_serial_receiver(self, node: NodeId, cb) -> None:
+        """Van.stop in serial mode: only remove OUR registration — a
+        replacement node may have already re-registered under this id."""
+        with self._lock:
+            if self._serial_receivers.get(str(node)) is cb:
+                del self._serial_receivers[str(node)]
+
     def _serial_loop(self):
         while True:
             msg = self._serial_q.get()
@@ -339,6 +346,13 @@ class Van:
 
     def stop(self):
         self._running = False
+        if getattr(self.fabric, "serial", False):
+            # unregister so a "killed" node stops processing — without
+            # this a deterministic-mode restart test would keep the ghost
+            # server merging replayed pushes from its pre-kill store
+            remove = getattr(self.fabric, "remove_serial_receiver", None)
+            if remove is not None:
+                remove(self.node, self._handle_inbound)
         stopper = Message(sender=self.node, recipient=self.node, control=Control.TERMINATE)
         self._box.q.put(stopper)
         if self._use_send_thread:
