@@ -48,13 +48,15 @@ from pathlib import Path
 ROOT = Path(__file__).resolve().parent
 sys.path.insert(0, str(ROOT))
 
-BATCH = 1024
+BATCH = 4096        # measured: throughput saturates at 4096 (584k img/s
+#                     vs 302k at 1024 — the tiny CNN is HBM-bound and
+#                     needs the batch to amortize per-step overheads)
 STEPS = 32          # per on-device scan segment
 A100_PEAK_BF16 = 312e12
-EFF_A100 = 0.20     # assumed FLOP efficiency of the CUDA reference on this
-#                     small CNN (generous: small convs at batch 1024 rarely
-#                     exceed ~20% on A100; stated in output for audit)
+A100_SXM_BW = 2039e9   # A100-SXM 80GB HBM2e
+A100_PCIE_BW = 1555e9  # A100 40GB HBM2
 V5E_PEAK_BF16 = 197e12  # TPU v5e (device reports "TPU v5 lite")
+V5E_BW = 819e9
 
 
 # --------------------------------------------------------------------------
@@ -63,7 +65,9 @@ V5E_PEAK_BF16 = 197e12  # TPU v5e (device reports "TPU v5 lite")
 
 def _cnn_flops_per_image():
     """Analytic fwd FLOPs/image of models/cnn.py's CNN at 32x32x3; the
-    train step is ~3x fwd (fwd + 2x in bwd)."""
+    train step is ~3x fwd (fwd + 2x in bwd).  (XLA's cost_analysis is
+    not usable here: over the axon AOT backend it omits the conv
+    custom-calls and reports only the dense flops.)"""
     f = 0.0
     # conv1: 32x32x3 -> 32x32x32, 3x3;  conv2: pool-> 16x16x64, 3x3
     f += 2 * 32 * 32 * 32 * (3 * 3 * 3)
@@ -71,6 +75,68 @@ def _cnn_flops_per_image():
     # dense: flatten 8*8*64=4096 -> 128 -> 64 -> 10 (models/cnn.py)
     f += 2 * (8 * 8 * 64) * 128 + 2 * 128 * 64 + 2 * 64 * 10
     return 3.0 * f
+
+
+# per-image activation tensor sizes (elements) of the demo CNN
+_CNN_T = dict(x=32 * 32 * 3, y1=32 * 32 * 32, p1=16 * 16 * 32,
+              y2=16 * 16 * 64, p2=8 * 8 * 64, d1=128, d2=64, lg=10)
+_CNN_PARAMS = (27 * 32 + 32) + (288 * 64 + 64) + \
+    (4096 * 128 + 128) + (128 * 64 + 64) + (64 * 10 + 10)
+
+
+def _cnn_bytes_per_image(act_b: float, fused: bool, batch: int) -> float:
+    """HBM traffic per image of one train step, from a per-op table.
+
+    ``act_b``: activation dtype bytes (2=bf16, 4=fp32).  ``fused``:
+    True models an XLA-style executor (pointwise ops — relu, cast, bias
+    — fused into the adjacent conv/pool/dense kernel, so they cost no
+    extra HBM round-trip); False models the reference's MXNet 1.x
+    executor, where each relu fwd/bwd is its own CUDA kernel that
+    re-reads and re-writes the activation (MXNet's pointwise fuser only
+    merges chains of pointwise ops; a lone relu between conv and pool
+    stays a kernel).  Conv/pool/dense boundaries are never fused on
+    either stack.  Input x stays fp32 (4B) in all scenarios.
+    """
+    T = _CNN_T
+    b = 0.0
+    # conv1: read x fp32, write y1
+    b += T["x"] * 4 + T["y1"] * act_b
+    if not fused:                       # relu1 kernel: r+w y1
+        b += 2 * T["y1"] * act_b
+    b += (T["y1"] + T["p1"]) * act_b    # pool1
+    b += (T["p1"] + T["y2"]) * act_b    # conv2
+    if not fused:
+        b += 2 * T["y2"] * act_b        # relu2
+    b += (T["y2"] + T["p2"]) * act_b    # pool2
+    b += (T["p2"] + T["d1"]) * act_b    # dense1
+    if not fused:
+        b += 2 * T["d1"] * act_b
+    b += (T["d1"] + T["d2"]) * act_b    # dense2
+    if not fused:
+        b += 2 * T["d2"] * act_b
+    b += (T["d2"] + T["lg"]) * act_b    # dense3
+    b += 2 * T["lg"] * act_b            # softmax+loss
+    # bwd
+    b += 2 * T["lg"] * act_b                                # dloss
+    b += (T["lg"] + T["d2"] + T["d2"]) * act_b              # dense3 bwd
+    if not fused:
+        b += 3 * T["d2"] * act_b
+    b += (T["d2"] + T["d1"] + T["d1"]) * act_b              # dense2 bwd
+    if not fused:
+        b += 3 * T["d1"] * act_b
+    b += (T["d1"] + T["p2"] + T["p2"]) * act_b              # dense1 bwd
+    b += (T["p2"] + T["y2"] + T["y2"]) * act_b              # pool2 bwd (mask)
+    if not fused:
+        b += 3 * T["y2"] * act_b                            # relu2 bwd
+    b += (T["y2"] + T["p1"]) * act_b                        # conv2 dx
+    b += (T["p1"] + T["y2"]) * act_b                        # conv2 dw
+    b += (T["p1"] + T["y1"] + T["y1"]) * act_b              # pool1 bwd
+    if not fused:
+        b += 3 * T["y1"] * act_b                            # relu1 bwd
+    b += T["x"] * 4 + T["y1"] * act_b                       # conv1 dw
+    # adam: read g,p,m,v; write p,m,v — fp32, amortized over the batch
+    b += _CNN_PARAMS * 4 * 7 / batch
+    return b
 
 
 def child_cnn():
@@ -121,26 +187,79 @@ def child_cnn():
         best_dt = min(best_dt, time.perf_counter() - t0)
 
     ips = BATCH * STEPS / best_dt
-    a100_ref = EFF_A100 * A100_PEAK_BF16 / _cnn_flops_per_image()
+
+    # ---- A100 reference derivation (no A100 is reachable; BASELINE.md:
+    # the reference repo publishes no throughput numbers either).  The
+    # tiny CNN is HBM-bound on any modern chip (arithmetic intensity
+    # ~50 FLOP/byte << both chips' ridge points), so the roofline is the
+    # bandwidth one.  Method: compute per-op HBM traffic tables for (a)
+    # our XLA execution and (b) the reference's MXNet-1.x execution
+    # (unfused pointwise kernels; fp32 activations as its examples run,
+    # plus a bf16-granted variant), calibrate the achievable bandwidth
+    # fraction from OUR measured throughput, and grant the reference the
+    # same fraction on A100 — i.e. the reference is modeled with
+    # XLA-grade kernel efficiency and only pays for its own executor's
+    # memory traffic.  Every input is a spec sheet number, a measured
+    # number, or an auditable per-op count (_cnn_bytes_per_image).
+    flops_img = _cnn_flops_per_image()
+    xla_bytes = _cnn_bytes_per_image(2, fused=True, batch=BATCH)
+    f_bw = ips * xla_bytes / V5E_BW        # our achieved HBM fraction
+
+    def a100_ips(act_b, fused, bw, flop_peak):
+        byt = _cnn_bytes_per_image(act_b, fused, BATCH)
+        t_bytes = byt / (f_bw * bw)
+        t_flops = flops_img / (0.25 * flop_peak)
+        return 1.0 / max(t_bytes, t_flops), byt
+
+    # per-scenario matmul peak: fp32 convs on A100 run TF32 tensor cores
+    # at best (156 TF; generous — the as-published cu80/cu101 builds
+    # predate A100 and TF32 entirely); bf16 scenarios get the 312 TF
+    # bf16 peak
+    A100_TF32 = 156e12
+    scen = {}
+    for name, (act_b, fused, fpk) in {
+        "reference_as_published_fp32": (4, False, A100_TF32),
+        "reference_granted_bf16": (2, False, A100_PEAK_BF16),
+        "hypothetical_xla_grade_peer": (2, True, A100_PEAK_BF16),
+    }.items():
+        sxm, byt = a100_ips(act_b, fused, A100_SXM_BW, fpk)
+        pcie, _ = a100_ips(act_b, fused, A100_PCIE_BW, fpk)
+        scen[name] = {
+            "bytes_per_image": round(byt, 1),
+            "a100_sxm80_ips": round(sxm, 1),
+            "a100_pcie40_ips": round(pcie, 1),
+            "vs_0.9x_sxm80": round(ips / (0.9 * sxm), 3),
+            "vs_0.9x_pcie40": round(ips / (0.9 * pcie), 3),
+        }
+    primary = scen["reference_as_published_fp32"]["vs_0.9x_sxm80"]
     print(json.dumps({
         "images_per_sec": round(ips, 1),
-        "vs_baseline": round(ips / (0.9 * a100_ref), 3),
+        "vs_baseline": primary,
         "a100_ref_derivation": {
-            "a100_images_per_sec": round(a100_ref, 1),
-            "method": "EFF_A100 * A100_PEAK_BF16 / CNN_FLOPS_PER_IMAGE",
-            "eff_a100": EFF_A100,
-            "cnn_train_flops_per_image": _cnn_flops_per_image(),
+            "method": ("bandwidth roofline, per-op traffic tables; "
+                       "achieved-HBM-fraction calibrated on TPU and "
+                       "granted to the reference (see bench.py)"),
+            "primary": "reference_as_published_fp32 on A100-SXM 80GB",
+            "measured_tpu_hbm_fraction": round(f_bw, 3),
+            "tpu_xla_bytes_per_image": round(xla_bytes, 1),
+            "cnn_train_flops_per_image": flops_img,
+            "scenarios": scen,
         },
         "timing": "best_of_3_min, 32-step on-device scan",
+        "batch": BATCH,
         "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0]),
     }))
 
 
-# flagship MFU config: MXU-friendly shapes, fits v5e 16 GB with adam
+# flagship MFU config: MXU-friendly shapes, fits v5e 16 GB with adam.
+# attn_impl='flash' (pallas fused attention, no materialized probs) at
+# batch 4 measured best on-chip: 84.5 TFLOP/s vs 82.8 for bf16-dense
+# at batch 2 and 76.8 for the fp32-dense r1 config; batch 8/16(+remat)
+# and seq 4096 all measured lower (see PROGRESS notes).
 MFU_CFG = dict(vocab=8192, d_model=2048, n_heads=16, n_layers=8,
-               d_ff=8192, max_seq=2048)
-MFU_BATCH = 2
+               d_ff=8192, max_seq=2048, attn_impl="flash")
+MFU_BATCH = 4
 MFU_STEPS = 8
 
 
@@ -235,12 +354,29 @@ def child_quant():
     if not np.allclose(oi, expect):
         raise AssertionError("on-chip 2bit round-trip mismatch")
 
-    reps = 5
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        packed, r = quantize_2bit_tpu(g, r)
-    _ = float(packed[0])
-    dev_dt = (time.perf_counter() - t0) / reps
+    # time the kernel with an ON-DEVICE scan loop: one Python dispatch
+    # per measurement, so the axon tunnel's O(100ms) dispatch latency is
+    # excluded (round-1 style per-call timing measured the tunnel: it
+    # reported ~300 MB/s for a kernel that actually streams at GB/s)
+    reps = 32
+
+    @jax.jit
+    def run_reps(g, r):
+        def body(r, _):
+            packed, r = quantize_2bit_tpu(g, r)
+            return r, packed[0]
+        r, lasts = jax.lax.scan(body, r, None, length=reps)
+        return r, lasts[-1]
+
+    rr, last = run_reps(g, r)      # compile + warmup
+    _ = float(last)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        rr, last = run_reps(g, r)
+        _ = float(last)
+        best = min(best, time.perf_counter() - t0)
+    dev_dt = best / reps
 
     # host codec throughput for comparison
     from geomx_tpu.compression.codecs import TwoBitCodec

@@ -280,21 +280,57 @@ class BroadcastCompressor:
     formulation here is the TPU-build's numerically-safe equivalent).
     """
 
-    def __init__(self, ratio: float = 0.01):
+    def __init__(self, ratio: float = 0.01, trust_init: bool = True):
         self.ratio = float(ratio)
+        # trust_init: the sparse-from-INIT fast path assumes every fresh
+        # subscriber's replica equals the recorded INIT value.  True for
+        # a compressor installed at SET_COMPRESSION / overwrite-INIT time
+        # (the value was just propagated everywhere); MUST be False when
+        # rebuilt from a checkpoint restore — subscribers still hold
+        # whatever they last pulled, not the restored weights
+        self.trust_init = bool(trust_init)
         self._view: Dict[Tuple[str, int], np.ndarray] = {}
+        self._ver: Dict[Tuple[str, int], int] = {}
         self._init_values: Dict[int, np.ndarray] = {}
+        self.resyncs = 0  # forced dense resyncs (observability)
 
     def ensure_base(self, key: int, init_value: np.ndarray):
         self._init_values[key] = np.array(init_value, copy=True)
 
-    def compress(self, subscriber: str, key: int, weights: np.ndarray) -> np.ndarray:
+    def compress(self, subscriber: str, key: int, weights: np.ndarray,
+                 echo_ver: int = 0):
+        """Encode one pull for ``subscriber``.
+
+        ``echo_ver`` is the view version the subscriber last decoded
+        (0 = fresh replica still at the INIT value).  Returns
+        ``(payload, tag, new_ver)`` where tag is "bsc" (sparse delta) or
+        "f32" (dense resync).  The version handshake is what makes the
+        tracked view CRASH-SAFE: a restarted server has no view for the
+        (subscriber, key) pair but the subscriber echoes ver>0 → the
+        mismatch forces a dense resync instead of a delta against the
+        wrong base, which silently corrupts a handful of top-k entries
+        (observed: post-restart FSA desync in the 4x4 stress test).  A
+        replaced subscriber echoes 0 against a tracked ver>0 — same
+        resync.  Lost responses (replayed pulls) also mismatch and heal
+        the same way."""
+        tracked = self._ver.get((subscriber, key), 0)
         base = self._view.get((subscriber, key))
-        if base is None:
-            base = self._init_values.get(key)
-            if base is None:
-                base = np.zeros_like(weights)
-            base = base.copy()
+        if (base is None and tracked == 0 and echo_ver == 0
+                and self.trust_init and (key in self._init_values)):
+            # fresh pair on a server that has seen INIT: both sides hold
+            # the INIT value (overwrite-INITs propagate to every replica
+            # before pulls resume), so the first pull can already be
+            # sparse.  No recorded INIT value (or a restore-rebuilt
+            # compressor, trust_init=False) → dense resync below; a
+            # guessed base here would corrupt the replica.
+            base = self._init_values[key].copy()
+        elif base is None or echo_ver != tracked:
+            self.resyncs += 1
+            new_ver = max(int(echo_ver), tracked) + 1
+            w = np.ascontiguousarray(weights, dtype=np.float32)
+            self._view[(subscriber, key)] = w.copy()
+            self._ver[(subscriber, key)] = new_ver
+            return w, "f32", new_ver
         delta = np.ascontiguousarray(weights.astype(np.float32) - base)
         k = max(1, int(len(delta) * self.ratio))
         nlib = _native()
@@ -306,8 +342,10 @@ class BroadcastCompressor:
             idx = np.argpartition(np.abs(delta), -k)[-k:]
         vals = delta[idx]
         base[idx] += vals
+        new_ver = tracked + 1
         self._view[(subscriber, key)] = base
-        return pack_sparse(vals, idx.astype(np.int64))
+        self._ver[(subscriber, key)] = new_ver
+        return pack_sparse(vals, idx.astype(np.int64)), "bsc", new_ver
 
     @staticmethod
     def decompress_into(store_val: np.ndarray, payload: np.ndarray) -> np.ndarray:

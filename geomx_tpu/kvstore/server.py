@@ -133,6 +133,10 @@ class LocalServer:
         self.hfa_k2 = self.config.hfa_k2
         self._milestone: Dict[int, np.ndarray] = {}
         self._saw_row_sparse = False
+        # per-key pull-view version, echoed to the global tier on every
+        # pull-down so compressed (BSC) responses can detect a desynced
+        # tracked view and resync dense (BroadcastCompressor.compress)
+        self._pull_ver: Dict[int, int] = {}
         self.compression: dict = {"type": "none"}
         self.push_codec = None  # set by Ctrl.SET_COMPRESSION
         # TSEngine intra-party dissemination (ref: DefaultAutoPull
@@ -230,6 +234,11 @@ class LocalServer:
                         st.count = 0
                         st.in_flight = 0
                         st.epoch += 1
+                        # the global tier rebuilds its pull compressor on
+                        # overwrite (tracked vers → 0) with this value as
+                        # the INIT base; echo 0 re-enters the
+                        # sparse-from-INIT path consistently
+                        self._pull_ver[k] = 0
                     fresh.append((k, v))
             # pulls that raced ahead of init can be servable now
             for k, _ in fresh:
@@ -533,7 +542,7 @@ class LocalServer:
                 return
             self.up.zpull(keys,
                           cb=lambda kvs: self._on_pull_down(kvs, epochs),
-                          priority=prio)
+                          priority=prio, body=self._pull_echo(keys))
 
         # group keys by wire codec so each message has a uniform payload
         # dtype + compr tag (ref: PushCompressed kvstore_dist.h:530-563)
@@ -577,7 +586,8 @@ class LocalServer:
                 self.up.push_pull(
                     KVPairs(ks, vals, lens), cmd=Cmd.DEFAULT,
                     cb=lambda kvs: self._on_pull_down(kvs, epochs),
-                    compr=tag, priority=prio)
+                    compr=tag, priority=prio,
+                    body=self._pull_echo([int(k) for k in ks]))
             return
 
         remaining = [len(groups)]
@@ -638,13 +648,27 @@ class LocalServer:
                 new_w = self._decode_pull_value(k, v, tags.get(k, ""))
                 self.store[k] = new_w
                 self._milestone[k] = np.array(new_w, copy=True)
+                # the K2 pull bypassed the pull compressor (dense by
+                # design), so any BSC tracked view upstream is now stale;
+                # -1 can never equal a tracked version, forcing the next
+                # compressed pull of this key to resync dense
+                self._pull_ver[k] = -1
                 live.append(k)
             self._finish_round(live)
+
+    def _pull_echo(self, keys) -> dict:
+        """Request body for a pull-down: echo the per-key view versions
+        so the global tier's BSC compressor can detect desync."""
+        with self._mu:
+            return {"pv": {str(int(k)): self._pull_ver.get(int(k), 0)
+                           for k in keys}}
 
     def _decode_pull_value(self, k: int, v: np.ndarray, tag: str) -> np.ndarray:
         """Decode one pull-down slab into the new full weight vector.
         Caller holds self._mu.  "bsc" payloads are sparse deltas against
-        the current replica (ref: BSC decode :310-336)."""
+        the current replica (ref: BSC decode :310-336); "f32" is a dense
+        resync forced by a view-version mismatch (server or subscriber
+        restarted, or a pull response was lost)."""
         from geomx_tpu.compression.codecs import unpack_sparse
 
         if tag == "bsc":
@@ -654,6 +678,8 @@ class LocalServer:
             return w
         if tag == "fp16":
             return np.ascontiguousarray(v).view(np.float16).astype(np.float32)
+        if tag == "f32":
+            return np.ascontiguousarray(v).view(np.float32).copy()
         return np.array(v, copy=True)
 
     def _on_pull_down(self, kvs: KVPairs, epochs: Optional[dict] = None):
@@ -663,6 +689,7 @@ class LocalServer:
         mid-flight: skip them (their round was aborted and their parked
         pulls already drained); the rest finish normally."""
         tags = kvs.tags or {}
+        pv = kvs.pv or {}
         with self._mu:
             live = []
             for k, v in kvs.slices():
@@ -670,7 +697,25 @@ class LocalServer:
                         and k in self._keys
                         and self._keys[k].epoch != epochs.get(k)):
                     continue  # aborted by a restore
-                self.store[k] = self._decode_pull_value(k, v, tags.get(k, ""))
+                tag = tags.get(k, "")
+                if k in pv:
+                    # overlapping rounds can deliver responses out of
+                    # order (van delay/priority queues): a bsc delta is
+                    # only valid against the exact view it was encoded
+                    # for (ver pv-1), and a dense resync must never be
+                    # overwritten by an older response.  Skipping still
+                    # finishes the round — the replica stays one round
+                    # behind and the next echo mismatch heals it dense.
+                    cur = self._pull_ver.get(k, 0)
+                    if tag == "bsc" and cur != pv[k] - 1:
+                        live.append(k)
+                        continue
+                    if tag == "f32" and pv[k] <= cur:
+                        live.append(k)
+                        continue
+                self.store[k] = self._decode_pull_value(k, v, tag)
+                if k in pv:
+                    self._pull_ver[k] = pv[k]
                 live.append(k)
             self._finish_round(live)
 
@@ -1191,7 +1236,10 @@ class GlobalServer:
         size_bound = (int(self.compression.get("size_bound", 200_000))
                       if typ == "mpq" else 0)
         sender = str(req.sender)
-        ks, chunks, ls, tags = [], [], [], {}
+        echo = {}
+        if isinstance(req.body, dict):
+            echo = req.body.get("pv", {}) or {}
+        ks, chunks, ls, tags, pvs = [], [], [], {}, {}
         for k in req.keys:
             k = int(k)
             w = self.store[k]
@@ -1199,24 +1247,35 @@ class GlobalServer:
                 payload = w.astype(np.float16)
                 tags[str(k)] = "fp16"
             else:
-                payload = self.pull_comp.compress(sender, k, w)
-                tags[str(k)] = "bsc"
+                # version handshake: mismatched echo (either side
+                # restarted, or a lost response) → dense "f32" resync
+                # instead of a delta against a desynced view
+                payload, tag, ver = self.pull_comp.compress(
+                    sender, k, w, echo_ver=int(echo.get(str(k), 0)))
+                tags[str(k)] = tag
+                pvs[str(k)] = ver
             b = np.ascontiguousarray(payload).view(np.uint8)
             ks.append(k); chunks.append(b); ls.append(len(b))
         self.server.response(
             req,
             KVPairs(np.array(ks, dtype=np.int64), np.concatenate(chunks),
                     np.array(ls, dtype=np.int64)),
-            body={"compr": tags},
+            body={"compr": tags, "pv": pvs},
         )
 
-    def _apply_compression_locked(self, body: dict):
-        """Install a compression config (caller holds self._mu)."""
+    def _apply_compression_locked(self, body: dict, trust_init: bool = True):
+        """Install a compression config (caller holds self._mu).
+
+        ``trust_init=False`` (checkpoint restore) builds the pull
+        compressor without the sparse-from-INIT fast path: subscribers
+        still hold whatever they last pulled, not the restored weights,
+        so every pair's first post-restore pull must resync dense."""
         from geomx_tpu.compression import BroadcastCompressor
 
         self.compression = body
         if body.get("type") in ("bsc", "mpq"):
-            pc = BroadcastCompressor(ratio=body.get("ratio", 0.01))
+            pc = BroadcastCompressor(ratio=body.get("ratio", 0.01),
+                                     trust_init=trust_init)
             for k, v in self.store.items():
                 pc.ensure_base(k, v)
             # publish only after bases are seeded (pulls run on a
@@ -1300,8 +1359,12 @@ class GlobalServer:
             # resume under the checkpointed config, not whatever this
             # fresh process happened to default to
             self.sync_mode = meta.get("sync_mode", self.sync_mode)
+            # trust_init=False: subscribers hold whatever they last
+            # pulled, not these restored weights — their first pull after
+            # the restore must resync dense (version-echo mismatch)
             self._apply_compression_locked(
-                meta.get("compression", self.compression))
+                meta.get("compression", self.compression),
+                trust_init=False)
             for k in list(self.store):
                 self._serve_parked_pulls_locked(k)
 
@@ -1365,6 +1428,12 @@ class GlobalServer:
                 # this through the master worker finishing first)
                 "optimizer": type(self.optimizer).__name__.lower(),
                 "optimizer_configured": self._optimizer_configured,
+                # forced dense resyncs of the BSC pull compressor: a
+                # nonzero steady-state rate means the pull direction is
+                # degrading to uncompressed (e.g. sustained overlapping
+                # rounds of one key) — observability for finding that
+                "pull_resyncs": (self.pull_comp.resyncs
+                                 if self.pull_comp is not None else 0),
             })
             return
         elif msg.cmd == Ctrl.PROFILER:

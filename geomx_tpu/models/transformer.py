@@ -33,7 +33,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from geomx_tpu.parallel.ring_attention import dense_attention, ring_attention
+from geomx_tpu.parallel.ring_attention import (
+    dense_attention, fast_dense_attention, ring_attention)
 from geomx_tpu.parallel.ulysses import ulysses_attention
 
 
@@ -51,6 +52,14 @@ class TransformerConfig:
     sp_attn: str = "ring"    # "ring" (K/V rotation, any head count) or
     #                          "ulysses" (head<->seq all-to-all; needs
     #                          per-device heads divisible by sp)
+    attn_impl: str = "fast"  # single-device attention: "fast" (bf16 MXU
+    #                          matmuls, fp32 accum/softmax), "dense"
+    #                          (all-fp32 reference), "flash" (pallas
+    #                          fused kernel, real TPU only)
+    remat: bool = False      # jax.checkpoint each layer: recompute
+    #                          activations in bwd, trading ~1/3 more
+    #                          fwd FLOPs for O(L) less HBM — the TPU
+    #                          recipe for big batches / long seq
 
     @property
     def head_dim(self) -> int:
@@ -145,7 +154,7 @@ def make_apply(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
 
     def attn_op(q, k, v):
         if not use_ring:
-            return dense_attention(q, k, v, causal=True)
+            return _single_device_attention(cfg, q, k, v)
         if cfg.sp_attn == "ulysses":
             sp_fn = lambda a, b, c: ulysses_attention(  # noqa: E731
                 a, b, c, axis_name="sp", causal=True)
@@ -170,13 +179,39 @@ def make_apply(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
         shard = None
         if use_ring:
             shard = NamedSharding(mesh, P("dp", "sp", "tp", None))
+
+        def layer_fn(layer, x, i):
+            return _layer_forward(cfg, i, layer, x, attn_op, shard)
+
+        if cfg.remat:
+            layer_fn = jax.checkpoint(layer_fn, static_argnums=(2,))
         for i, layer in enumerate(params["layers"]):
-            x = _layer_forward(cfg, i, layer, x, attn_op, shard)
+            x = layer_fn(layer, x, i)
         x = _rms_norm(x, params["ln_f"])
         logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(cd))
         return logits.astype(jnp.float32)
 
     return apply
+
+
+def _single_device_attention(cfg: TransformerConfig, q, k, v):
+    """Dispatch the single-device attention per ``cfg.attn_impl``."""
+    if cfg.attn_impl == "dense":
+        return dense_attention(q, k, v, causal=True)
+    if cfg.attn_impl == "fast":
+        return fast_dense_attention(q, k, v, causal=True)
+    if cfg.attn_impl == "flash":
+        # jax's pallas TPU flash kernel wants [B, H, T, Dh]; ours is
+        # [B, T, H, Dh].  Real-TPU only (no interpret path wired).
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention)
+
+        sm = float(1.0 / np.sqrt(q.shape[-1]))
+        o = flash_attention(
+            q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
+            causal=True, sm_scale=sm)
+        return o.swapaxes(1, 2)
+    raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
 
 
 def _layer_forward(cfg: TransformerConfig, i: int, layer, x, attn_op,
@@ -233,7 +268,9 @@ def make_staged(cfg: TransformerConfig, rng: jax.Array):
         return x + p["pos"][:tokens.shape[1]][None].astype(cd)
 
     def layer_fn(p, x, i=0):
-        return _layer_forward(cfg, i, p, x, dense_attention_causal)
+        return _layer_forward(
+            cfg, i, p, x,
+            lambda q, k, v: _single_device_attention(cfg, q, k, v))
 
     def head_fn(p, x):
         x = _rms_norm(x, p["ln_f"])

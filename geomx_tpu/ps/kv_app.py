@@ -36,6 +36,9 @@ class KVPairs:
     vals: np.ndarray                      # flat payload
     lens: Optional[np.ndarray] = None     # int64 [n]; elements of vals per key
     tags: Optional[dict] = None           # int key -> compr tag
+    pv: Optional[dict] = None             # int key -> pull-view version
+    #                                       (BSC pull handshake; see
+    #                                       BroadcastCompressor.compress)
 
     def __post_init__(self):
         self.keys = np.asarray(self.keys, dtype=np.int64)
@@ -402,13 +405,16 @@ class KVWorker(_App):
         ts = msg.timestamp
         if msg.keys is not None and msg.vals is not None:
             # pull (or push_pull) response carrying data
-            tags = None
+            tags = pv = None
             if isinstance(msg.body, dict) and "compr" in msg.body:
                 tags = {int(k): t for k, t in msg.body["compr"].items()}
+            if isinstance(msg.body, dict) and "pv" in msg.body:
+                pv = {int(k): int(v) for k, v in msg.body["pv"].items()}
             with self._mu:
                 buf = self._pull_bufs.get(ts)
                 if buf is not None:
-                    buf.append(KVPairs(msg.keys, msg.vals, msg.lens, tags=tags))
+                    buf.append(KVPairs(msg.keys, msg.vals, msg.lens,
+                                       tags=tags, pv=pv))
                     done = len(buf) == self._pull_expected.get(ts, -1)
                 else:
                     done = False
@@ -430,9 +436,12 @@ class KVWorker(_App):
         aggregation sorts by key before the user callback)."""
         ks, vs, ls = [], [], []
         tags: dict = {}
+        pv: dict = {}
         for p in parts:
             if p.tags:
                 tags.update(p.tags)
+            if p.pv:
+                pv.update(p.pv)
             for k, v in p.slices():
                 ks.append(k); vs.append(v); ls.append(len(v))
         order = np.argsort(np.asarray(ks, dtype=np.int64), kind="stable")
@@ -440,7 +449,7 @@ class KVWorker(_App):
         vals = (np.concatenate([vs[i] for i in order])
                 if vs else np.empty(0, np.float32))
         lens = np.asarray(ls, dtype=np.int64)[order]
-        return KVPairs(keys, vals, lens, tags=tags or None)
+        return KVPairs(keys, vals, lens, tags=tags or None, pv=pv or None)
 
 
 class KVServer(_App):

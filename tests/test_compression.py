@@ -83,13 +83,48 @@ def test_broadcast_compressor_view_tracking():
     bc.ensure_base(0, w0)
     true_w = w0.copy()
     sub_view = w0.copy()
+    ver = 0
     rng = np.random.default_rng(3)
     for step in range(30):
         true_w = true_w + rng.standard_normal(100).astype(np.float32) * 0.1
-        payload = bc.compress("sub", 0, true_w)
+        payload, tag, ver = bc.compress("sub", 0, true_w, echo_ver=ver)
+        assert tag == "bsc"  # echo matches → always sparse
         sub_view = BroadcastCompressor.decompress_into(sub_view, payload)
     # after enough rounds the tracked view is close to the truth
     assert np.abs(sub_view - true_w).mean() < 0.2
+
+
+def test_broadcast_compressor_version_handshake_resyncs():
+    """The crash-safety handshake (stress-test FSA desync fix): any
+    version mismatch — server restart (fresh compressor, subscriber
+    echoes old ver), subscriber restart (echo 0 vs tracked>0), or a lost
+    response (stale echo) — must force a dense "f32" resync; matched
+    echoes stay sparse."""
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal(50).astype(np.float32)
+
+    bc = BroadcastCompressor(ratio=0.1)
+    bc.ensure_base(0, np.zeros(50, np.float32))
+    payload, tag, v1 = bc.compress("s", 0, w, echo_ver=0)
+    assert tag == "bsc" and v1 == 1  # fresh pair: sparse from INIT base
+
+    # server restarted: new compressor has no view, subscriber echoes v1
+    bc2 = BroadcastCompressor(ratio=0.1)
+    bc2.ensure_base(0, w)  # checkpointed weights
+    payload, tag, v2 = bc2.compress("s", 0, w, echo_ver=v1)
+    assert tag == "f32" and v2 > v1
+    np.testing.assert_array_equal(payload, w)
+
+    # matched echo after the resync: sparse again
+    w2 = w + 0.5
+    payload, tag, v3 = bc2.compress("s", 0, w2, echo_ver=v2)
+    assert tag == "bsc" and v3 == v2 + 1
+    # lost response: subscriber still echoes v2 → resync
+    payload, tag, v4 = bc2.compress("s", 0, w2, echo_ver=v2)
+    assert tag == "f32" and v4 > v3
+    # subscriber replaced (echo 0 while tracked > 0) → resync
+    payload, tag, _ = bc2.compress("s", 0, w2, echo_ver=0)
+    assert tag == "f32"
 
 
 def test_make_push_codec_rejects_unknown():

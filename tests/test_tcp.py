@@ -16,14 +16,36 @@ from geomx_tpu.transport import Domain, Message, Van
 from geomx_tpu.transport.tcp import TcpFabric, default_address_plan
 
 
-def free_base_port():
+def free_base_port(span: int = 16):
+    """Pick a base port with ``span`` consecutive free ports.
+
+    Deliberately OUTSIDE the kernel ephemeral range (32768-60999 here):
+    binding port 0 and closing returns an ephemeral port that an outgoing
+    connection from any still-running test process can grab before our
+    process binds it — and connect()-sockets don't set SO_REUSEADDR, so
+    the fabric's EADDRINUSE retry loop can never win that race (observed:
+    test_global_server_replacement_at_new_address flaking).
+    """
+    import random
     import socket
 
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    for _ in range(200):
+        base = random.randrange(18000, 28000)
+        try:
+            socks = []
+            try:
+                for i in range(span):
+                    s = socket.socket()
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                    s.bind(("127.0.0.1", base + i))
+                    socks.append(s)
+            finally:
+                for s in socks:
+                    s.close()
+            return base
+        except OSError:
+            continue
+    raise RuntimeError("no free port span found")
 
 
 def test_tcp_fabric_roundtrip():
