@@ -205,10 +205,19 @@ def child_cnn():
     xla_bytes = _cnn_bytes_per_image(2, fused=True, batch=BATCH)
     f_bw = ips * xla_bytes / V5E_BW        # our achieved HBM fraction
 
+    # The reference is granted a FIXED 0.70 HBM fraction per kernel (the
+    # practical ceiling of well-tuned bandwidth-bound CUDA kernels; its
+    # executor's inefficiency is the extra traffic, already counted in
+    # the per-op tables) — NOT our measured fraction.  Granting the
+    # measured fraction would cancel ips out of the ratio entirely,
+    # making vs_baseline blind to real regressions on our side.
+    EFF_REF_BW = 0.70
+    EFF_REF_FLOPS = 0.25
+
     def a100_ips(act_b, fused, bw, flop_peak):
         byt = _cnn_bytes_per_image(act_b, fused, BATCH)
-        t_bytes = byt / (f_bw * bw)
-        t_flops = flops_img / (0.25 * flop_peak)
+        t_bytes = byt / (EFF_REF_BW * bw)
+        t_flops = flops_img / (EFF_REF_FLOPS * flop_peak)
         return 1.0 / max(t_bytes, t_flops), byt
 
     # per-scenario matmul peak: fp32 convs on A100 run TF32 tensor cores
@@ -237,9 +246,10 @@ def child_cnn():
         "vs_baseline": primary,
         "a100_ref_derivation": {
             "method": ("bandwidth roofline, per-op traffic tables; "
-                       "achieved-HBM-fraction calibrated on TPU and "
-                       "granted to the reference (see bench.py)"),
+                       "reference granted a fixed 0.70 HBM fraction per "
+                       "kernel + 0.25 matmul-peak fraction (see bench.py)"),
             "primary": "reference_as_published_fp32 on A100-SXM 80GB",
+            "granted_ref_hbm_fraction": EFF_REF_BW,
             "measured_tpu_hbm_fraction": round(f_bw, 3),
             "tpu_xla_bytes_per_image": round(xla_bytes, 1),
             "cnn_train_flops_per_image": flops_img,

@@ -297,6 +297,20 @@ class BroadcastCompressor:
     def ensure_base(self, key: int, init_value: np.ndarray):
         self._init_values[key] = np.array(init_value, copy=True)
 
+    def invalidate_key(self, key: int, new_init: np.ndarray):
+        """Overwrite-INIT of ``key``: the new value was just propagated
+        to every replica, so drop all subscribers' tracked views/versions
+        for THIS key and re-seed its INIT base — echo-0 pulls re-enter
+        the sparse-from-INIT path against the propagated value.  Other
+        keys' handshake state stays untouched (a full rebuild would
+        re-seed their INIT bases from trained weights that echo-0
+        subscribers never held)."""
+        self.ensure_base(key, new_init)
+        for pair in [p for p in self._view if p[1] == key]:
+            del self._view[pair]
+        for pair in [p for p in self._ver if p[1] == key]:
+            del self._ver[pair]
+
     def compress(self, subscriber: str, key: int, weights: np.ndarray,
                  echo_ver: int = 0):
         """Encode one pull for ``subscriber``.

@@ -83,8 +83,20 @@ class WorkerKVStore:
         parts = sorted(self.plan.parts(tid, flat.size, priority),
                        key=lambda p: p.ps_key)
         keys = np.array([p.ps_key for p in parts], dtype=np.int64)
-        vals = np.concatenate([flat[p.start:p.start + p.length] for p in parts])
         lens = np.array([p.length for p in parts], dtype=np.int64)
+        # partition plans slice the tensor in key order: when the parts
+        # tile ``flat`` exactly, skip the concatenate — the push payload
+        # is the caller's buffer (in-proc delivery is zero-copy; servers
+        # copy on first touch, and the caller must not mutate the buffer
+        # until the push is acked — the reference's async-push contract)
+        off = 0
+        for p in parts:
+            if p.start != off:
+                break
+            off += p.length
+        if off == flat.size:
+            return KVPairs(keys, flat, lens)
+        vals = np.concatenate([flat[p.start:p.start + p.length] for p in parts])
         return KVPairs(keys, vals, lens)
 
     def _decode(self, tid: int, kvs: KVPairs) -> np.ndarray:
@@ -177,7 +189,11 @@ class WorkerKVStore:
         workers' contributions (TS push-direction: the elected holder
         pushes once for everyone, ref: num_merge counting van.cc:1197-1252).
         """
-        flat = np.asarray(grad).astype(np.float32).ravel()
+        # no-copy when already float32/contiguous: the payload may alias
+        # the caller's buffer all the way into the in-proc fabric (the
+        # async-push contract — don't mutate the buffer until acked;
+        # servers copy on first touch)
+        flat = np.asarray(grad, dtype=np.float32).ravel()
         fields = {"body": {"num_merge": int(num_merge)}} if num_merge > 1 else {}
         ts = self.worker.zpush(self._encode(tid, flat, priority),
                                cmd=Cmd.DEFAULT, priority=priority, **fields)

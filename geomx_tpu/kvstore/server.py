@@ -68,6 +68,19 @@ def _handle_profiler_cmd(po: Postoffice, msg: Message, server: KVServer):
     server.reply_cmd(msg, body=p.stats())
 
 
+def _f32_payload(arrs: List[np.ndarray]) -> np.ndarray:
+    """Build a pull-response payload with exactly ONE full copy.
+
+    The copy is deliberate — responses must be isolated from the store
+    (in-proc delivery is zero-copy and the store is mutated in place by
+    BSC decode) — but ``astype`` + ``concatenate`` was TWO copies, which
+    at the 200 MB-tensor scale regime is ~0.4 s of pure memcpy per
+    response."""
+    if len(arrs) == 1:
+        return arrs[0].astype(np.float32)
+    return np.concatenate([np.asarray(a, np.float32) for a in arrs])
+
+
 class _KeyState:
     """Per-ps-key aggregation state on the local server."""
 
@@ -786,12 +799,12 @@ class LocalServer:
         for k in req.keys:
             k = int(k)
             w = self.store[k]
-            ks.append(k); vs.append(w.astype(np.float32)); ls.append(len(w))
+            ks.append(k); vs.append(w); ls.append(len(w))
         # P3 piggybacked pushes park here until the round finishes; record
         # the response so a replay re-serves values instead of re-merging
         self._recent.mark_done(req)
         self.server.response(req, KVPairs(
-            np.array(ks, dtype=np.int64), np.concatenate(vs),
+            np.array(ks, dtype=np.int64), _f32_payload(vs),
             np.array(ls, dtype=np.int64)))
         return True
 
@@ -963,8 +976,13 @@ class GlobalServer:
                         # init may race ahead of early pulls
                         self._serve_parked_pulls_locked(int(k))
                 if fresh and overwrite and self.pull_comp is not None:
-                    # subscriber base views track the OLD weights — rebuild
-                    self._apply_compression_locked(self.compression)
+                    # drop ONLY the overwritten keys' tracked views and
+                    # re-seed their INIT bases with the propagated value;
+                    # a full compressor rebuild would also re-seed
+                    # untouched keys' bases from trained weights that
+                    # echo-0 subscribers never held
+                    for k, v in kvs.slices():
+                        self.pull_comp.invalidate_key(int(k), v)
                 elif fresh and self.pull_comp is not None:
                     for k, v in kvs.slices():
                         self.pull_comp.ensure_base(int(k), v)
@@ -1077,8 +1095,12 @@ class GlobalServer:
                     # apply additively (ref: HandleHFAAccumulate :959-972)
                     self.store[k] = self.store[k] + st.accum
                 else:
-                    grad = st.accum / self.num_contributors
-                    self.store[k] = self.optimizer.update(k, self.store[k], grad)
+                    # accum is donated: update_scaled may build the new
+                    # weights in it, skipping the /num temporary and the
+                    # result allocation (big-tensor hot path)
+                    self.store[k] = self.optimizer.update_scaled(
+                        k, self.store[k], st.accum,
+                        1.0 / self.num_contributors)
                 st.accum = None
                 st.count = 0
                 for ent in st.parked_pushes:
@@ -1149,12 +1171,13 @@ class GlobalServer:
         with self._mu:
             for k, v in kvs.slices():
                 k = int(k)
-                grad = v.astype(np.float32)
+                grad = v.astype(np.float32)  # copy: donated below
                 if isinstance(self.optimizer, DCASGD):
                     self.store[k] = self.optimizer.update(
                         k, self.store[k], grad, sender=str(msg.sender))
                 else:
-                    self.store[k] = self.optimizer.update(k, self.store[k], grad)
+                    self.store[k] = self.optimizer.update_scaled(
+                        k, self.store[k], grad, 1.0)
             self._auto_ckpt_locked(len(kvs.keys))
             if self.ts_inter is not None and msg.cmd == Cmd.DEFAULT:
                 self._ts_async_dirty.update(int(k) for k in kvs.keys)
@@ -1216,9 +1239,9 @@ class GlobalServer:
         for k in req.keys:
             k = int(k)
             w = self.store[k]
-            ks.append(k); vs.append(w.astype(np.float32)); ls.append(len(w))
+            ks.append(k); vs.append(w); ls.append(len(w))
         self.server.response(req, KVPairs(
-            np.array(ks, dtype=np.int64), np.concatenate(vs),
+            np.array(ks, dtype=np.int64), _f32_payload(vs),
             np.array(ls, dtype=np.int64)))
 
     def _respond_pull_compressed(self, req: Message):

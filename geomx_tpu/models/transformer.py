@@ -155,13 +155,16 @@ def make_apply(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     def attn_op(q, k, v):
         if not use_ring:
             return _single_device_attention(cfg, q, k, v)
+        # attn_impl="dense" keeps the all-fp32 reference blocks; any
+        # other impl runs the sp blocks bf16-on-MXU with fp32 accum
+        fast = cfg.attn_impl != "dense"
         if cfg.sp_attn == "ulysses":
             sp_fn = lambda a, b, c: ulysses_attention(  # noqa: E731
-                a, b, c, axis_name="sp", causal=True)
+                a, b, c, axis_name="sp", causal=True, fast=fast)
         else:
             sp_fn = lambda a, b, c: ring_attention(  # noqa: E731
                 a, b, c, axis_name="sp", axis_size=mesh.shape["sp"],
-                causal=True)
+                causal=True, fast=fast)
         spec = P("dp", "sp", "tp", None)
         f = shard_map(
             sp_fn,
@@ -286,10 +289,6 @@ def make_staged(cfg: TransformerConfig, rng: jax.Array):
     stage_fns.append(head_fn)
     stage_params.append({"ln_f": params["ln_f"], "head": head})
     return stage_fns, stage_params
-
-
-def dense_attention_causal(q, k, v):
-    return dense_attention(q, k, v, causal=True)
 
 
 def lm_loss(apply_fn, params, tokens):

@@ -23,20 +23,32 @@ import numpy as np
 from jax import lax
 
 
-def _block_attn(q, k, v, bias):
+def _block_attn(q, k, v, bias, fast: bool = False):
     """One (Q-block, KV-block) partial attention.
 
     q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; bias: [Tq, Tk] additive.
     Returns (scores_max [B,Tq,H], exp_sum [B,Tq,H], out [B,Tq,H,D]).
+
+    ``fast`` keeps the two matmuls in the input dtype (bf16 on TPU →
+    MXU-native passes) with float32 accumulation; the online-softmax
+    statistics stay float32 either way.  False = all-fp32 reference.
     """
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
-    s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    if fast:
+        s = jnp.einsum("bqhd,bkhd->bqhk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+    else:
+        s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
     s = s + bias[None, :, None, :]
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(jnp.float32))
+    if fast:
+        o = jnp.einsum("bqhk,bkhd->bqhd", p.astype(q.dtype), v,
+                       preferred_element_type=jnp.float32)
+    else:
+        o = jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(jnp.float32))
     return m, l, o
 
 
@@ -47,12 +59,15 @@ def ring_attention(
     axis_name: str = "sp",
     axis_size: Optional[int] = None,
     causal: bool = True,
+    fast: bool = False,
 ) -> jax.Array:
     """Exact attention with K/V ring rotation over ``axis_name``.
 
     Shapes (per device): q/k/v [B, T_local, H, D].  Global sequence =
     axis_size * T_local, laid out contiguously by sp rank.  Returns
-    [B, T_local, H, D] in q.dtype.
+    [B, T_local, H, D] in q.dtype.  ``fast`` = bf16 MXU matmuls with
+    fp32 accumulation in each block (see _block_attn); accumulation
+    across ring hops is float32 either way.
     """
     if axis_size is None:
         axis_size = lax.axis_size(axis_name)
@@ -82,7 +97,7 @@ def ring_attention(
     def step(i, carry):
         k_blk, v_blk, m, l, o = carry
         src = (my + i) % axis_size
-        bm, bl, bo = _block_attn(q, k_blk, v_blk, bias_for(src))
+        bm, bl, bo = _block_attn(q, k_blk, v_blk, bias_for(src), fast=fast)
         new_m = jnp.maximum(m, bm)
         # guard fully-masked blocks (bm = -inf everywhere for that row)
         alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - new_m, neg))

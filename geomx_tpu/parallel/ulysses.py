@@ -19,7 +19,8 @@ from __future__ import annotations
 import jax
 from jax import lax
 
-from geomx_tpu.parallel.ring_attention import dense_attention
+from geomx_tpu.parallel.ring_attention import (
+    dense_attention, fast_dense_attention)
 
 
 def ulysses_attention(
@@ -28,6 +29,7 @@ def ulysses_attention(
     v: jax.Array,
     axis_name: str = "sp",
     causal: bool = True,
+    fast: bool = False,
 ) -> jax.Array:
     """Exact attention via head↔sequence all-to-all re-sharding.
 
@@ -51,6 +53,7 @@ def ulysses_attention(
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
-    o = dense_attention(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
-                        causal=causal)
+    attn = fast_dense_attention if fast else dense_attention
+    o = attn(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
+             causal=causal)
     return heads_to_seq(o).astype(q.dtype)

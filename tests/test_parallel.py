@@ -44,6 +44,29 @@ def test_ring_attention_matches_dense(causal):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_ring_attention_fast_mode_tracks_dense():
+    """fast=True (bf16 MXU matmuls inside each ring block, fp32 online
+    softmax) stays within bf16 tolerance of the fp32 reference."""
+    mesh = make_mesh({"sp": 4})
+    B, T, H, D = 2, 32, 2, 16
+    rng = np.random.default_rng(1)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.bfloat16)
+               for _ in range(3))
+
+    ref = dense_attention(q, k, v, causal=True)
+    spec = P(None, "sp", None, None)
+    f = shard_map(
+        lambda a, b, c: ring_attention(a, b, c, axis_name="sp", axis_size=4,
+                                       causal=True, fast=True),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    out = jax.jit(f)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ulysses_attention_matches_dense(causal):
     from geomx_tpu.parallel import ulysses_attention
