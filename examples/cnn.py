@@ -70,6 +70,11 @@ def main():
                     help="local steps between party syncs")
     ap.add_argument("--hfa-k2", type=int, default=2,
                     help="party syncs between WAN syncs")
+    ap.add_argument("--esync", action="store_true",
+                    help="ESync straggler balancing: the party's state "
+                         "server assigns per-worker local step counts "
+                         "(implies HFA-style weight exchange; --steps "
+                         "counts sync rounds)")
     ap.add_argument("--record", default=None, metavar="PATH",
                     help="train from a record-IO dataset file instead of "
                          "in-memory synthetic data (written on first use); "
@@ -89,7 +94,7 @@ def main():
         sync_global_mode=(args.sync == "fsa"),
         compression=args.compression,
         bsc_ratio=args.bsc_ratio,
-        use_hfa=args.hfa,
+        use_hfa=args.hfa or args.esync,
         hfa_k2=args.hfa_k2,
         enable_p3=args.p3,
         p3_slice_elems=50_000,
@@ -147,7 +152,12 @@ def main():
                 print(f"step {step:4d}  loss {loss:.4f}  acc {acc:.3f}  "
                       f"({time.time() - t0:.2f}s)", flush=True)
 
-        if args.hfa:
+        if args.esync:
+            from geomx_tpu.training import run_worker_esync
+
+            hist = run_worker_esync(kv, params, grad_fn, it, args.steps,
+                                    log_fn=log)
+        elif args.hfa:
             hist = run_worker_hfa(kv, params, grad_fn, it, args.steps,
                                   k1=args.hfa_k1, log_fn=log)
         else:

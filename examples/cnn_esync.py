@@ -1,0 +1,12 @@
+#!/usr/bin/env python
+"""ESync demo: cnn_esync.py == cnn.py --esync (the reference lists
+ESync as to-be-integrated, ref: README.md:45; integrated here — the
+party's state server balances per-worker local step counts)."""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _wrapper import run
+
+if __name__ == "__main__":
+    sys.exit(run("--esync"))

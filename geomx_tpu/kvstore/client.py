@@ -509,6 +509,20 @@ class WorkerKVStore:
             self.po.topology.server(self.party), Ctrl.QUERY_STATS
         ) or {}
 
+    def esync_report(self, step_s: float, comm_s: float,
+                     max_steps: int = 64) -> int:
+        """ESync state-server round trip: report this worker's measured
+        per-local-step compute time and per-round push+pull time, get
+        back the local-step count to run before the next sync
+        (geomx_tpu.sched.esync; ref README.md:45 — the reference's
+        planned-but-unintegrated straggler balancer)."""
+        reply = self.worker.send_cmd(
+            self.po.topology.server(self.party), Ctrl.ESYNC,
+            body={"worker": str(self.po.node), "step_s": float(step_s),
+                  "comm_s": float(comm_s), "max_steps": int(max_steps)},
+        ) or {}
+        return int(reply.get("steps", 1))
+
     def stop(self):
         self.worker.stop()
 

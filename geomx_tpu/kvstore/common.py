@@ -105,3 +105,7 @@ class Ctrl(enum.IntEnum):
     QUERY_STATS = 17           # body: None → reply {"wan_send_bytes": ..., ...}
     CHECKPOINT = 18            # body: {"action": "save"|"load", "path": ...}
     DEAD_NODES = 19            # scheduler query → reply {"dead": [...]}
+    ESYNC = 20                 # body: {"worker", "step_s", "comm_s"} →
+    #                            reply {"steps": int, "plan": {...}}
+    #                            (state server; ref README.md:45 ESync
+    #                            "to be integrated" — integrated here)

@@ -150,6 +150,7 @@ class LocalServer:
         # pull-down so compressed (BSC) responses can detect a desynced
         # tracked view and resync dense (BroadcastCompressor.compress)
         self._pull_ver: Dict[int, int] = {}
+        self._esync = None  # EsyncState, lazily built on first Ctrl.ESYNC
         self.compression: dict = {"type": "none"}
         self.push_codec = None  # set by Ctrl.SET_COMPRESSION
         # TSEngine intra-party dissemination (ref: DefaultAutoPull
@@ -851,6 +852,28 @@ class LocalServer:
                 "recv_bytes": van.recv_bytes,
                 "store_bytes": store_b,
                 "accum_bytes": accum_b,
+            })
+            return
+        elif msg.cmd == Ctrl.ESYNC:
+            # state server (ESync, ref README.md:45 "to be integrated"):
+            # record this worker's measured times, reply with its next
+            # local-step assignment.  Lazily constructed — ESync is
+            # opt-in via the worker loop, no config needed server-side.
+            if self._esync is None:
+                from geomx_tpu.sched.esync import EsyncState
+
+                # generous server ceiling; the effective cap per worker
+                # is the max_steps its own loop reports
+                self._esync = EsyncState(max_steps=1024)
+            self._esync.report(str(body["worker"]),
+                               float(body["step_s"]),
+                               float(body["comm_s"]),
+                               max_steps=int(body.get("max_steps", 0)))
+            plan = self._esync.plan()
+            self.server.reply_cmd(msg, body={
+                "steps": plan.get(str(body["worker"]),
+                                  self._esync.min_steps),
+                "plan": plan,
             })
             return
         elif msg.cmd == Ctrl.PROFILER:

@@ -1,0 +1,85 @@
+"""ESync: straggler-balancing local-step assignment (state server).
+
+The reference lists ESync as designed-but-not-integrated
+(ref: README.md:45 "To be integrated", paper README.md:111 — ESync,
+IEEE TSC'20): a synchronous algorithm for heterogeneous clusters where a
+**state server** orchestrates how many LOCAL optimizer steps each worker
+runs between synchronizations, so fast workers do useful extra work
+instead of idling at the barrier and every worker reaches the server at
+roughly the same wall-clock time.
+
+This build integrates it natively: the state server is a small planner
+hosted by each party's LocalServer (ESync is intra-domain — across data
+centers the usual HiPS/HFA tiers apply unchanged), reachable over the
+command channel (``Ctrl.ESYNC``).  The sync itself rides the HFA
+machinery: workers push mean weights every round; only the number of
+local steps per round varies per worker.
+
+Planner model: a worker's reach-server time for ``M`` local steps is
+``R_i(M) = M * step_i + comm_i`` (measured per-local-step compute time
+and per-round push+pull time, EWMA-smoothed).  The target is the slowest
+worker running ``min_steps``::
+
+    T = max_i (min_steps * step_i + comm_i)
+    M_i = clamp(floor((T - comm_i) / step_i), min_steps, max_steps)
+
+so the slowest worker gets ``min_steps`` and faster workers fill the
+same wall-clock window with more local progress.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class EsyncState:
+    """The state server's planner.  Thread-safe; one per party."""
+
+    def __init__(self, min_steps: int = 1, max_steps: int = 64,
+                 smooth: float = 0.5):
+        assert 1 <= min_steps <= max_steps
+        self.min_steps = int(min_steps)
+        self.max_steps = int(max_steps)
+        self.smooth = float(smooth)  # EWMA weight of the NEW sample
+        self._mu = threading.Lock()
+        self._stats: Dict[str, Dict[str, float]] = {}
+
+    def report(self, worker: str, step_s: float, comm_s: float,
+               max_steps: int = 0) -> None:
+        """Record one round's measurements (seconds per LOCAL step, and
+        transmission time for the round).  ``max_steps`` > 0 records
+        THIS worker's assignment cap (workers may size their data
+        pipelines differently; a single shared cap would let one
+        worker's larger cap override another's)."""
+        step_s = max(float(step_s), 1e-9)
+        comm_s = max(float(comm_s), 0.0)
+        with self._mu:
+            st = self._stats.get(worker)
+            if st is None:
+                st = self._stats[worker] = {"step_s": step_s,
+                                            "comm_s": comm_s,
+                                            "cap": self.max_steps}
+            else:
+                a = self.smooth
+                st["step_s"] += a * (step_s - st["step_s"])
+                st["comm_s"] += a * (comm_s - st["comm_s"])
+            if max_steps > 0:
+                st["cap"] = min(self.max_steps, int(max_steps))
+
+    def plan(self) -> Dict[str, int]:
+        """Per-worker local step counts balancing reach-server time."""
+        with self._mu:
+            if not self._stats:
+                return {}
+            target = max(self.min_steps * st["step_s"] + st["comm_s"]
+                         for st in self._stats.values())
+            out = {}
+            for w, st in self._stats.items():
+                m = int((target - st["comm_s"]) / st["step_s"])
+                out[w] = max(self.min_steps, min(st["cap"], m))
+            return out
+
+    def steps_for(self, worker: str) -> int:
+        """Assignment for one worker (min_steps until it has reported)."""
+        return self.plan().get(worker, self.min_steps)

@@ -94,20 +94,133 @@ def run_worker_hfa(
 
             params = _optax.apply_updates(params, updates)
         if (step + 1) % k1 == 0:
-            with m.phase("push"):
-                w_leaves, _ = jax.tree_util.tree_flatten(params)
-                for tid, w in enumerate(w_leaves):
-                    kv.push(tid, np.asarray(w) / n, priority=-tid)
-                for tid in range(len(leaves)):
-                    kv.pull(tid, lambda t, arr: buf.__setitem__(t, arr),
-                            priority=-tid)
-            with m.phase("pull_wait"):
-                kv.wait_all()
-            params = unflatten_params(treedef, buf)  # type: ignore[arg-type]
+            params, _ = _hfa_sync_round(kv, params, treedef, len(leaves),
+                                        buf, n, m)
         m.step_end()
         history.append((float(loss), float(acc)))
         if log_fn is not None:
             log_fn(step, float(loss), float(acc))
+    if params_out is not None:
+        params_out["params"] = params
+    return history
+
+
+def _hfa_sync_round(kv, params, treedef, n_leaves, buf, n, m,
+                    measure_comm: bool = False):
+    """One weight-exchange sync: push party-mean weights, pull the
+    merged result (shared by the HFA and ESync loops — one place for
+    the push normalization and pull-into-buf pattern).
+
+    Returns ``(params, comm_s)``.  ``comm_s`` (only when
+    ``measure_comm``) is the TRANSMISSION time: the server acks each
+    push on receipt, so waiting on push acks measures the uplink — the
+    pull barrier below it is the straggler wait ESync exists to
+    eliminate, and counting it as comm would feed the wait back into
+    the plan and pin every fast worker at min_steps."""
+    import time as _time
+
+    w_leaves, _ = jax.tree_util.tree_flatten(params)
+    comm_s = None
+    t1 = _time.perf_counter()
+    with m.phase("push"):
+        push_ts = [kv.push(tid, np.asarray(w) / n, priority=-tid)
+                   for tid, w in enumerate(w_leaves)]
+        if measure_comm:
+            for pts in push_ts:
+                kv.worker.wait(pts)
+            comm_s = _time.perf_counter() - t1
+        for tid in range(n_leaves):
+            kv.pull(tid, lambda t, arr: buf.__setitem__(t, arr),
+                    priority=-tid)
+    with m.phase("pull_wait"):
+        kv.wait_all()
+    return unflatten_params(treedef, buf), comm_s
+
+
+def run_worker_esync(
+    kv: WorkerKVStore,
+    params,
+    grad_fn: Callable,
+    data_iter: Iterable,
+    rounds: int,
+    optimizer=None,
+    barrier_init: bool = True,
+    log_fn: Optional[Callable[[int, float, float], None]] = None,
+    params_out: Optional[dict] = None,
+    max_local_steps: int = 64,
+    measure=None,
+) -> List[Tuple[float, float]]:
+    """ESync client loop (geomx_tpu.sched.esync; ref README.md:45 — the
+    reference's planned-but-unintegrated straggler balancer, ESync
+    TSC'20).
+
+    Like HFA, each worker runs a LOCAL optimizer and pushes mean weights
+    at every sync — but the number of local steps between syncs is
+    assigned per worker per round by the party's state server, which
+    balances reach-server time across heterogeneous workers: fast
+    workers fill the slowest worker's round with extra local progress
+    instead of idling at the barrier.
+
+    ``rounds`` counts SYNC rounds, identical on every worker of the
+    party (one push per worker per round keeps the HFA merge in
+    lockstep; a per-worker local-step budget would deadlock the party
+    when fast workers exhausted it in fewer rounds).  Local step counts
+    per round vary per worker.  ``data_iter`` should yield enough
+    batches (up to rounds × max_local_steps) or be cyclic; if it runs
+    dry the worker still pushes each remaining round.  Requires HFA mode
+    on the servers (weights, not gradients, cross the tiers;
+    Config.use_hfa / SET_HFA).
+    """
+    import time as _time
+
+    import optax
+
+    from geomx_tpu.utils.measure import Measure
+
+    m = measure if measure is not None else Measure()
+    if optimizer is None:
+        optimizer = optax.adam(1e-2)
+    leaves, treedef = flatten_params(params)
+    for tid, leaf in enumerate(leaves):
+        kv.init(tid, leaf, barrier=barrier_init)
+    params = unflatten_params(treedef, leaves)
+    opt_state = optimizer.init(params)
+    n = kv.num_workers
+    history: List[Tuple[float, float]] = []
+    buf: List[Optional[np.ndarray]] = [None] * len(leaves)
+
+    it = iter(data_iter)
+    local_steps = 1  # until the state server has a plan
+    loss = acc = 0.0
+    for _round in range(rounds):
+        m.step_start()
+        t0 = _time.perf_counter()
+        ran = 0
+        with m.phase("grad"):
+            for _ in range(local_steps):
+                try:
+                    x, y = next(it)
+                except StopIteration:
+                    break
+                loss, acc, grads = grad_fn(params, x, y)
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+                params = optax.apply_updates(params, updates)
+                ran += 1
+                history.append((float(loss), float(acc)))
+        step_s = (_time.perf_counter() - t0) / max(ran, 1)
+        params, comm_s = _hfa_sync_round(kv, params, treedef, len(leaves),
+                                         buf, n, m, measure_comm=True)
+        m.step_end()
+        if ran > 0:
+            # a dry data iterator (ran == 0) must not report: its
+            # near-zero "step time" would make the planner believe this
+            # worker is infinitely fast, collapse the reach-time target,
+            # and pin every worker that still has data at min_steps
+            local_steps = kv.esync_report(step_s, comm_s,
+                                          max_steps=max_local_steps)
+        if log_fn is not None:
+            log_fn(_round, float(loss), float(acc))
     if params_out is not None:
         params_out["params"] = params
     return history

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# ESync acceptance: heterogeneous-worker straggler balancing
+# (the reference's to-be-integrated mode, README.md:45).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python examples/cnn_esync.py --parties 2 --workers 2 --steps "${STEPS:-8}" "$@"

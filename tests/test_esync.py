@@ -1,0 +1,112 @@
+"""ESync: state-server local-step balancing for heterogeneous workers
+(geomx_tpu.sched.esync; the reference lists ESync as to-be-integrated,
+ref: README.md:45 + TSC'20 paper row in README.md:111)."""
+
+import threading
+import time
+
+import numpy as np
+
+from geomx_tpu.core.config import Config, Topology
+from geomx_tpu.kvstore import Simulation
+from geomx_tpu.sched.esync import EsyncState
+from geomx_tpu.training import run_worker_esync
+
+
+def test_planner_balances_reach_time():
+    """Fast workers get more local steps; the slowest gets min_steps;
+    assignments clamp to [min_steps, max_steps]."""
+    st = EsyncState(min_steps=1, max_steps=16)
+    st.report("slow", step_s=0.100, comm_s=0.010)
+    st.report("fast", step_s=0.010, comm_s=0.010)
+    st.report("turbo", step_s=0.001, comm_s=0.010)
+    plan = st.plan()
+    assert plan["slow"] == 1
+    # target = 0.100 + 0.010 = 0.110; fast: (0.110-0.010)/0.010 = 10
+    assert plan["fast"] == 10
+    assert plan["turbo"] == 16  # (0.11-0.01)/0.001 = 100 -> clamp
+    # reach times within one local step of the target for unclamped
+    for w in ("slow", "fast"):
+        s = st._stats[w]
+        reach = plan[w] * s["step_s"] + s["comm_s"]
+        assert reach <= 0.110 + 1e-9
+        assert reach + s["step_s"] > 0.110 - 1e-9
+
+
+def test_planner_ewma_adapts():
+    st = EsyncState(min_steps=1, max_steps=64, smooth=0.5)
+    st.report("w", step_s=0.1, comm_s=0.0)
+    st.report("w", step_s=0.3, comm_s=0.0)
+    assert abs(st._stats["w"]["step_s"] - 0.2) < 1e-9
+
+
+def test_esync_training_assigns_more_steps_to_fast_worker():
+    """Two heterogeneous workers in one party, lockstep rounds: the
+    state server gives the fast worker more local steps per round, both
+    replicas stay in sync, and the loss goes downhill."""
+    cfg = Config(
+        topology=Topology(num_parties=1, workers_per_party=2),
+        use_hfa=True, hfa_k2=1,
+    )
+    sim = Simulation(cfg)
+    try:
+        target = np.full(8, 3.0, np.float32)
+
+        def make_grad_fn(delay_s):
+            def grad_fn(params, x, y):
+                time.sleep(delay_s)
+                w = params["w"]
+                err = w - target
+                return float(np.mean(err ** 2)), 0.0, {"w": 0.5 * err}
+            return grad_fn
+
+        def batches():
+            while True:
+                yield None, None
+
+        rounds = 5
+        results = {}
+
+        def worker_main(rank, delay_s):
+            kv = sim.worker(0, rank)
+            out = {}
+            hist = run_worker_esync(
+                kv, {"w": np.zeros(8, np.float32)}, make_grad_fn(delay_s),
+                batches(), rounds, params_out=out, max_local_steps=8)
+            results[rank] = (hist, out["params"])
+
+        ts = [threading.Thread(target=worker_main, args=(0, 0.15)),
+              threading.Thread(target=worker_main, args=(1, 0.005))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert set(results) == {0, 1}, "a worker hung"
+
+        hist_slow, params_slow = results[0]
+        hist_fast, params_fast = results[1]
+        # the fast worker ran more local steps across the same rounds
+        assert len(hist_fast) > len(hist_slow), (
+            len(hist_fast), len(hist_slow))
+        # lockstep HFA rounds end with identical replicas
+        np.testing.assert_allclose(params_slow["w"], params_fast["w"],
+                                   rtol=1e-5, atol=1e-6)
+        # and training moved toward the target
+        assert hist_fast[-1][0] < hist_fast[0][0]
+    finally:
+        sim.shutdown()
+
+
+def test_esync_cmd_roundtrip():
+    """The Ctrl.ESYNC command channel: report → assignment reply."""
+    sim = Simulation(Config(topology=Topology(num_parties=1,
+                                              workers_per_party=2)))
+    try:
+        kv = sim.worker(0, 0)
+        assert kv.esync_report(step_s=0.1, comm_s=0.01) == 1
+        kv2 = sim.worker(0, 1)
+        # the second worker is 10x faster -> gets ~10 steps
+        steps = kv2.esync_report(step_s=0.01, comm_s=0.01)
+        assert steps == 10
+    finally:
+        sim.shutdown()
