@@ -38,6 +38,7 @@ is an upper estimate of the reference, making vs_baseline conservative.
 """
 
 import argparse
+import functools
 import json
 import os
 import subprocess
@@ -164,7 +165,7 @@ def child_cnn():
         updates, s = tx.update(grads, s, p)
         return (optax.apply_updates(p, updates), s), loss
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def run_steps(p, s):
         (p, s), losses = jax.lax.scan(step, (p, s), None, length=STEPS)
         return p, s, losses[-1]
@@ -309,7 +310,7 @@ def child_mfu():
         updates, s = tx.update(grads, s, p)
         return (optax.apply_updates(p, updates), s), loss
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def run_steps(p, s):
         (p, s), losses = jax.lax.scan(step, (p, s), None, length=MFU_STEPS)
         return p, s, losses[-1]
