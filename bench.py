@@ -2,18 +2,26 @@
 """Benchmark harness. Prints ONE JSON line:
 {"metric", "value", "unit", "vs_baseline", ...extras}.
 
-Round-1 failure mode (BENCH_r01.json rc=1, parsed null): the axon TPU
-tunnel flaked during backend init and one exception killed the run.
-This harness therefore:
+Two failed rounds shaped this harness.  r1 (rc=1): a tunnel flake
+during backend init killed the run — fixed by running every device
+benchmark in a subprocess with a hard timeout.  r2 (rc=124): the
+orchestrator's worst-case wall budget exceeded the driver's timeout
+and nothing was printed until the single final line, so every
+already-computed result was lost.  The rules now:
 
-- runs every device benchmark in a **subprocess** with a hard timeout
-  and retry/backoff, so a hung backend init (observed: jax.devices()
-  blocking >2 min) can never wedge the whole bench;
-- always runs the CPU-only WAN codec benchmark, so even a dead tunnel
-  still yields a real number (the reference's headline is WAN-traffic
-  reduction, README.md:21-45);
-- on TPU failure emits the WAN figure as the primary metric plus an
-  "error" field — never rc!=0, never an empty line.
+- **global wall-clock deadline** (``BENCH_DEADLINE_S``, default 480 s):
+  every child's timeout is clipped to the remaining budget and children
+  are skipped outright once it is exhausted;
+- **incremental emission**: the full record is re-printed as one JSON
+  line after *every* child completes — last line wins — so a driver
+  kill at any point still leaves the freshest complete record on
+  stdout;
+- **SIGTERM/SIGINT flush**: the handler kills running children, prints
+  the current record, and exits 0;
+- **tunnel probe**: one tiny device call (60 s cap) gates all TPU
+  children — a dead tunnel costs one probe, not per-child timeouts;
+- CPU children (wan/overlap/stress) run on a **parallel thread** so a
+  slow tunnel cannot starve them of budget, and vice versa.
 
 Benchmarks:
 - **cnn**   CIFAR-10-shape CNN images/sec/chip (BASELINE.md metric #1).
@@ -41,8 +49,10 @@ import argparse
 import functools
 import json
 import os
+import signal
 import subprocess
 import sys
+import threading
 import time
 from pathlib import Path
 
@@ -273,6 +283,13 @@ MFU_CFG = dict(vocab=8192, d_model=2048, n_heads=16, n_layers=8,
 MFU_BATCH = 4
 MFU_STEPS = 8
 
+# On-chip batch/remat/seq sweep evidence for the config above (VERDICT
+# r2 weak #4) — measured interactively via `bench.py --child mfu_sweep`
+# on the real chip and baked in here so the driver-run child times only
+# the winner but the record carries the full justification.  None =
+# sweep not yet captured on hardware this round.
+MFU_SWEEP_MEASURED = None
+
 
 def _transformer_train_flops_per_step(cfg, batch, seq):
     """Standard 6*N*T + attention-matmul term (12*L*T*seq*d_model*3 for
@@ -286,7 +303,64 @@ def _transformer_train_flops_per_step(cfg, batch, seq):
     return dense + attn, n_params
 
 
+def _flash_exactness_check(attn_impl: str):
+    """flash vs the fast bf16-dense reference on a small shape — the
+    headline MFU number must never time an unvalidated kernel (VERDICT
+    r2 #2).  Returns (attn_impl_to_use, human_readable_status)."""
+    import jax
+    import jax.numpy as jnp
+
+    if attn_impl != "flash":
+        return attn_impl, f"skipped (attn_impl={attn_impl!r})"
+    try:
+        from geomx_tpu.models.transformer import (
+            TransformerConfig, _single_device_attention)
+        from geomx_tpu.parallel.ring_attention import fast_dense_attention
+
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(2), 3)
+        shp = (1, 512, 4, 128)  # [B, T, H, Dh]; Dh matches MFU_CFG
+        q = jax.random.normal(kq, shp, jnp.bfloat16)
+        k = jax.random.normal(kk, shp, jnp.bfloat16)
+        v = jax.random.normal(kv, shp, jnp.bfloat16)
+        chk = TransformerConfig(attn_impl="flash")
+        o = _single_device_attention(chk, q, k, v).astype(jnp.float32)
+        r = fast_dense_attention(q, k, v, causal=True).astype(jnp.float32)
+        err = float(jnp.max(jnp.abs(o - r)))
+        if not (err < 5e-2):  # bf16 attention tolerance (unit inputs)
+            raise AssertionError(f"flash vs dense max abs diff {err}")
+        return "flash", f"ok (max abs diff {err:.2e})"
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        return "fast", f"FAILED ({type(e).__name__}: {e}); fell back to fast"
+
+
 def child_mfu():
+    import jax
+
+    attn_impl, flash_check = _flash_exactness_check(MFU_CFG["attn_impl"])
+    cfg_d = {**MFU_CFG, "attn_impl": attn_impl}
+    tflops, tokens_per_sec = _time_mfu_config(
+        cfg_d, MFU_BATCH, steps=MFU_STEPS, reps=3)
+    _flops, n_params = _transformer_train_flops_per_step(
+        cfg_d, MFU_BATCH, cfg_d["max_seq"])
+    platform = jax.devices()[0].platform
+    peak = V5E_PEAK_BF16 if platform in ("tpu", "axon") else None
+    print(json.dumps({
+        "achieved_tflops": round(tflops, 2),
+        "peak_tflops": peak and peak / 1e12,
+        "mfu": peak and round(tflops * 1e12 / peak, 4),
+        "model": (f"transformer d{MFU_CFG['d_model']} L{MFU_CFG['n_layers']} "
+                  f"ff{MFU_CFG['d_ff']} seq{MFU_CFG['max_seq']} "
+                  f"batch{MFU_BATCH} bf16 ({n_params/1e6:.0f}M params)"),
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "attn_impl": attn_impl,
+        "flash_check": flash_check,
+        "config_sweep": MFU_SWEEP_MEASURED,
+        "platform": platform,
+    }))
+
+
+def _time_mfu_config(cfg_dict, batch, steps=4, reps=2):
+    """Compile + time one MFU config; returns (tflops, tokens/s)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -294,14 +368,14 @@ def child_mfu():
     from geomx_tpu.models.transformer import (
         TransformerConfig, init_params, lm_loss, make_apply)
 
-    cfg = TransformerConfig(**MFU_CFG)
+    cfg = TransformerConfig(**cfg_dict)
     params = init_params(cfg, jax.random.PRNGKey(0))
     apply_fn = make_apply(cfg)
     tx = optax.adam(1e-4)
     opt_state = tx.init(params)
     tokens = jax.random.randint(
-        jax.random.PRNGKey(1), (MFU_BATCH, MFU_CFG["max_seq"]), 0,
-        MFU_CFG["vocab"], dtype=jnp.int32)
+        jax.random.PRNGKey(1), (batch, cfg_dict["max_seq"]), 0,
+        cfg_dict["vocab"], dtype=jnp.int32)
 
     def step(carry, _):
         p, s = carry
@@ -312,34 +386,43 @@ def child_mfu():
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def run_steps(p, s):
-        (p, s), losses = jax.lax.scan(step, (p, s), None, length=MFU_STEPS)
+        (p, s), losses = jax.lax.scan(step, (p, s), None, length=steps)
         return p, s, losses[-1]
 
     params, opt_state, loss = run_steps(params, opt_state)
     _ = float(loss)
-    best_dt = float("inf")
-    for _ in range(3):
+    best = float("inf")
+    for _ in range(reps):
         t0 = time.perf_counter()
         params, opt_state, loss = run_steps(params, opt_state)
         _ = float(loss)
-        best_dt = min(best_dt, time.perf_counter() - t0)
+        best = min(best, time.perf_counter() - t0)
+    flops, _n = _transformer_train_flops_per_step(
+        cfg_dict, batch, cfg_dict["max_seq"])
+    return flops * steps / best / 1e12, batch * cfg_dict["max_seq"] * steps / best
 
-    flops_per_step, n_params = _transformer_train_flops_per_step(
-        MFU_CFG, MFU_BATCH, MFU_CFG["max_seq"])
-    achieved = flops_per_step * MFU_STEPS / best_dt
-    platform = jax.devices()[0].platform
-    peak = V5E_PEAK_BF16 if platform in ("tpu", "axon") else None
-    print(json.dumps({
-        "achieved_tflops": round(achieved / 1e12, 2),
-        "peak_tflops": peak and peak / 1e12,
-        "mfu": peak and round(achieved / peak, 4),
-        "model": (f"transformer d{MFU_CFG['d_model']} L{MFU_CFG['n_layers']} "
-                  f"ff{MFU_CFG['d_ff']} seq{MFU_CFG['max_seq']} "
-                  f"batch{MFU_BATCH} bf16 ({n_params/1e6:.0f}M params)"),
-        "tokens_per_sec": round(
-            MFU_BATCH * MFU_CFG["max_seq"] * MFU_STEPS / best_dt, 1),
-        "platform": platform,
-    }))
+
+def child_mfu_sweep():
+    """Interactive-only: sweep batch/remat/seq/attn around MFU_CFG on the
+    real chip; the winning row gets baked into MFU_CFG/MFU_SWEEP_MEASURED.
+    Not scheduled by the orchestrator (too slow for the driver budget)."""
+    rows = []
+    for name, cfg_d, batch in [
+        ("flash_b4", dict(MFU_CFG, attn_impl="flash"), 4),
+        ("flash_b8", dict(MFU_CFG, attn_impl="flash"), 8),
+        ("flash_b16_remat", dict(MFU_CFG, attn_impl="flash", remat=True), 16),
+        ("flash_b8_seq4k", dict(MFU_CFG, attn_impl="flash", max_seq=4096), 8),
+        ("fast_b4", dict(MFU_CFG, attn_impl="fast"), 4),
+        ("fast_b8", dict(MFU_CFG, attn_impl="fast"), 8),
+    ]:
+        try:
+            tf, tps = _time_mfu_config(cfg_d, batch)
+            rows.append({"config": name, "tflops": round(tf, 1),
+                         "tokens_per_sec": round(tps, 1)})
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            rows.append({"config": name,
+                         "error": f"{type(e).__name__}: {e}"[:200]})
+        print(json.dumps({"sweep": rows}), flush=True)
 
 
 QUANT_MB = 64
@@ -420,6 +503,121 @@ def child_overlap():
     res["overlap_s_per_step"] = round(res["overlap_s_per_step"], 4)
     res["speedup"] = round(res["speedup"], 3)
     print(json.dumps(res))
+
+
+def child_probe():
+    """Tunnel liveness probe: backend init + one tiny device matmul.
+    Gates all TPU children — jax.devices() has been observed to hang for
+    minutes when the axon tunnel is down, so this is the only child that
+    ever pays that cost."""
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    dev = jax.devices()[0]
+    init_s = time.perf_counter() - t0
+    x = jnp.ones((128, 128))
+    t1 = time.perf_counter()
+    y = x @ x
+    _ = float(y[0, 0])
+    print(json.dumps({
+        "platform": dev.platform,
+        "device": str(dev),
+        "init_s": round(init_s, 1),
+        "dispatch_s": round(time.perf_counter() - t1, 2),
+    }))
+
+
+# staged-overlap-on-chip config: big enough that per-stage compute is
+# real MXU work, small enough that 10 stage jits compile fast.  The sim
+# kvstore runs in-proc on the host (no WAN throttle): the child isolates
+# the *schedule cost* of staging — per-stage dispatch overhead over the
+# axon tunnel vs one monolithic jit — which is the open risk VERDICT r2
+# flagged against the sim-only 1.44x overlap claim.
+OVL_TPU_CFG = dict(vocab=8192, d_model=1024, n_heads=8, n_layers=8,
+                   d_ff=4096, max_seq=1024, attn_impl="fast")
+OVL_TPU_BATCH = 8
+OVL_TPU_STEPS = 3
+
+
+def child_overlap_tpu():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.kvstore import Simulation
+    from geomx_tpu.models.transformer import (
+        TransformerConfig, make_staged, token_cross_entropy)
+    from geomx_tpu.overlap import StagedModel, run_worker_overlapped
+    from geomx_tpu.training import run_worker
+
+    cfg = TransformerConfig(**OVL_TPU_CFG)
+    fns, stage_params = make_staged(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab, (OVL_TPU_BATCH, cfg.max_seq)), jnp.int32)
+
+    def ce(logits, tokens):
+        return token_cross_entropy(logits, tokens), jnp.mean(logits)
+
+    data = [(tokens, tokens)] * (OVL_TPU_STEPS + 1)
+
+    def timed(staged: bool) -> float:
+        sim = Simulation(Config(
+            topology=Topology(num_parties=1, workers_per_party=1),
+            enable_p3=True))
+        try:
+            kv = sim.all_workers()[0]
+            kv.set_optimizer({"type": "sgd", "lr": 1e-4})
+            if staged:
+                model = StagedModel(fns, ce)
+                run_worker_overlapped(kv, model, stage_params, data[:1], 1,
+                                      barrier_init=False)  # compile
+                t0 = time.perf_counter()
+                run_worker_overlapped(kv, model, stage_params,
+                                      data[:OVL_TPU_STEPS], OVL_TPU_STEPS,
+                                      barrier_init=False)
+                return time.perf_counter() - t0
+
+            def grad_fn(ps, x, y):
+                def composed(ps):
+                    h = x
+                    for f, p in zip(fns, ps):
+                        h = f(p, h)
+                    return ce(h, y)
+                (loss, aux), grads = jax.value_and_grad(
+                    composed, has_aux=True)(ps)
+                return loss, aux, grads
+
+            grad_fn = jax.jit(grad_fn)
+            run_worker(kv, stage_params, grad_fn, data[:1], 1,
+                       barrier_init=False)  # compile
+            t0 = time.perf_counter()
+            run_worker(kv, stage_params, grad_fn, data[:OVL_TPU_STEPS],
+                       OVL_TPU_STEPS, barrier_init=False)
+            return time.perf_counter() - t0
+        finally:
+            sim.shutdown()
+
+    mono = timed(False) / OVL_TPU_STEPS
+    stag = timed(True) / OVL_TPU_STEPS
+    n_stages = len(fns)
+    print(json.dumps({
+        "monolithic_s_per_step": round(mono, 3),
+        "staged_s_per_step": round(stag, 3),
+        "staged_overhead_s_per_step": round(stag - mono, 3),
+        "staged_overhead_per_stage_ms": round(
+            (stag - mono) / n_stages * 1000, 1),
+        "n_stages": n_stages,
+        "model": (f"transformer d{OVL_TPU_CFG['d_model']} "
+                  f"L{OVL_TPU_CFG['n_layers']} seq{OVL_TPU_CFG['max_seq']} "
+                  f"batch{OVL_TPU_BATCH}"),
+        "note": ("in-proc kvstore, no WAN throttle: measures the pure "
+                 "schedule/dispatch cost of staging on this backend; the "
+                 "overlap *win* under WAN contention is the cpu overlap "
+                 "child"),
+        "platform": jax.devices()[0].platform,
+    }))
 
 
 def child_stress():
@@ -513,10 +711,51 @@ def child_wan():
             out[name] = (sim.wan_bytes()["wan_send_bytes"] - base) / STEPS_W
         finally:
             sim.shutdown()
+
+    # flagship-scale ledger (VERDICT r2 #7): one 50M-element tensor (200
+    # MB fp32) through MultiGPS shards (3 global servers) x BSC — the
+    # regime where per-message overheads amortize and the shard split
+    # matters.  Reference payload math: kvstore_dist_server.h:1190-1206.
+    N_FLAG = 50_000_000
+    flagship = {}
+    sim = Simulation(Config(topology=Topology(
+        num_parties=2, workers_per_party=1, num_global_servers=3)))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(N_FLAG, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        for p in range(2):
+            sim.worker(p, 0).set_gradient_compression(
+                {"type": "bsc", "ratio": 0.01})
+        g = np.abs(np.random.default_rng(1)
+                   .standard_normal(N_FLAG)).astype(np.float32)
+        base = sim.wan_bytes()["wan_send_bytes"]
+        t0 = time.perf_counter()
+        for w in ws:
+            w.push(0, g)
+        for w in ws:
+            w.pull_sync(0)
+            w.wait_all()
+        dt = time.perf_counter() - t0
+        sent = sim.wan_bytes()["wan_send_bytes"] - base
+        flagship = {
+            "tensor_elems": N_FLAG,
+            "global_servers": 3,
+            "bsc_ratio": 0.01,
+            "wan_bytes_per_step": sent,
+            "dense_bytes_would_be": 2 * 2 * N_FLAG * 4,  # 2 parties x p+p
+            "reduction": round(2 * 2 * N_FLAG * 4 / max(sent, 1), 2),
+            "round_wall_s": round(dt, 3),
+        }
+    finally:
+        sim.shutdown()
+
     print(json.dumps({
         "bytes_per_step": {k: round(v, 1) for k, v in out.items()},
         "reduction": {k: round(out["vanilla"] / v, 2)
                       for k, v in out.items() if v > 0},
+        "flagship_50m_multigps_bsc": flagship,
     }))
 
 
@@ -524,21 +763,154 @@ def child_wan():
 # orchestrator
 # --------------------------------------------------------------------------
 
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "480"))
+RESERVE_S = 8.0          # kept back for the final emission
+MIN_CHILD_S = 20.0       # don't bother launching a child with less
+_T0 = time.monotonic()
+
+_lock = threading.Lock()
+_results: dict = {}      # child name -> parsed JSON
+_errors: dict = {}       # child name -> error string
+_procs: set = set()      # running child Popen handles (for SIGTERM)
+
+
+def _remaining() -> float:
+    return DEADLINE_S - (time.monotonic() - _T0)
+
+
+def _build_record() -> dict:
+    """Assemble the full output record from whatever has finished.
+    Pure function of _results/_errors — called after every child and
+    from the signal handler, so it must never block or throw."""
+    cnn = _results.get("cnn")
+    mfu = _results.get("mfu")
+    wan = _results.get("wan")
+    if cnn is not None:
+        deriv = cnn.get("a100_ref_derivation", {})
+        scen = deriv.get("scenarios", {})
+        record = {
+            "metric": "cifar10_cnn_images_per_sec_per_chip",
+            "value": cnn.get("images_per_sec"),
+            "unit": "images/sec/chip",
+            "vs_baseline": cnn.get("vs_baseline"),
+            # vs_baseline divides measured TPU throughput by a MODELED
+            # A100 reference (no A100 reachable; BASELINE.md) — surface
+            # the least-favorable modeled scenario next to it so no
+            # consumer mistakes the model for a measurement
+            "vs_baseline_semantics": (
+                "measured TPU ips / modeled A100 reference "
+                "(reference_as_published_fp32; see a100_ref_derivation)"),
+            "vs_modeled_xla_grade_peer": scen.get(
+                "hypothetical_xla_grade_peer", {}).get("vs_0.9x_sxm80"),
+            "a100_ref_derivation": deriv,
+            "device": cnn.get("device"),
+        }
+    elif mfu is not None:
+        record = {
+            "metric": "transformer_achieved_tflops",
+            "value": mfu.get("achieved_tflops"),
+            "unit": "TFLOP/s",
+            "vs_baseline": None,
+        }
+    elif wan is not None:
+        record = {
+            "metric": "wan_bytes_per_step",
+            "value": wan.get("bytes_per_step", {}).get("vanilla"),
+            "unit": "bytes/step (vanilla; see configs)",
+            "vs_baseline": None,
+            "error": "TPU benchmarks unavailable (see errors)",
+        }
+    else:
+        record = {
+            "metric": "none_completed_yet",
+            "value": None,
+            "unit": None,
+            "vs_baseline": None,
+            "error": "no child benchmark has completed (see errors)",
+        }
+    for key, name in (("mfu", "mfu"), ("quantize", "quant"),
+                      ("wan", "wan"), ("overlap", "overlap"),
+                      ("overlap_tpu", "overlap_tpu"),
+                      ("stress", "stress"), ("probe", "probe")):
+        if name in _results:
+            record[key] = _results[name]
+    if _errors:
+        record["errors"] = dict(_errors)
+    record["elapsed_s"] = round(time.monotonic() - _T0, 1)
+    record["deadline_s"] = DEADLINE_S
+    return record
+
+
+def _emit():
+    """Print the current full record as one JSON line (last line wins)."""
+    with _lock:
+        line = json.dumps(_build_record())
+    sys.stdout.write(line + "\n")
+    sys.stdout.flush()
+
+
+def _kill_children():
+    for p in list(_procs):
+        try:
+            p.kill()
+        except Exception:
+            pass
+
+
+def _on_term(signum, frame):
+    """Emergency flush.  Runs in the main thread while the CPU worker
+    thread may be mid-mutation of _results/_errors and the interrupted
+    main-thread _emit may have written half a line — so: try the lock
+    briefly (the worker only holds it for dict inserts), serialize
+    defensively, and prefix a newline so the LAST stdout line is intact
+    whatever was interrupted.  Must never raise."""
+    _kill_children()
+    _errors["harness"] = (f"signal {signum} at "
+                          f"{time.monotonic() - _T0:.0f}s; partial "
+                          "record flushed")
+    locked = _lock.acquire(timeout=1.0)
+    try:
+        try:
+            line = json.dumps(_build_record())
+        except Exception as e:  # torn concurrent state: minimal record
+            line = json.dumps({
+                "metric": "none_completed_yet", "value": None,
+                "unit": None, "vs_baseline": None,
+                "error": f"signal-path serialization failed: {e!r}"})
+    finally:
+        if locked:
+            _lock.release()
+    try:
+        os.write(1, ("\n" + line + "\n").encode())
+    except OSError:
+        pass
+    os._exit(0)
+
+
 def _run_child(name: str, timeout: float, env_extra=None):
+    budget = _remaining() - RESERVE_S
+    if budget < MIN_CHILD_S:
+        return None, "skipped: global deadline exhausted"
+    timeout = min(timeout, budget)
     env = dict(os.environ)
-    env.pop("BENCH_CHILD", None)
     if env_extra:
         env.update(env_extra)
+    p = subprocess.Popen(
+        [sys.executable, str(ROOT / "bench.py"), "--child", name],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    _procs.add(p)
     try:
-        p = subprocess.run(
-            [sys.executable, str(ROOT / "bench.py"), "--child", name],
-            capture_output=True, text=True, timeout=timeout, env=env)
+        out, err_txt = p.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
+        p.kill()
+        p.communicate()
         return None, f"timeout after {timeout:.0f}s"
+    finally:
+        _procs.discard(p)
     if p.returncode != 0:
-        tail = (p.stderr or p.stdout or "").strip().splitlines()[-6:]
+        tail = (err_txt or out or "").strip().splitlines()[-6:]
         return None, f"rc={p.returncode}: " + " | ".join(tail)
-    for line in reversed(p.stdout.strip().splitlines()):
+    for line in reversed(out.strip().splitlines()):
         try:
             return json.loads(line), None
         except json.JSONDecodeError:
@@ -546,23 +918,23 @@ def _run_child(name: str, timeout: float, env_extra=None):
     return None, "no JSON in child output"
 
 
-def _run_tpu_child(name: str, timeout: float, attempts: int = 2,
-                   backoff: float = 20.0):
-    err = None
-    for i in range(attempts):
-        if i:
-            time.sleep(backoff)
-        res, err = _run_child(name, timeout)
+def _do(name: str, timeout: float, env_extra=None) -> bool:
+    """Run one child, record its result or error, re-emit the record."""
+    res, err = _run_child(name, timeout, env_extra)
+    with _lock:
         if res is not None:
-            return res, None
-    return None, err
+            _results[name] = res
+        if err:
+            _errors[name] = err
+    _emit()
+    return res is not None
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--child",
-                    choices=["cnn", "mfu", "quant", "wan", "overlap",
-                             "stress"])
+                    choices=["cnn", "mfu", "mfu_sweep", "quant", "wan",
+                             "overlap", "overlap_tpu", "stress", "probe"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -574,15 +946,19 @@ def main():
         # alone is too late and a dead TPU tunnel would hang the child
         from geomx_tpu.core.platform import apply_platform_from_env
         apply_platform_from_env()
-        {"cnn": child_cnn, "mfu": child_mfu, "quant": child_quant,
-         "wan": child_wan, "overlap": child_overlap,
-         "stress": child_stress}[args.child]()
+        {"cnn": child_cnn, "mfu": child_mfu, "mfu_sweep": child_mfu_sweep,
+         "quant": child_quant, "wan": child_wan, "overlap": child_overlap,
+         "overlap_tpu": child_overlap_tpu, "stress": child_stress,
+         "probe": child_probe}[args.child]()
         return
 
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+
     cpu_env = {"JAX_PLATFORMS": "cpu", "JAX_PLATFORM_NAME": "cpu"}
-    wan, wan_err = _run_child("wan", timeout=300, env_extra=cpu_env)
 
     if args.wan:  # legacy single-benchmark mode: WAN codec numbers only
+        wan, wan_err = _run_child("wan", timeout=300, env_extra=cpu_env)
         print(json.dumps({
             "metric": "wan_bytes_per_step",
             "value": wan and wan["bytes_per_step"]["vanilla"],
@@ -594,70 +970,45 @@ def main():
         }))
         return
 
-    overlap, overlap_err = _run_child("overlap", timeout=300,
-                                      env_extra=cpu_env)
-    stress, stress_err = _run_child("stress", timeout=600,
-                                    env_extra=cpu_env)
+    _emit()  # a valid line exists from second zero, whatever happens
 
-    errors = {}
-    cnn = mfu = quant = None
+    # CPU children on their own thread: a slow tunnel can't starve them
+    def cpu_chain():
+        _do("wan", 240, cpu_env)
+        _do("overlap", 180, cpu_env)
+        _do("stress", 300, cpu_env)
+
+    cpu_thread = threading.Thread(target=cpu_chain, daemon=True)
+    cpu_thread.start()
+
     if not args.skip_tpu:
-        # the cnn child runs first and doubles as the tunnel probe:
-        # jax.devices() has been observed to hang for minutes when the
-        # tunnel is down, and the subprocess timeout contains that
-        cnn, err = _run_tpu_child("cnn", timeout=420)
-        if err:
-            errors["cnn"] = err
-        mfu, err = _run_tpu_child("mfu", timeout=600)
-        if err:
-            errors["mfu"] = err
-        quant, err = _run_tpu_child("quant", timeout=420)
-        if err:
-            errors["quant"] = err
-    if wan_err:
-        errors["wan"] = wan_err
-    if overlap_err:
-        errors["overlap"] = overlap_err
-    if stress_err:
-        errors["stress"] = stress_err
+        # two probe attempts with a short backoff: the r1 failure mode is
+        # a *transient* tunnel flake at backend init, so one flake must
+        # not forfeit the round's TPU metrics; a genuinely dead tunnel
+        # still only costs ~2.5 min total before all TPU children skip
+        ok = _do("probe", 60)
+        if not ok and _remaining() > 120:
+            time.sleep(15)
+            ok = _do("probe", 75)
+        platform = _results.get("probe", {}).get("platform")
+        if ok and platform not in ("cpu", None):
+            # tunnel alive: no retries/backoffs — the deadline governs
+            _do("cnn", 300)
+            _do("mfu", 300)
+            _do("quant", 180)
+            _do("overlap_tpu", 240)
+        else:
+            with _lock:
+                _errors["tpu"] = (
+                    f"tunnel probe failed or non-TPU ({platform}): "
+                    + _errors.get("probe", "skipping all TPU children"))
+            _emit()
 
-    if cnn is not None:
-        record = {
-            "metric": "cifar10_cnn_images_per_sec_per_chip",
-            "value": cnn["images_per_sec"],
-            "unit": "images/sec/chip",
-            "vs_baseline": cnn["vs_baseline"],
-            "a100_ref_derivation": cnn["a100_ref_derivation"],
-            "device": cnn.get("device"),
-        }
-    elif mfu is not None:
-        record = {
-            "metric": "transformer_achieved_tflops",
-            "value": mfu["achieved_tflops"],
-            "unit": "TFLOP/s",
-            "vs_baseline": None,
-        }
-    else:
-        record = {
-            "metric": "wan_bytes_per_step",
-            "value": wan and wan["bytes_per_step"]["vanilla"],
-            "unit": "bytes/step (vanilla; see configs)",
-            "vs_baseline": None,
-            "error": "TPU benchmarks unavailable (see errors)",
-        }
-    if mfu:
-        record["mfu"] = mfu
-    if quant:
-        record["quantize"] = quant
-    if wan:
-        record["wan"] = wan
-    if overlap:
-        record["overlap"] = overlap
-    if stress:
-        record["stress"] = stress
-    if errors:
-        record["errors"] = errors
-    print(json.dumps(record))
+    cpu_thread.join(timeout=max(0.0, _remaining() - RESERVE_S / 2))
+    # deadline expiry must not orphan a still-running child (the daemon
+    # thread dies with us, its subprocess would not)
+    _kill_children()
+    _emit()
 
 
 if __name__ == "__main__":

@@ -13,8 +13,10 @@ parallelism the reference lacks:
   ``TransformerConfig.sp_attn``.
 - **dp**: batch sharded; gradient AllReduce inserted by XLA.
 - **ep**: MoE layers (optional) shard the expert dimension over the tp
-  axis — dense routing (every expert computes, combine weighted by the
-  router), which is exact; top-k dispatch is a later optimization.
+  axis.  ``moe_top_k=0`` is dense routing (every expert computes,
+  combine weighted by the router — exact); ``moe_top_k>0`` is real EP:
+  GShard-style top-k dispatch with capacity (``parallel/moe.py``),
+  per-token FLOPs independent of the expert count.
 
 Pure-jax functional style: ``init_params`` builds a pytree,
 ``param_specs`` mirrors it with PartitionSpecs, ``make_apply`` returns the
@@ -48,6 +50,11 @@ class TransformerConfig:
     max_seq: int = 512
     moe_every: int = 0       # every Nth layer is MoE (0 = none)
     n_experts: int = 4
+    moe_top_k: int = 0       # 0 = dense routing (every expert computes,
+    #                          exact); k>0 = GShard-style top-k dispatch
+    #                          with capacity (per-token FLOPs independent
+    #                          of n_experts — parallel/moe.py)
+    moe_capacity_factor: float = 1.25
     compute_dtype: Any = jnp.bfloat16
     sp_attn: str = "ring"    # "ring" (K/V rotation, any head count) or
     #                          "ulysses" (head<->seq all-to-all; needs
@@ -142,11 +149,16 @@ def _rms_norm(x, scale):
     return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
 
 
-def make_apply(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
+def make_apply(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
+               return_aux: bool = False):
     """Build the forward fn.  With a mesh containing an ``sp`` axis of
     size > 1, attention runs sequence-parallel in shard_map — ring
     attention or Ulysses all-to-all per ``cfg.sp_attn`` — otherwise the
-    dense single-device path."""
+    dense single-device path.
+
+    ``return_aux=True`` makes the fn return ``(logits, aux)`` where aux
+    is the summed MoE load-balancing loss (zero without top-k MoE); the
+    default keeps the historical logits-only signature."""
     if cfg.sp_attn not in ("ring", "ulysses"):
         raise ValueError(
             f"sp_attn must be 'ring' or 'ulysses', got {cfg.sp_attn!r}")
@@ -188,11 +200,14 @@ def make_apply(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
 
         if cfg.remat:
             layer_fn = jax.checkpoint(layer_fn, static_argnums=(2,))
+        aux_total = jnp.zeros((), jnp.float32)
         for i, layer in enumerate(params["layers"]):
-            x = layer_fn(layer, x, i)
+            x, aux = layer_fn(layer, x, i)
+            aux_total = aux_total + aux
         x = _rms_norm(x, params["ln_f"])
         logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(cd))
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        return (logits, aux_total) if return_aux else logits
 
     return apply
 
@@ -232,21 +247,33 @@ def _layer_forward(cfg: TransformerConfig, i: int, layer, x, attn_op,
     a = attn_op(q, k, v)
     x = x + jnp.einsum("bthk,hkd->btd", a, layer["wo"].astype(cd))
     h = _rms_norm(x, layer["ln2"])
+    aux = jnp.zeros((), jnp.float32)
     if cfg.is_moe(i):
-        # dense-routing MoE: every expert computes, outputs are
-        # combined by router weights (exact; experts sharded tp/ep)
-        gates = jax.nn.softmax(
-            jnp.einsum("btd,de->bte", h.astype(jnp.float32),
-                       layer["router"]), axis=-1).astype(cd)
-        up = jnp.einsum("btd,edf->btef", h, layer["we1"].astype(cd))
-        up = jax.nn.gelu(up)
-        down = jnp.einsum("btef,efd->bted", up, layer["we2"].astype(cd))
-        x = x + jnp.einsum("bted,bte->btd", down, gates)
+        if cfg.moe_top_k > 0:
+            # real EP: top-k routing with capacity; each token computed
+            # by only its k experts (parallel/moe.py, batch = groups)
+            from geomx_tpu.parallel.moe import moe_ffn_topk
+            y, aux = moe_ffn_topk(
+                h, layer["router"], layer["we1"], layer["we2"],
+                k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                compute_dtype=cd)
+            x = x + y
+        else:
+            # dense-routing MoE: every expert computes, outputs are
+            # combined by router weights (exact; experts sharded tp/ep)
+            gates = jax.nn.softmax(
+                jnp.einsum("btd,de->bte", h.astype(jnp.float32),
+                           layer["router"]), axis=-1).astype(cd)
+            up = jnp.einsum("btd,edf->btef", h, layer["we1"].astype(cd))
+            up = jax.nn.gelu(up)
+            down = jnp.einsum("btef,efd->bted", up, layer["we2"].astype(cd))
+            x = x + jnp.einsum("bted,bte->btd", down, gates)
     else:
         up = jax.nn.gelu(jnp.einsum("btd,df->btf", h,
                                     layer["w1"].astype(cd)))
         x = x + jnp.einsum("btf,fd->btd", up, layer["w2"].astype(cd))
-    return x
+    return x, aux
 
 
 def make_staged(cfg: TransformerConfig, rng: jax.Array):
@@ -260,6 +287,12 @@ def make_staged(cfg: TransformerConfig, rng: jax.Array):
     Returns ``(stage_fns, stage_params)`` ready for
     ``overlap.StagedModel`` / ``run_worker_overlapped``.
     """
+    if cfg.moe_every > 0 and cfg.moe_top_k > 0:
+        # the staged loop has no channel for the MoE aux loss; dropping
+        # it silently would train top-k routers without load balancing
+        raise ValueError("make_staged supports dense-routing MoE only "
+                         "(moe_top_k must be 0): the staged loss has no "
+                         "aux-loss channel")
     params = init_params(cfg, rng)
     head = jax.random.normal(
         jax.random.fold_in(rng, 7), (cfg.d_model, cfg.vocab),
@@ -273,7 +306,7 @@ def make_staged(cfg: TransformerConfig, rng: jax.Array):
     def layer_fn(p, x, i=0):
         return _layer_forward(
             cfg, i, p, x,
-            lambda q, k, v: _single_device_attention(cfg, q, k, v))
+            lambda q, k, v: _single_device_attention(cfg, q, k, v))[0]
 
     def head_fn(p, x):
         x = _rms_norm(x, p["ln_f"])
@@ -291,10 +324,22 @@ def make_staged(cfg: TransformerConfig, rng: jax.Array):
     return stage_fns, stage_params
 
 
+def token_cross_entropy(logits, tokens):
+    """Next-token cross-entropy (shift by one) — THE LM objective; every
+    consumer (lm_loss, the bench children, the dryrun) must route
+    through here so they all measure the same thing."""
+    logp = jax.nn.log_softmax(logits[:, :-1])
+    ll = jnp.take_along_axis(logp, tokens[:, 1:, None], axis=-1)
+    return -jnp.mean(ll)
+
+
 def lm_loss(apply_fn, params, tokens):
     """Next-token cross-entropy (shift by one)."""
-    logits = apply_fn(params, tokens)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits[:, :-1])
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return -jnp.mean(ll)
+    return token_cross_entropy(apply_fn(params, tokens), tokens)
+
+
+def lm_loss_with_aux(apply_fn, params, tokens, aux_coef: float = 0.01):
+    """LM loss + MoE load-balancing aux.  ``apply_fn`` must come from
+    ``make_apply(..., return_aux=True)``."""
+    logits, aux = apply_fn(params, tokens)
+    return token_cross_entropy(logits, tokens) + aux_coef * aux

@@ -1,3 +1,5 @@
 from geomx_tpu.parallel.mesh import make_mesh, named_sharding  # noqa: F401
+from geomx_tpu.parallel.moe import (  # noqa: F401
+    expert_capacity, moe_ffn_topk, topk_dispatch_combine)
 from geomx_tpu.parallel.ring_attention import ring_attention  # noqa: F401
 from geomx_tpu.parallel.ulysses import ulysses_attention  # noqa: F401

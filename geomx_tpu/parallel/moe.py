@@ -1,0 +1,140 @@
+"""Expert parallelism: top-k routed MoE with capacity-bounded dispatch.
+
+Absent from the reference (SURVEY.md §2.3 — GeoMX has no MoE/EP
+anywhere); a TPU-design addition.  Round-2 shipped dense routing (every
+expert computes every token — exact but O(E) FLOPs); this module is the
+real thing: GShard/Switch-style top-k routing where each token is
+computed by only its k chosen experts, bounded by a per-group expert
+capacity, so **per-token FLOPs are independent of the expert count**.
+
+Design notes (why this shape and not a sort/scatter kernel):
+
+- Dispatch and combine are expressed as *einsums over one-hot tensors*
+  — the formulation GSPMD partitions natively.  With experts sharded
+  ``P("tp")`` (ep aliases tp: each device owns E/tp experts) and
+  activations replicated over tp, XLA partitions the dispatch einsum
+  with zero communication and inserts exactly one psum at the combine —
+  the same collective footprint as the Megatron MLP it replaces.
+- Shapes are static: capacity ``C = ceil(S*k*cf/E)`` is computed from
+  static dims, tokens past capacity are dropped (standard GShard
+  semantics), and the schedule contains no data-dependent control flow
+  — everything tiles onto the MXU.
+- Tokens route in groups (the leading batch dim): capacity is per
+  group, which bounds the dispatch tensor at [G,S,E,C] = S²·k·cf
+  elements per group instead of the global (G·S)² blowup.
+
+Exactness anchor: with ``k = E`` and ``capacity = S`` the dispatch is
+total (every token reaches every expert with its full softmax gate), so
+the layer reproduces dense routing bit-for-bit — that equivalence is the
+correctness test (tests/test_moe.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def expert_capacity(tokens_per_group: int, n_experts: int, k: int,
+                    capacity_factor: float) -> int:
+    """Per-group per-expert slot count: ceil(S·k·cf / E), min 1."""
+    return max(1, math.ceil(tokens_per_group * k * capacity_factor
+                            / n_experts))
+
+
+def topk_dispatch_combine(
+    router_logits: jax.Array,
+    k: int,
+    capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k routing tensors for grouped tokens.
+
+    ``router_logits``: [G, S, E] float32 (G groups of S tokens).
+    Returns ``(dispatch, combine, aux_loss)``:
+
+    - ``dispatch`` [G, S, E, C] float32 in {0,1} — token s of group g
+      occupies slot c of expert e;
+    - ``combine``  [G, S, E, C] float32 — dispatch scaled by the token's
+      (renormalized) gate for that expert;
+    - ``aux_loss`` scalar — Switch-style load-balancing loss
+      (E · Σ_e fraction_tokens_e · mean_router_prob_e), to be added to
+      the training objective with a small coefficient.
+
+    Priority is choice-major then token-major (all first choices claim
+    slots before any second choice), matching GShard so earlier tokens
+    never lose their first-choice slot to a later token's second choice.
+    """
+    G, S, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = lax.top_k(probs, k)          # [G, S, k]
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)  # [G,S,k,E]
+
+    # position of each (token, choice) within its expert's queue,
+    # counted choice-major: cumsum over the flattened [k*S] order
+    oh_km = jnp.swapaxes(onehot, 1, 2)                 # [G, k, S, E]
+    cum = jnp.cumsum(oh_km.reshape(G, k * S, E), axis=1)
+    pos_km = cum.reshape(G, k, S, E) - oh_km           # exclusive cumsum
+    pos = jnp.swapaxes(pos_km, 1, 2)                   # [G, S, k, E]
+    pos_in_expert = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
+
+    keep = (pos_in_expert < capacity).astype(jnp.float32)
+    loc = jax.nn.one_hot(pos_in_expert, capacity,
+                         dtype=jnp.float32)            # [G, S, k, C]
+
+    # contract the choice dim without materializing [G,S,k,E,C]
+    dispatch = jnp.einsum("gske,gskc->gsec", onehot * keep[..., None], loc)
+    combine = jnp.einsum(
+        "gske,gskc->gsec",
+        onehot * (gate_vals * keep)[..., None], loc)
+
+    # Switch aux loss: encourages uniform expert load.  fraction of
+    # tokens whose FIRST choice is e  ·  mean router prob of e
+    first = jax.nn.one_hot(gate_idx[..., 0], E, dtype=jnp.float32)
+    frac_tokens = jnp.mean(first, axis=(0, 1))         # [E]
+    mean_prob = jnp.mean(probs, axis=(0, 1))           # [E]
+    aux_loss = E * jnp.sum(frac_tokens * mean_prob)
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn_topk(
+    x: jax.Array,
+    router_w: jax.Array,
+    we1: jax.Array,
+    we2: jax.Array,
+    k: int,
+    capacity_factor: float = 1.25,
+    capacity: Optional[int] = None,
+    compute_dtype=jnp.bfloat16,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k routed expert FFN.
+
+    ``x`` [G, S, D] (groups × tokens × model dim), ``router_w`` [D, E],
+    ``we1`` [E, D, F], ``we2`` [E, F, D].  Returns ``(y, aux_loss)``
+    with ``y`` [G, S, D] in ``compute_dtype``.
+
+    Expert compute runs as [E, G, C, D] einsums — expert dim leading so
+    a ``P("tp")`` sharding on we1/we2/xe keeps every matmul local to
+    the expert's device; the combine einsum is where GSPMD inserts the
+    single psum over tp.
+    """
+    G, S, D = x.shape
+    E = router_w.shape[-1]
+    if capacity is None:
+        capacity = expert_capacity(S, E, k, capacity_factor)
+
+    logits = jnp.einsum("gsd,de->gse", x.astype(jnp.float32), router_w)
+    dispatch, combine, aux_loss = topk_dispatch_combine(logits, k, capacity)
+
+    cd = compute_dtype
+    xe = jnp.einsum("gsec,gsd->egcd", dispatch.astype(cd), x.astype(cd))
+    up = jax.nn.gelu(jnp.einsum("egcd,edf->egcf", xe, we1.astype(cd)))
+    ye = jnp.einsum("egcf,efd->egcd", up, we2.astype(cd))
+    y = jnp.einsum("gsec,egcd->gsd", combine.astype(cd), ye)
+    return y.astype(cd), aux_loss

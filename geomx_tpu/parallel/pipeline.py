@@ -28,6 +28,7 @@ def pipeline_apply(
     stacked_params,
     x_mb: jax.Array,
     axis: str = "pp",
+    dp_axis: Optional[str] = None,
 ):
     """Run microbatches through the pipelined block stack.
 
@@ -35,16 +36,18 @@ def pipeline_apply(
     - ``stacked_params``: pytree whose leaves have a leading layer dim L,
       sharded ``P(axis)`` (L must divide by the pp axis size).
     - ``x_mb``: [M, mb, ...] microbatches, replicated across ``axis``.
+    - ``dp_axis``: optional mesh axis sharding the microbatch dim (index
+      1) — pp×dp composition: each dp shard runs its own pipeline over
+      its slice of every microbatch; the pp collectives (ppermute
+      relays, final psum) stay within a dp coordinate, and the gradient
+      AllReduce over dp is inserted by shard_map's transpose as usual.
 
-    Returns [M, mb, ...] outputs, replicated.
+    Returns [M, mb, ...] outputs, replicated over ``axis`` (sharded over
+    ``dp_axis`` if given).
     """
     pp = mesh.shape[axis]
 
     def stage(params_local, x):
-        # scan my local blocks over the activation
-        def one(block_params, h):
-            return block_fn(block_params, h), None
-
         def apply_local(h):
             h, _ = lax.scan(lambda c, p: (block_fn(p, c), None),
                             h, params_local)
@@ -84,11 +87,12 @@ def pipeline_apply(
         mask = jnp.where(my == pp - 1, 1.0, 0.0).astype(out.dtype)
         return lax.psum(out * mask, axis)
 
-    pspec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    x_spec = P(*([None, dp_axis] + [None] * (x_mb.ndim - 2))
+               if dp_axis else [None] * x_mb.ndim)
     return shard_map(
         stage, mesh=mesh,
-        in_specs=(P(axis), P(*([None] * x_mb.ndim))),
-        out_specs=P(*([None] * x_mb.ndim)),
+        in_specs=(P(axis), x_spec),
+        out_specs=x_spec,
         check_vma=False,
     )(stacked_params, x_mb)
 
@@ -118,3 +122,82 @@ def sequential_apply(stacked_params, x_mb, block_fn=mlp_block):
         return h
 
     return jax.vmap(apply_one)(x_mb)
+
+
+# --------------------------------------------------------------------------
+# flagship transformer over pp(+dp) — VERDICT r2 item 5
+# --------------------------------------------------------------------------
+
+def stack_layers(layers):
+    """Stack a list of identical-structure layer pytrees along a new
+    leading dim (the pp shard dim).  Homogeneous (non-MoE) layers only."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def init_pp_transformer(cfg, rng):
+    """Flagship params in pipeline layout: ``layers`` stacked [L, ...]
+    (shard ``P("pp")``), embedding/head UNTIED (same reasoning as
+    ``make_staged``: one tensor must not live in two stages when each
+    stage's grads are pushed to the kvstore independently)."""
+    from geomx_tpu.models.transformer import init_params
+
+    assert cfg.moe_every == 0, "pp flagship pipelines homogeneous layers"
+    params = init_params(cfg, rng)
+    import numpy as np
+    head = jax.random.normal(
+        jax.random.fold_in(rng, 7), (cfg.d_model, cfg.vocab),
+        jnp.float32) / np.sqrt(cfg.d_model)
+    return {
+        "embed": params["embed"],
+        "pos": params["pos"],
+        "layers": stack_layers(params["layers"]),
+        "ln_f": params["ln_f"],
+        "head": head,
+    }
+
+
+def pp_param_specs(pp_params, axis: str = "pp"):
+    """PartitionSpecs mirroring an ``init_pp_transformer`` tree: layer
+    stack sharded over pp (leading dim), everything else replicated."""
+    return {
+        "embed": P(None, None),
+        "pos": P(None, None),
+        "layers": jax.tree_util.tree_map(
+            lambda leaf: P(*([axis] + [None] * (leaf.ndim - 1))),
+            pp_params["layers"]),
+        "ln_f": P(None),
+        "head": P(None, None),
+    }
+
+
+def make_pp_apply(cfg, mesh: Mesh, n_microbatches: int,
+                  axis: str = "pp", dp_axis: Optional[str] = None):
+    """Pipelined flagship forward: embed (replicated over pp) → GPipe
+    schedule over the stacked transformer layers → ln_f + untied head.
+    One jit compiles the whole thing; grads flow through the schedule
+    (the scan is differentiable), so ``value_and_grad`` of the returned
+    apply is the full pipelined train step."""
+    from geomx_tpu.models.transformer import (
+        _layer_forward, _rms_norm, _single_device_attention)
+
+    def block(layer, x):
+        return _layer_forward(
+            cfg, 0, layer, x,
+            lambda q, k, v: _single_device_attention(cfg, q, k, v))[0]
+
+    def apply(pp_params, tokens):
+        B, T = tokens.shape
+        M = n_microbatches
+        assert B % M == 0, (B, M)
+        cd = cfg.compute_dtype
+        x = pp_params["embed"][tokens].astype(cd)
+        x = x + pp_params["pos"][:T][None].astype(cd)
+        x_mb = x.reshape(M, B // M, T, cfg.d_model)
+        out = pipeline_apply(mesh, block, pp_params["layers"], x_mb,
+                             axis=axis, dp_axis=dp_axis)
+        x = out.reshape(B, T, cfg.d_model)
+        x = _rms_norm(x, pp_params["ln_f"])
+        logits = jnp.einsum("btd,dv->btv", x, pp_params["head"].astype(cd))
+        return logits.astype(jnp.float32)
+
+    return apply
