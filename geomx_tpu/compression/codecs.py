@@ -264,9 +264,16 @@ class MpqSelector:
         self.fp16 = Fp16Codec()
         self.bsc = BscCodec(ratio=ratio, momentum=momentum,
                             sample_rate=sample_rate)
+        # split observability for acceptance runs / QUERY_STATS
+        self.bsc_picks = 0
+        self.fp16_picks = 0
 
     def select(self, size: int) -> Codec:
-        return self.bsc if size >= self.size_bound else self.fp16
+        if size >= self.size_bound:
+            self.bsc_picks += 1
+            return self.bsc
+        self.fp16_picks += 1
+        return self.fp16
 
 
 class BroadcastCompressor:

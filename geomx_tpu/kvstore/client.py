@@ -66,6 +66,7 @@ class WorkerKVStore:
             self._ts_cv = threading.Condition()
             self._ts_buf: Dict[int, np.ndarray] = {}
             self._ts_count: Dict[int, int] = {}
+            self.ts_relays_received = 0  # overlay acceptance observable
             self._push_rounds: Dict[int, int] = {}
             self.worker.ts_handler = self._on_ts_relay
             # push-direction overlay: worker-to-worker merge trees
@@ -80,6 +81,10 @@ class WorkerKVStore:
 
     # ---- helpers ------------------------------------------------------------
     def _encode(self, tid: int, flat: np.ndarray, priority: int = 0) -> KVPairs:
+        """Encode ``flat`` into the tensor's partition plan.  When the
+        parts tile ``flat`` exactly the returned KVPairs ALIASES it
+        (see push()'s aliasing contract) — callers hand the result to
+        the van and must not mutate ``flat`` until acked."""
         parts = sorted(self.plan.parts(tid, flat.size, priority),
                        key=lambda p: p.ps_key)
         keys = np.array([p.ps_key for p in parts], dtype=np.int64)
@@ -173,6 +178,7 @@ class WorkerKVStore:
         it = str(msg.body["iter"])
         kvs = _KVPairs(msg.keys, msg.vals, msg.lens)
         with self._ts_cv:
+            self.ts_relays_received += 1
             for k, v in kvs.slices():
                 self._ts_buf[k] = np.array(v, copy=True)
                 self._ts_count[k] = self._ts_count.get(k, 0) + 1
@@ -185,14 +191,18 @@ class WorkerKVStore:
              num_merge: int = 1, _count_round: bool = True) -> int:
         """Async push of a gradient (ref: kvstore_dist.h:460-528).
 
+        **Aliasing contract (public API)**: when ``grad`` is already
+        float32/contiguous the payload ALIASES the caller's buffer all
+        the way into the in-proc fabric — no defensive copy is taken.
+        The caller must not mutate ``grad`` until the push is acked
+        (``wait(ts)`` / ``wait_all()``); reusing the buffer earlier
+        silently corrupts the in-flight push.  Servers copy on first
+        touch, so the alias never outlives the ack.
+
         ``num_merge > 1`` marks a pre-merged gradient carrying that many
         workers' contributions (TS push-direction: the elected holder
         pushes once for everyone, ref: num_merge counting van.cc:1197-1252).
         """
-        # no-copy when already float32/contiguous: the payload may alias
-        # the caller's buffer all the way into the in-proc fabric (the
-        # async-push contract — don't mutate the buffer until acked;
-        # servers copy on first touch)
         flat = np.asarray(grad, dtype=np.float32).ravel()
         fields = {"body": {"num_merge": int(num_merge)}} if num_merge > 1 else {}
         ts = self.worker.zpush(self._encode(tid, flat, priority),

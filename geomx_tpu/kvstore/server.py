@@ -150,6 +150,10 @@ class LocalServer:
         # pull-down so compressed (BSC) responses can detect a desynced
         # tracked view and resync dense (BroadcastCompressor.compress)
         self._pull_ver: Dict[int, int] = {}
+        # feature observability (acceptance runs + QUERY_STATS)
+        self.hfa_gated_key_rounds = 0  # K2-gated (key, round) pairs
+        self.ts_deliveries = 0      # inter-party overlay deliveries adopted
+        self.stale_pull_skips = 0   # out-of-order pull responses skipped
         self._esync = None  # EsyncState, lazily built on first Ctrl.ESYNC
         self.compression: dict = {"type": "none"}
         self.push_codec = None  # set by Ctrl.SET_COMPRESSION
@@ -410,6 +414,7 @@ class LocalServer:
         (serving parked pulls before every party worker pushed)."""
         it = str(msg.body["iter"])
         with self._mu:
+            self.ts_deliveries += 1
             for k, v in kvs.slices():
                 # fp16 relay payloads decode back to f32 replicas
                 self.store[k] = np.asarray(v, dtype=np.float32).copy()
@@ -433,6 +438,7 @@ class LocalServer:
                 st.round += 1
                 if self.hfa_enabled and st.round % self.hfa_k2 != 0:
                     local_ks.append(k)
+                    self.hfa_gated_key_rounds += 1
                 else:
                     up_ks.append(k)
 
@@ -722,9 +728,11 @@ class LocalServer:
                     # behind and the next echo mismatch heals it dense.
                     cur = self._pull_ver.get(k, 0)
                     if tag == "bsc" and cur != pv[k] - 1:
+                        self.stale_pull_skips += 1
                         live.append(k)
                         continue
                     if tag == "f32" and pv[k] <= cur:
+                        self.stale_pull_skips += 1
                         live.append(k)
                         continue
                 self.store[k] = self._decode_pull_value(k, v, tag)
@@ -852,6 +860,12 @@ class LocalServer:
                 "recv_bytes": van.recv_bytes,
                 "store_bytes": store_b,
                 "accum_bytes": accum_b,
+                "hfa_gated_key_rounds": self.hfa_gated_key_rounds,
+                "ts_deliveries": self.ts_deliveries,
+                "stale_pull_skips": self.stale_pull_skips,
+                "mpq_bsc_picks": getattr(self.push_codec, "bsc_picks", 0),
+                "mpq_fp16_picks": getattr(self.push_codec, "fp16_picks", 0),
+                "pq_overtakes": van.pq_overtakes,
             })
             return
         elif msg.cmd == Ctrl.ESYNC:

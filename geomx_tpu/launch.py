@@ -178,20 +178,13 @@ def shutdown_cluster(po: Postoffice):
                 pass
 
 
-def _worker_demo(po, kv, args):
-    """The reference demo workload (examples/cnn.py) for launcher smoke
-    runs: tiny CNN on synthetic data."""
-    import jax
-    import numpy as np
-
-    from geomx_tpu.data import ShardedIterator, synthetic_classification
-    from geomx_tpu.models import create_cnn_state
-    from geomx_tpu.training import run_worker
-
-    x, y = synthetic_classification(n=512, shape=(12, 12, 1), seed=0)
-    _, params, grad_fn = create_cnn_state(
-        jax.random.PRNGKey(0), input_shape=(1, 12, 12, 1))
-    widx = kv.party * kv.num_workers + kv.rank
+def _configure_worker(po, kv, args):
+    """Shared worker-side setup for every demo workload: either gate on
+    the central master worker's configuration or (rank 0) push optimizer
+    + compression ourselves, then barrier.  Every workload variant MUST
+    route through here — a path that skips it silently trains without
+    the requested compression and reintroduces the first-round race
+    against the default optimizer."""
     topo = po.topology
     if topo.central_worker:
         # central-worker deployment: the MASTER drives configuration
@@ -221,6 +214,23 @@ def _worker_demo(po, kv, args):
         if kv.rank == 0 and args.compression != "none":
             kv.set_gradient_compression({"type": args.compression})
     kv.barrier()
+
+
+def _worker_demo(po, kv, args):
+    """The reference demo workload (examples/cnn.py) for launcher smoke
+    runs: tiny CNN on synthetic data."""
+    import jax
+    import numpy as np
+
+    from geomx_tpu.data import ShardedIterator, synthetic_classification
+    from geomx_tpu.models import create_cnn_state
+    from geomx_tpu.training import run_worker
+
+    x, y = synthetic_classification(n=512, shape=(12, 12, 1), seed=0)
+    _, params, grad_fn = create_cnn_state(
+        jax.random.PRNGKey(0), input_shape=(1, 12, 12, 1))
+    widx = kv.party * kv.num_workers + kv.rank
+    _configure_worker(po, kv, args)
     it = ShardedIterator(x, y, args.batch, widx, kv.num_all_workers)
     hist = run_worker(kv, params, grad_fn, it, args.steps, barrier_init=True)
     print(f"{po.node}: steps={len(hist)} first_loss={hist[0][0]:.4f} "
@@ -228,6 +238,59 @@ def _worker_demo(po, kv, args):
     kv.barrier()
     if kv.party == 0 and kv.rank == 0:
         time.sleep(0.5)  # let sibling parties drain their last rounds
+        shutdown_cluster(po)
+
+
+def _worker_demo_staged(po, kv, args):
+    """P3 acceptance workload: a staged MLP through the overlapped loop
+    (``overlap.run_worker_overlapped``) — backward pushes deepest stage
+    FIRST, so the shallow stages' later, higher-priority pushes must
+    overtake queued deep slices in the van's priority queue (the
+    observable: ``pq_overtakes`` in this process's exit stats).  Stage
+    params carry a large ballast leaf so socket writes outlast the VJP
+    chain and the queue actually holds contending messages."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from geomx_tpu.data import ShardedIterator, synthetic_classification
+    from geomx_tpu.overlap import StagedModel, run_worker_overlapped
+
+    dims = [144, 64, 64, 64, 64, 10]
+    key = jax.random.PRNGKey(0)
+    fns, params = [], []
+    for i, (din, dout) in enumerate(zip(dims[:-1], dims[1:])):
+        k1, key = jax.random.split(key)
+        params.append({
+            "w": jax.random.normal(k1, (din, dout)) / np.sqrt(din),
+            "b": jnp.zeros((dout,), jnp.float32),
+            "ballast": jnp.zeros((256_000,), jnp.float32),
+        })
+        last = i == len(dims) - 2
+
+        def fn(p, x, last=last):
+            h = x @ p["w"] + p["b"] + 1e-9 * jnp.sum(p["ballast"])
+            return h if last else jax.nn.relu(h)
+
+        fns.append(fn)
+
+    def ce(logits, y):
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+        return loss, jnp.mean(jnp.argmax(logits, -1) == y)
+
+    x, y = synthetic_classification(n=512, shape=(12, 12, 1), seed=0)
+    x = x.reshape(len(x), -1)
+    widx = kv.party * kv.num_workers + kv.rank
+    _configure_worker(po, kv, args)
+    it = ShardedIterator(x, y, args.batch, widx, kv.num_all_workers)
+    model = StagedModel(fns, ce)
+    hist = run_worker_overlapped(kv, model, params, it, args.steps)
+    print(f"{po.node}: steps={len(hist)} first_loss={hist[0][0]:.4f} "
+          f"last_loss={hist[-1][0]:.4f}", flush=True)
+    kv.barrier()
+    if kv.party == 0 and kv.rank == 0:
+        time.sleep(0.5)
         shutdown_cluster(po)
 
 
@@ -303,7 +366,12 @@ def main(argv=None):
                                           advertise=advertise)
     print(f"{node}: up", flush=True)
     if node.role is Role.WORKER:
-        _worker_demo(po, role_obj, args)
+        if cfg.enable_p3:
+            # P3 deployments train through the staged overlap loop —
+            # that IS the feature (priority-scheduled per-stage rounds)
+            _worker_demo_staged(po, role_obj, args)
+        else:
+            _worker_demo(po, role_obj, args)
     elif node.role is Role.MASTER_WORKER:
         # the master worker's whole life: configure, then return before
         # training (ref: examples/cnn.py:96 — master returns after setup)
@@ -326,6 +394,24 @@ def main(argv=None):
         # channels actually rode UDP datagrams, not the reliable conn
         print(f"{node}: udp_tx={udp_tx} udp_rx={udp_rx} "
               f"udp_dropped={udp_drop}", flush=True)
+    # per-feature observables for the acceptance matrix: each proves the
+    # feature's mechanism actually fired, not just that training finished
+    feats = []
+    for attr, tag in (("ts_relays_received", "ts_relays"),
+                      ("hfa_gated_key_rounds", "hfa_gated_key_rounds"),
+                      ("ts_deliveries", "ts_deliveries"),
+                      ("stale_pull_skips", "stale_skips")):
+        v = getattr(role_obj, attr, 0)
+        if v:
+            feats.append(f"{tag}={v}")
+    pc = getattr(role_obj, "push_codec", None)
+    if pc is not None and getattr(pc, "bsc_picks", 0) + getattr(
+            pc, "fp16_picks", 0) > 0:
+        feats.append(f"mpq_bsc={pc.bsc_picks} mpq_fp16={pc.fp16_picks}")
+    if po.van.pq_overtakes:
+        feats.append(f"pq_overtakes={po.van.pq_overtakes}")
+    if feats:
+        print(f"{node}: " + " ".join(feats), flush=True)
     po.stop()
     return 0
 

@@ -158,7 +158,17 @@ def make_apply(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
 
     ``return_aux=True`` makes the fn return ``(logits, aux)`` where aux
     is the summed MoE load-balancing loss (zero without top-k MoE); the
-    default keeps the historical logits-only signature."""
+    default keeps the historical logits-only signature.  TRAINING a
+    top-k MoE through the logits-only form discards the load-balancing
+    pressure (router collapse, silent capacity drops) — fine for
+    inference/forward comparisons, so it warns instead of raising."""
+    if cfg.moe_every > 0 and cfg.moe_top_k > 0 and not return_aux:
+        import warnings
+
+        warnings.warn(
+            "make_apply(return_aux=False) with top-k MoE discards the "
+            "load-balancing aux loss; use return_aux=True + "
+            "lm_loss_with_aux for training", stacklevel=2)
     if cfg.sp_attn not in ("ring", "ulysses"):
         raise ValueError(
             f"sp_attn must be 'ring' or 'ulysses', got {cfg.sp_attn!r}")

@@ -304,6 +304,11 @@ class Van:
         self.recv_bytes = 0
         self.wan_send_bytes = 0
         self.wan_recv_bytes = 0
+        # P3 observability: count priority-queue overtakes (a message
+        # dequeued before an earlier-enqueued one — i.e. the queue
+        # actually reordered under contention)
+        self.pq_overtakes = 0
+        self._max_popped_tie = -1
         self._stats_lock = threading.Lock()
         # resender state (ref: resender.h:15-141).  Dedup keys are
         # (sender, sig) so per-sender counters can't collide; the window is
@@ -437,9 +442,13 @@ class Van:
 
     def _send_loop(self):
         while self._running:
-            _, _, msg = self._pq.get()
+            _, tie, msg = self._pq.get()
             if msg is None:
                 return
+            if tie < self._max_popped_tie:
+                self.pq_overtakes += 1  # enqueued before one already sent
+            else:
+                self._max_popped_tie = tie
             self._send_now(msg)
 
     # ---- receive path -------------------------------------------------------
