@@ -552,10 +552,16 @@ def child_overlap_tpu():
     from geomx_tpu.overlap import StagedModel, run_worker_overlapped
     from geomx_tpu.training import run_worker
 
-    cfg = TransformerConfig(**OVL_TPU_CFG)
+    cfg_d = dict(OVL_TPU_CFG)
+    batch = OVL_TPU_BATCH
+    if os.environ.get("BENCH_OVL_SMALL"):  # CPU validation of the path
+        cfg_d.update(d_model=64, n_heads=4, d_ff=128, max_seq=64,
+                     n_layers=2)
+        batch = 2
+    cfg = TransformerConfig(**cfg_d)
     fns, stage_params = make_staged(cfg, jax.random.PRNGKey(0))
     tokens = jnp.asarray(np.random.default_rng(0).integers(
-        0, cfg.vocab, (OVL_TPU_BATCH, cfg.max_seq)), jnp.int32)
+        0, cfg.vocab, (batch, cfg.max_seq)), jnp.int32)
 
     def ce(logits, tokens):
         return token_cross_entropy(logits, tokens), jnp.mean(logits)
@@ -609,9 +615,9 @@ def child_overlap_tpu():
         "staged_overhead_per_stage_ms": round(
             (stag - mono) / n_stages * 1000, 1),
         "n_stages": n_stages,
-        "model": (f"transformer d{OVL_TPU_CFG['d_model']} "
-                  f"L{OVL_TPU_CFG['n_layers']} seq{OVL_TPU_CFG['max_seq']} "
-                  f"batch{OVL_TPU_BATCH}"),
+        "model": (f"transformer d{cfg_d['d_model']} "
+                  f"L{cfg_d['n_layers']} seq{cfg_d['max_seq']} "
+                  f"batch{batch}"),
         "note": ("in-proc kvstore, no WAN throttle: measures the pure "
                  "schedule/dispatch cost of staging on this backend; the "
                  "overlap *win* under WAN contention is the cpu overlap "

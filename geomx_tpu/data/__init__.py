@@ -1,4 +1,5 @@
-from geomx_tpu.data.synthetic import synthetic_classification, ShardedIterator  # noqa: F401
+from geomx_tpu.data.synthetic import (  # noqa: F401
+    ShardedIterator, TokenIterator, synthetic_classification, synthetic_lm)
 from geomx_tpu.data.recordio import (  # noqa: F401
     RecordReader, RecordWriter, pack_array, unpack_array,
     write_array_dataset,

@@ -32,6 +32,48 @@ def synthetic_classification(
     return x, y
 
 
+def synthetic_lm(
+    n: int = 2048,
+    seq: int = 64,
+    vocab: int = 256,
+    order: float = 0.85,
+    seed: int = 0,
+) -> np.ndarray:
+    """Token sequences from a low-entropy affine chain: with probability
+    ``order`` the next token is ``(5*cur + 17) % vocab``, else uniform —
+    an LM can cut its loss well below ``log(vocab)`` within a few steps,
+    which is all the e2e acceptance needs (same oracle philosophy as
+    ``synthetic_classification``).  Returns int32 [n, seq]."""
+    rng = np.random.default_rng(seed)
+    toks = np.empty((n, seq), np.int32)
+    toks[:, 0] = rng.integers(0, vocab, size=n)
+    for t in range(1, seq):
+        det = (5 * toks[:, t - 1] + 17) % vocab
+        rand = rng.integers(0, vocab, size=n)
+        toks[:, t] = np.where(rng.random(n) < order, det, rand)
+    return toks
+
+
+class TokenIterator:
+    """Sharded batch iterator over token sequences; yields ``(tokens,
+    tokens)`` pairs so the generic worker loops (which expect (x, y))
+    work unchanged — the LM objective ignores y."""
+
+    def __init__(self, tokens: np.ndarray, batch_size: int,
+                 worker_index: int = 0, num_workers: int = 1, seed: int = 0):
+        self.tokens = tokens[worker_index::num_workers]
+        self.batch_size = batch_size
+        self._rng = np.random.default_rng(seed + worker_index)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        idx = self._rng.integers(0, len(self.tokens), size=self.batch_size)
+        batch = self.tokens[idx]
+        return batch, batch
+
+
 class ShardedIterator:
     """Round-robin shard of a dataset for one worker among
     ``num_all_workers`` (global worker index orders shards)."""

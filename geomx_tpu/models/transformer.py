@@ -177,12 +177,17 @@ def make_apply(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     def attn_op(q, k, v):
         if not use_ring:
             return _single_device_attention(cfg, q, k, v)
-        # attn_impl="dense" keeps the all-fp32 reference blocks; any
-        # other impl runs the sp blocks bf16-on-MXU with fp32 accum
-        fast = cfg.attn_impl != "dense"
+        # attn_impl="dense" keeps the all-fp32 reference blocks;
+        # "flash" fuses each ring block in a pallas kernel (no HBM
+        # probs); anything else runs bf16-on-MXU einsum blocks with
+        # fp32 accum.  Ulysses does whole-sequence attention after its
+        # all-to-all, so it takes the boolean fast path only.
+        fast = ("flash" if cfg.attn_impl == "flash"
+                else cfg.attn_impl != "dense")
         if cfg.sp_attn == "ulysses":
             sp_fn = lambda a, b, c: ulysses_attention(  # noqa: E731
-                a, b, c, axis_name="sp", causal=True, fast=fast)
+                a, b, c, axis_name="sp", causal=True,
+                fast=cfg.attn_impl != "dense")
         else:
             sp_fn = lambda a, b, c: ring_attention(  # noqa: E731
                 a, b, c, axis_name="sp", axis_size=mesh.shape["sp"],

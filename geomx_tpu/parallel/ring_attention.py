@@ -59,7 +59,7 @@ def ring_attention(
     axis_name: str = "sp",
     axis_size: Optional[int] = None,
     causal: bool = True,
-    fast: bool = False,
+    fast=False,
 ) -> jax.Array:
     """Exact attention with K/V ring rotation over ``axis_name``.
 
@@ -67,7 +67,11 @@ def ring_attention(
     axis_size * T_local, laid out contiguously by sp rank.  Returns
     [B, T_local, H, D] in q.dtype.  ``fast`` = bf16 MXU matmuls with
     fp32 accumulation in each block (see _block_attn); accumulation
-    across ring hops is float32 either way.
+    across ring hops is float32 either way.  ``fast="flash"`` runs each
+    hop's block through the fused pallas kernel
+    (``ops/block_attention.flash_block_attention``): no HBM-materialized
+    score/prob tensors, same semantics (on-chip wants D a multiple of
+    128; off-chip use TPU interpret mode).
     """
     if axis_size is None:
         axis_size = lax.axis_size(axis_name)
@@ -97,7 +101,15 @@ def ring_attention(
     def step(i, carry):
         k_blk, v_blk, m, l, o = carry
         src = (my + i) % axis_size
-        bm, bl, bo = _block_attn(q, k_blk, v_blk, bias_for(src), fast=fast)
+        if fast == "flash":
+            from geomx_tpu.ops.block_attention import flash_block_attention
+
+            offs = jnp.stack([my * T, src * T]).astype(jnp.int32)
+            bm, bl, bo = flash_block_attention(q, k_blk, v_blk, offs,
+                                               causal)
+        else:
+            bm, bl, bo = _block_attn(q, k_blk, v_blk, bias_for(src),
+                                     fast=fast)
         new_m = jnp.maximum(m, bm)
         # guard fully-masked blocks (bm = -inf everywhere for that row)
         alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - new_m, neg))
