@@ -59,6 +59,66 @@ from pathlib import Path
 ROOT = Path(__file__).resolve().parent
 sys.path.insert(0, str(ROOT))
 
+# Last-known-good cache for on-chip results (VERDICT r3 item 1): the axon
+# tunnel dies for whole rounds at a time, so any child that completes on
+# real TPU hardware persists its result here immediately.  When the live
+# probe fails at bench time, the record is assembled from this cache with
+# explicit staleness markers — one live-tunnel window at ANY point in a
+# round is enough to land the round's on-chip numbers.  "probe" is
+# deliberately NOT cached: it measures tunnel liveness *now*; replaying
+# it would misreport a dead tunnel as alive.
+TPU_LKG_PATH = ROOT / "TPU_LKG.json"
+TPU_CHILDREN = ("cnn", "mfu", "quant", "overlap_tpu")
+# serializes chip access between the round's live bench and the
+# background watcher's capture passes (both are this script)
+BENCH_FLOCK_PATH = ROOT / ".bench.lock"
+_allow_lkg = True        # cleared by --skip-tpu: a CPU-only record must
+#                          stay a pure function of the flags
+
+
+def _git_head() -> str:
+    try:
+        out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             cwd=ROOT, capture_output=True, text=True,
+                             timeout=10)
+        return out.stdout.strip() or "unknown"
+    except (OSError, subprocess.SubprocessError):
+        return "unknown"
+
+
+def _load_lkg() -> dict:
+    # ValueError covers JSONDecodeError AND UnicodeDecodeError — this
+    # runs on the signal-handler path, where a corrupt file must not
+    # throw (it would kill the emergency flush)
+    try:
+        return json.loads(TPU_LKG_PATH.read_text())
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_lkg_entry(name: str, res: dict):
+    """Read-modify-write under an OS-level lock: the watcher's capture
+    pass and the round's live bench are separate processes writing the
+    same file, so a threading.Lock or a shared tmp name would lose or
+    corrupt entries."""
+    import fcntl
+
+    with open(BENCH_FLOCK_PATH.with_suffix(".lkg.lock"), "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        cur = _load_lkg()
+        cur[name] = {
+            "result": res,
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "captured_unix": time.time(),
+            # numbers from an older build must not masquerade as current
+            # (a regression landed after capture would be invisible) —
+            # _build_record flags any commit mismatch
+            "commit": _git_head(),
+        }
+        tmp = TPU_LKG_PATH.with_suffix(f".json.{os.getpid()}.tmp")
+        tmp.write_text(json.dumps(cur, indent=1, sort_keys=True))
+        tmp.replace(TPU_LKG_PATH)
+
 BATCH = 4096        # measured: throughput saturates at 4096 (584k img/s
 #                     vs 302k at 1024 — the tiny CNN is HBM-bound and
 #                     needs the batch to amortize per-step overheads)
@@ -786,10 +846,32 @@ def _remaining() -> float:
 
 def _build_record() -> dict:
     """Assemble the full output record from whatever has finished.
-    Pure function of _results/_errors — called after every child and
-    from the signal handler, so it must never block or throw."""
+    Pure function of _results/_errors (+ the LKG file) — called after
+    every child and from the signal handler, so it must never block or
+    throw.  TPU children missing from this run fall back to the
+    last-known-good cache with explicit staleness markers."""
+    lkg = _load_lkg() if _allow_lkg else {}
+    head = _git_head() if lkg else None
+
+    def lkg_src(name: str) -> str:
+        e = lkg[name]
+        src = f"lkg:{e.get('captured_at') or 'unknown'}"
+        if e.get("commit") and e["commit"] != head:
+            src += f" (commit {e['commit']}, now {head})"
+        return src
+
     cnn = _results.get("cnn")
+    cnn_src = "live"
+    lkg_used = False
+    if cnn is None and "cnn" in lkg:
+        cnn = lkg["cnn"].get("result")
+        cnn_src = lkg_src("cnn")
+        lkg_used = cnn is not None
     mfu = _results.get("mfu")
+    mfu_src = "live"
+    if mfu is None and "mfu" in lkg:
+        mfu = lkg["mfu"].get("result")
+        mfu_src = lkg_src("mfu")
     wan = _results.get("wan")
     if cnn is not None:
         deriv = cnn.get("a100_ref_derivation", {})
@@ -800,16 +882,19 @@ def _build_record() -> dict:
             "unit": "images/sec/chip",
             "vs_baseline": cnn.get("vs_baseline"),
             # vs_baseline divides measured TPU throughput by a MODELED
-            # A100 reference (no A100 reachable; BASELINE.md) — surface
-            # the least-favorable modeled scenario next to it so no
-            # consumer mistakes the model for a measurement
+            # A100 reference (no A100 reachable; BASELINE.md) — the
+            # duplicate key name says so outright, and the least-favorable
+            # modeled scenario sits next to it so no consumer mistakes
+            # the model for a measurement (VERDICT r3 item 8)
+            "vs_modeled_a100": cnn.get("vs_baseline"),
             "vs_baseline_semantics": (
-                "measured TPU ips / modeled A100 reference "
+                "modeled, not measured: TPU ips / modeled A100 reference "
                 "(reference_as_published_fp32; see a100_ref_derivation)"),
             "vs_modeled_xla_grade_peer": scen.get(
                 "hypothetical_xla_grade_peer", {}).get("vs_0.9x_sxm80"),
             "a100_ref_derivation": deriv,
             "device": cnn.get("device"),
+            "value_source": cnn_src,
         }
     elif mfu is not None:
         record = {
@@ -817,7 +902,10 @@ def _build_record() -> dict:
             "value": mfu.get("achieved_tflops"),
             "unit": "TFLOP/s",
             "vs_baseline": None,
+            "value_source": mfu_src,
         }
+        if mfu_src != "live":
+            lkg_used = True
     elif wan is not None:
         record = {
             "metric": "wan_bytes_per_step",
@@ -840,6 +928,17 @@ def _build_record() -> dict:
                       ("stress", "stress"), ("probe", "probe")):
         if name in _results:
             record[key] = _results[name]
+        elif name in TPU_CHILDREN and name in lkg:
+            res = lkg[name].get("result")
+            if res is not None:
+                extra = {"lkg_stale": True,
+                         "lkg_captured_at": lkg[name].get("captured_at")}
+                if lkg[name].get("commit") and lkg[name]["commit"] != head:
+                    extra["lkg_commit_mismatch"] = lkg[name]["commit"]
+                record[key] = dict(res, **extra)
+                lkg_used = True
+    if lkg_used:
+        record["tpu_lkg_used"] = True
     if _errors:
         record["errors"] = dict(_errors)
     record["elapsed_s"] = round(time.monotonic() - _T0, 1)
@@ -893,6 +992,27 @@ def _on_term(signum, frame):
     os._exit(0)
 
 
+def _acquire_bench_lock(blocking_s: float):
+    """Serialize chip access between the live bench and the watcher's
+    capture passes: concurrent TPU children over one tunnel would depress
+    each other's (headline) numbers.  Returns the fd holding the flock,
+    or None if it could not be acquired within ``blocking_s``.  The lock
+    dies with the process, so a killed holder cannot wedge the next run."""
+    import fcntl
+
+    f = open(BENCH_FLOCK_PATH, "w")
+    deadline = time.monotonic() + blocking_s
+    while True:
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return f
+        except OSError:
+            if time.monotonic() >= deadline:
+                f.close()
+                return None
+            time.sleep(2)
+
+
 def _run_child(name: str, timeout: float, env_extra=None):
     budget = _remaining() - RESERVE_S
     if budget < MIN_CHILD_S:
@@ -925,13 +1045,20 @@ def _run_child(name: str, timeout: float, env_extra=None):
 
 
 def _do(name: str, timeout: float, env_extra=None) -> bool:
-    """Run one child, record its result or error, re-emit the record."""
+    """Run one child, record its result or error, re-emit the record.
+    On-chip results are also persisted to the LKG cache immediately."""
     res, err = _run_child(name, timeout, env_extra)
     with _lock:
         if res is not None:
             _results[name] = res
         if err:
             _errors[name] = err
+    if (res is not None and name in TPU_CHILDREN
+            and res.get("platform") in ("tpu", "axon")):
+        try:
+            _save_lkg_entry(name, res)
+        except OSError:
+            pass
     _emit()
     return res is not None
 
@@ -944,7 +1071,15 @@ def main():
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
+    ap.add_argument("--capture-lkg", action="store_true",
+                    help="probe the tunnel; if alive run all TPU children "
+                         "and persist results to TPU_LKG.json (used by "
+                         "scripts/tpu_watch.py to exploit transient "
+                         "live-tunnel windows mid-round)")
     args = ap.parse_args()
+    global _allow_lkg
+    if args.skip_tpu:
+        _allow_lkg = False
 
     if args.child:
         # route a CPU request through jax.config: the sandbox's
@@ -962,6 +1097,39 @@ def main():
     signal.signal(signal.SIGINT, _on_term)
 
     cpu_env = {"JAX_PLATFORMS": "cpu", "JAX_PLATFORM_NAME": "cpu"}
+
+    if args.capture_lkg:
+        # LKG capture pass: generous probe (cold backend init has been
+        # observed >75 s), then every TPU child; _do persists each
+        # on-chip success to TPU_LKG.json as it lands.  The chip lock is
+        # taken PER CHILD, non-blocking: if the round's live bench wants
+        # the chip it acquires between our children within its 60 s
+        # grace, and we abandon the pass rather than contend with the
+        # headline measurement.  A full pass cannot fit the default
+        # deadline — raise it unless the operator set one explicitly.
+        global DEADLINE_S
+        if "BENCH_DEADLINE_S" not in os.environ:
+            DEADLINE_S = max(DEADLINE_S, 1500.0)
+
+        def locked_do(name: str, timeout: float) -> bool:
+            fd = _acquire_bench_lock(0)
+            if fd is None:
+                print(json.dumps({"capture_lkg": f"stopped before {name}: "
+                                  "live bench holds the chip lock"}))
+                return False
+            try:
+                return _do(name, timeout)
+            finally:
+                fd.close()
+
+        if locked_do("probe", 180):
+            platform = _results.get("probe", {}).get("platform")
+            if platform not in ("cpu", None):
+                for child, t in (("cnn", 300), ("mfu", 300),
+                                 ("quant", 180), ("overlap_tpu", 240)):
+                    if not locked_do(child, t):
+                        break
+        return
 
     if args.wan:  # legacy single-benchmark mode: WAN codec numbers only
         wan, wan_err = _run_child("wan", timeout=300, env_extra=cpu_env)
@@ -988,14 +1156,26 @@ def main():
     cpu_thread.start()
 
     if not args.skip_tpu:
+        # evict a still-running watcher capture pass from the chip (wait
+        # up to 60 s; proceed regardless — contention is unlikely and
+        # a wedged watcher must not forfeit the round's live attempt)
+        bench_lock = _acquire_bench_lock(60)
+        if bench_lock is None:
+            with _lock:
+                _errors["bench_lock"] = ("proceeding without the chip "
+                                         "lock (holder did not yield "
+                                         "within 60s)")
         # two probe attempts with a short backoff: the r1 failure mode is
         # a *transient* tunnel flake at backend init, so one flake must
-        # not forfeit the round's TPU metrics; a genuinely dead tunnel
-        # still only costs ~2.5 min total before all TPU children skip
-        ok = _do("probe", 60)
-        if not ok and _remaining() > 120:
+        # not forfeit the round's TPU metrics.  Ceilings raised in r4:
+        # cold backend init has been observed to exceed 75 s (VERDICT
+        # r3), and a dead tunnel no longer forfeits the round's numbers
+        # anyway — the LKG cache covers it — so probing harder is cheap
+        # relative to what a live window is worth.
+        ok = _do("probe", 120)
+        if not ok and _remaining() > 180:
             time.sleep(15)
-            ok = _do("probe", 75)
+            ok = _do("probe", 120)
         platform = _results.get("probe", {}).get("platform")
         if ok and platform not in ("cpu", None):
             # tunnel alive: no retries/backoffs — the deadline governs
