@@ -797,14 +797,28 @@ def child_wan():
         g = np.abs(np.random.default_rng(1)
                    .standard_normal(N_FLAG)).astype(np.float32)
         base = sim.wan_bytes()["wan_send_bytes"]
-        t0 = time.perf_counter()
-        for w in ws:
-            w.push(0, g)
-        for w in ws:
-            w.pull_sync(0)
-            w.wait_all()
-        dt = time.perf_counter() - t0
-        sent = sim.wan_bytes()["wan_send_bytes"] - base
+
+        def one_round() -> float:
+            t0 = time.perf_counter()
+            for w in ws:
+                w.push(0, g)
+            for w in ws:
+                w.pull_sync(0)
+                w.wait_all()
+            return time.perf_counter() - t0
+
+        # round 1 is a different regime on both axes: it pays one-time
+        # costs (compressor tracked views, DGC velocity/accum
+        # allocation, first-touch store copies) and its pull is a DENSE
+        # resync (~1/ratio more WAN bytes than a steady top-k delta) —
+        # so it is excluded from BOTH the steady wall time and the
+        # steady bytes/step.  Steady state = best of two subsequent
+        # rounds (this single-core host is noisy under background load).
+        dt_cold = one_round()
+        steady_base = sim.wan_bytes()["wan_send_bytes"]
+        cold_sent = steady_base - base
+        dt = min(one_round(), one_round())
+        sent = (sim.wan_bytes()["wan_send_bytes"] - steady_base) / 2
         flagship = {
             "tensor_elems": N_FLAG,
             "global_servers": 3,
@@ -812,7 +826,9 @@ def child_wan():
             "wan_bytes_per_step": sent,
             "dense_bytes_would_be": 2 * 2 * N_FLAG * 4,  # 2 parties x p+p
             "reduction": round(2 * 2 * N_FLAG * 4 / max(sent, 1), 2),
+            "cold_round_bytes": cold_sent,  # incl. dense pull resync
             "round_wall_s": round(dt, 3),
+            "round_wall_s_cold": round(dt_cold, 3),
         }
     finally:
         sim.shutdown()

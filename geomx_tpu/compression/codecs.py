@@ -352,7 +352,10 @@ class BroadcastCompressor:
             self._view[(subscriber, key)] = w.copy()
             self._ver[(subscriber, key)] = new_ver
             return w, "f32", new_ver
-        delta = np.ascontiguousarray(weights.astype(np.float32) - base)
+        # asarray, not astype: weights is the (frozen) f32 store array in
+        # the hot path and astype would memcpy it before the subtract
+        delta = np.ascontiguousarray(
+            np.asarray(weights, np.float32) - base)
         k = max(1, int(len(delta) * self.ratio))
         nlib = _native()
         if nlib is not None:

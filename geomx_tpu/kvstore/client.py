@@ -111,7 +111,10 @@ class WorkerKVStore:
         for k, v in kvs.slices():
             p = parts[k]
             out[p.start:p.start + p.length] = v
-        return out.reshape(self._shapes[tid]).astype(self._dtypes[tid])
+        # the fill above is the user-isolation copy; copy=False keeps
+        # the f32 common case from paying a second full memcpy
+        return out.reshape(self._shapes[tid]).astype(
+            self._dtypes[tid], copy=False)
 
     def _track(self, ts: int):
         with self._mu:
@@ -258,7 +261,7 @@ class WorkerKVStore:
                 out = np.empty(size, dtype=np.float32)
                 for k, p in parts.items():
                     out[p.start:p.start + p.length] = self._ts_buf[k]
-            cb(tid, out.reshape(self._shapes[tid]).astype(self._dtypes[tid]))
+            cb(tid, out.reshape(self._shapes[tid]).astype(self._dtypes[tid], copy=False))
             return self.worker.customer.new_request(0)  # already complete
         keys = [p.ps_key for p in self.plan.parts(tid, size)]
         with self._mu:
@@ -371,7 +374,7 @@ class WorkerKVStore:
                     remaining[0] -= 1
                     done = remaining[0] == 0
                 if done:
-                    cb(tid, out.reshape(shape).astype(dtype))
+                    cb(tid, out.reshape(shape).astype(dtype, copy=False))
             return on_data
 
         tss = []

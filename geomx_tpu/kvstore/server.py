@@ -68,17 +68,41 @@ def _handle_profiler_cmd(po: Postoffice, msg: Message, server: KVServer):
     server.reply_cmd(msg, body=p.stats())
 
 
-def _f32_payload(arrs: List[np.ndarray]) -> np.ndarray:
-    """Build a pull-response payload with exactly ONE full copy.
+def _store_payload(arrs: List[np.ndarray]) -> np.ndarray:
+    """Serve stored weights by read-only alias instead of copying.
 
-    The copy is deliberate — responses must be isolated from the store
-    (in-proc delivery is zero-copy and the store is mutated in place by
-    BSC decode) — but ``astype`` + ``concatenate`` was TWO copies, which
-    at the 200 MB-tensor scale regime is ~0.4 s of pure memcpy per
-    response."""
-    if len(arrs) == 1:
-        return arrs[0].astype(np.float32)
+    In-proc delivery is by reference, so a response must never expose a
+    mutable view of live server state.  r3 isolated responses with a
+    full copy (~0.27 s per 200 MB response on this single-core host);
+    now the server FREEZES the stored array (``writeable=False``) and
+    ships it as-is.  The freeze is permanent: every in-place mutation
+    path (BSC pull decode is the only one) copies-on-write when it meets
+    a frozen array, so any number of in-flight responses may alias the
+    frozen buffer safely, and receivers may adopt a frozen payload as
+    their own replica without a copy (see ``Message.donated`` for the
+    ownership rules of *mutable* payloads)."""
+    if len(arrs) == 1 and arrs[0].dtype == np.float32:
+        arrs[0].flags.writeable = False  # freeze in place (idempotent)
+        return arrs[0]
+    # multi-key responses concatenate — the concat IS the isolation
+    # copy, so the source arrays stay writeable (freezing them here
+    # would buy nothing and force a COW copy on every later in-place
+    # decode of those keys)
     return np.concatenate([np.asarray(a, np.float32) for a in arrs])
+
+
+def _adopt_or_copy(v: np.ndarray, donated: bool) -> np.ndarray:
+    """First-push accumulator seed: adopt the wire buffer when the sender
+    transferred ownership (``Message.donated``) and it is mutable;
+    otherwise take the defensive copy — in-proc delivery is by reference,
+    so a non-donated payload may alias the sender's live data, and a
+    frozen payload is an immutability promise to OTHER aliases."""
+    acc = np.ascontiguousarray(v, dtype=np.float32)
+    if donated and acc.flags.writeable:
+        return acc
+    if np.may_share_memory(acc, v):
+        acc = acc.copy()  # never alias (or mutate) the wire buffer
+    return acc
 
 
 class _KeyState:
@@ -302,10 +326,7 @@ class LocalServer:
             for k, v in kvs.slices():
                 st = self._keys.setdefault(k, _KeyState())
                 if st.accum is None:
-                    acc = np.ascontiguousarray(v, dtype=np.float32)
-                    if np.may_share_memory(acc, v):
-                        acc = acc.copy()  # never alias the wire buffer
-                    st.accum = acc
+                    st.accum = _adopt_or_copy(v, msg.donated)
                 else:
                     # native threaded merge for big tensors (the server
                     # hot loop; ref: kvstore_dist_server.h:1277-1296)
@@ -456,8 +477,12 @@ class LocalServer:
                     if st.row_sparse:
                         rs_keys.add(k)
                         st.row_sparse = False  # describes this round only
+                # single-key rounds (the big-tensor regime) hand the
+                # accumulator over as-is — concatenate([one]) is a full
+                # copy (~0.27 s at 200 MB on this host)
                 return KVPairs(np.array(ks, dtype=np.int64),
-                               np.concatenate(vs), np.array(ls, dtype=np.int64))
+                               vs[0] if len(vs) == 1 else np.concatenate(vs),
+                               np.array(ls, dtype=np.int64))
 
             kvs_local = take(local_ks) if local_ks else None
             kvs_up = take(up_ks) if up_ks else None
@@ -601,12 +626,13 @@ class LocalServer:
         if use_piggyback:
             for tag, pairs in groups.items():
                 ks = np.array([k for k, _ in pairs], dtype=np.int64)
-                vals = np.concatenate([p for _, p in pairs])
+                vals = (pairs[0][1] if len(pairs) == 1
+                        else np.concatenate([p for _, p in pairs]))
                 lens = np.array([len(p) for _, p in pairs], dtype=np.int64)
                 self.up.push_pull(
                     KVPairs(ks, vals, lens), cmd=Cmd.DEFAULT,
                     cb=lambda kvs: self._on_pull_down(kvs, epochs),
-                    compr=tag, priority=prio,
+                    compr=tag, priority=prio, donated=True,
                     body=self._pull_echo([int(k) for k in ks]))
             return
 
@@ -622,11 +648,15 @@ class LocalServer:
 
         for tag, pairs in groups.items():
             ks = np.array([k for k, _ in pairs], dtype=np.int64)
-            vals = np.concatenate([p for _, p in pairs])
+            vals = (pairs[0][1] if len(pairs) == 1
+                    else np.concatenate([p for _, p in pairs]))
             lens = np.array([len(p) for _, p in pairs], dtype=np.int64)
+            # donated: every push-up payload is server-owned (the round's
+            # aggregation buffer, a codec output, or a fresh delta) and
+            # never touched again — the receiving tier may adopt it
             self.up.zpush(KVPairs(ks, vals, lens), cmd=Cmd.DEFAULT,
                           on_complete=one_group_acked, compr=tag,
-                          body=push_body, priority=prio)
+                          body=push_body, priority=prio, donated=True)
 
     def _push_up_hfa(self, kvs: KVPairs):
         """K2 round: ship (mean_weights - milestone)/num_global_workers
@@ -694,12 +724,22 @@ class LocalServer:
         if tag == "bsc":
             vals, idx = unpack_sparse(np.ascontiguousarray(v).view(np.float32))
             w = self.store[k]
+            if not w.flags.writeable:
+                # copy-on-write: the current replica is frozen (aliased
+                # by in-flight responses / adopted from upstream) — the
+                # delta must not mutate it under those readers
+                w = w.copy()
             w[idx] += vals
             return w
         if tag == "fp16":
             return np.ascontiguousarray(v).view(np.float16).astype(np.float32)
         if tag == "f32":
-            return np.ascontiguousarray(v).view(np.float32).copy()
+            arr = np.ascontiguousarray(v).view(np.float32)
+            # frozen payload = upstream's immutability promise: adopt the
+            # alias instead of copying (every local mutation path COWs)
+            return arr if not arr.flags.writeable else arr.copy()
+        if v.dtype == np.float32 and not v.flags.writeable:
+            return v
         return np.array(v, copy=True)
 
     def _on_pull_down(self, kvs: KVPairs, epochs: Optional[dict] = None):
@@ -813,7 +853,7 @@ class LocalServer:
         # the response so a replay re-serves values instead of re-merging
         self._recent.mark_done(req)
         self.server.response(req, KVPairs(
-            np.array(ks, dtype=np.int64), _f32_payload(vs),
+            np.array(ks, dtype=np.int64), _store_payload(vs),
             np.array(ls, dtype=np.int64)))
         return True
 
@@ -1056,7 +1096,8 @@ class GlobalServer:
                 orig = len(self.store[k])
                 dense = decompress_payload(msg.compr, k, payload, orig, thr)
                 ks.append(k); vs.append(dense); ls.append(orig)
-        return KVPairs(np.array(ks, dtype=np.int64), np.concatenate(vs),
+        return KVPairs(np.array(ks, dtype=np.int64),
+                       vs[0] if len(vs) == 1 else np.concatenate(vs),
                        np.array(ls, dtype=np.int64))
 
     # ---- sync tier ----------------------------------------------------------
@@ -1098,10 +1139,7 @@ class GlobalServer:
                 k = int(k)
                 st = self._keys.setdefault(k, _GlobalKeyState())
                 if st.accum is None:
-                    acc = np.ascontiguousarray(v, dtype=np.float32)
-                    if np.may_share_memory(acc, v):
-                        acc = acc.copy()  # never alias the wire buffer
-                    st.accum = acc
+                    st.accum = _adopt_or_copy(v, msg.donated)
                 else:
                     # native threaded merge for big tensors (the server
                     # hot loop; ref: kvstore_dist_server.h:1277-1296)
@@ -1278,7 +1316,7 @@ class GlobalServer:
             w = self.store[k]
             ks.append(k); vs.append(w); ls.append(len(w))
         self.server.response(req, KVPairs(
-            np.array(ks, dtype=np.int64), _f32_payload(vs),
+            np.array(ks, dtype=np.int64), _store_payload(vs),
             np.array(ls, dtype=np.int64)))
 
     def _respond_pull_compressed(self, req: Message):

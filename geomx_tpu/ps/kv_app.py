@@ -439,6 +439,11 @@ class KVWorker(_App):
     def _merge(parts: List[KVPairs]) -> KVPairs:
         """Sort-merge per-server responses by key (ref: kv_app.h pull
         aggregation sorts by key before the user callback)."""
+        if len(parts) == 1:
+            # single-server response: pass through as-is (already
+            # key-sorted by the server; concatenate would be a full
+            # payload copy — ~0.27 s at the 200 MB-tensor regime)
+            return parts[0]
         ks, vs, ls = [], [], []
         tags: dict = {}
         pv: dict = {}

@@ -226,5 +226,8 @@ class DgtReassembler:
             cmd=final.cmd, priority=final.priority, compr=final.compr,
             keys=final.keys, vals=vals, lens=final.lens,
             body=(final.body or {}).get("orig"),
+            # the reassembly buffer is freshly allocated and exclusively
+            # ours — the receiving server may adopt it as its accumulator
+            donated=True,
         )
         return out
