@@ -378,7 +378,11 @@ def _flash_exactness_check(attn_impl: str):
         from geomx_tpu.parallel.ring_attention import fast_dense_attention
 
         kq, kk, kv = jax.random.split(jax.random.PRNGKey(2), 3)
-        shp = (1, 512, 4, 128)  # [B, T, H, Dh]; Dh matches MFU_CFG
+        # validate at the SAME geometry the MFU child times — a flash
+        # bug specific to the timed seq length or head_dim must not
+        # pass the gate and then become the headline number (advisor r3)
+        shp = (1, MFU_CFG["max_seq"], MFU_CFG["n_heads"],
+               MFU_CFG["d_model"] // MFU_CFG["n_heads"])  # [B, T, H, Dh]
         q = jax.random.normal(kq, shp, jnp.bfloat16)
         k = jax.random.normal(kk, shp, jnp.bfloat16)
         v = jax.random.normal(kv, shp, jnp.bfloat16)

@@ -290,6 +290,9 @@ class Config:
     dgt_k: float = 0.5            # initial fraction on the reliable channel
     dgt_k_min: float = 0.2
     dgt_adaptive_k: bool = False
+    dgt_k_anneal_steps: int = 1000  # pushes over which adaptive k decays
+    #                                 k -> k_min (ref: ADAPTIVE_K_FLAG
+    #                                 anneals with iteration)
     dgt_udp_channels: int = 3
     dgt_contrib_alpha: float = 0.3
 
@@ -409,6 +412,7 @@ class Config:
             dgt_k=_env_float("GEOMX_DGT_K", _env_float("DMLC_K", 0.5)),
             dgt_k_min=_env_float("GEOMX_DGT_K_MIN", _env_float("DMLC_K_MIN", 0.2)),
             dgt_adaptive_k=_env_bool("GEOMX_DGT_ADAPTIVE", _env_bool("ADAPTIVE_K_FLAG")),
+            dgt_k_anneal_steps=_env_int("GEOMX_DGT_K_ANNEAL_STEPS", 1000),
             dgt_udp_channels=_env_int(
                 "GEOMX_DGT_CHANNELS", _env_int("DMLC_UDP_CHANNEL_NUM", 3)
             ),

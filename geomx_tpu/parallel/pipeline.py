@@ -14,7 +14,7 @@ Bubble fraction is the usual (pp−1)/(M+pp−1); pick M ≥ 4·pp in practice.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -179,6 +179,11 @@ def make_pp_apply(cfg, mesh: Mesh, n_microbatches: int,
     apply is the full pipelined train step."""
     from geomx_tpu.models.transformer import (
         _layer_forward, _rms_norm, _single_device_attention)
+
+    # same guard as init_pp_transformer: block() routes every layer
+    # through _layer_forward(idx=0), which silently applies dense FFN
+    # (and drops the aux loss) for a MoE config
+    assert cfg.moe_every == 0, "pp flagship pipelines homogeneous layers"
 
     def block(layer, x):
         return _layer_forward(

@@ -77,7 +77,7 @@ class EsyncState:
                 # legitimate and common (first-round jit compile, cache
                 # warmup) and only affect the reporting worker's own
                 # assignment, so they pass through unclamped
-                step_s = min(step_s, st["step_s"] * c)
+                step_s = min(step_s, max(st["step_s"], 1e-3) * c)
                 comm_s = min(comm_s, max(st["comm_s"], 1e-3) * c)
                 st["step_s"] += a * (step_s - st["step_s"])
                 st["comm_s"] += a * (comm_s - st["comm_s"])

@@ -28,6 +28,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
 
 from geomx_tpu.core.config import Config, NodeId
@@ -49,7 +50,10 @@ class TsScheduler:
         self.members = [str(m) for m in members]
         self.greed = greed_rate
         self.A: Dict[str, Dict[str, float]] = {}  # A[from][to] = throughput
-        self._served: Dict[str, set] = {}
+        # true LRU (recency = last ask touching the round), not
+        # insertion order: a long-running round kept alive by asks must
+        # not be evicted just because it STARTED first
+        self._served: "OrderedDict[str, set]" = OrderedDict()
         self._done: set = set()
         self._done_rounds: list = []
         self._mu = threading.Lock()
@@ -75,10 +79,10 @@ class TsScheduler:
                 if it not in self._served and len(self._served) > 1000:
                     # rounds abandoned mid-flight (relay timeout, dead
                     # member) never reach the no-candidates branch — bound
-                    # the map by evicting the oldest stalled round
-                    oldest = next(iter(self._served))
-                    del self._served[oldest]
+                    # the map by evicting the least-recently-asked round
+                    self._served.popitem(last=False)
                 served = self._served.setdefault(it, set())
+                self._served.move_to_end(it)  # refresh recency
                 candidates = [m for m in self.members
                               if m not in served and m != sender]
                 if not candidates:

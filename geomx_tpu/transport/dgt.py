@@ -67,6 +67,7 @@ class DgtSender:
         self.k = config.dgt_k
         self.k_min = config.dgt_k_min
         self.adaptive = config.dgt_adaptive_k
+        self.k_anneal_steps = config.dgt_k_anneal_steps
         self.channels = max(1, config.dgt_udp_channels)
         self.alpha = config.dgt_contrib_alpha
         self.mode = config.enable_dgt
@@ -75,10 +76,11 @@ class DgtSender:
 
     def current_k(self) -> float:
         """Adaptive k decays from k to k_min over training
-        (ref: ADAPTIVE_K_FLAG; the reference anneals with iteration)."""
+        (ref: ADAPTIVE_K_FLAG; the reference anneals with iteration).
+        The horizon is ``dgt_k_anneal_steps`` (GEOMX_DGT_K_ANNEAL_STEPS)."""
         if not self.adaptive:
             return self.k
-        t = min(1.0, self._steps / 1000.0)
+        t = min(1.0, self._steps / max(1, self.k_anneal_steps))
         return self.k + (self.k_min - self.k) * t
 
     def split(self, msg: Message) -> List[Message]:
