@@ -68,7 +68,7 @@ sys.path.insert(0, str(ROOT))
 # deliberately NOT cached: it measures tunnel liveness *now*; replaying
 # it would misreport a dead tunnel as alive.
 TPU_LKG_PATH = ROOT / "TPU_LKG.json"
-TPU_CHILDREN = ("cnn", "mfu", "quant", "overlap_tpu")
+TPU_CHILDREN = ("cnn", "mfu", "quant", "overlap_tpu", "flash_autotune")
 # serializes chip access between the round's live bench and the
 # background watcher's capture passes (both are this script)
 BENCH_FLOCK_PATH = ROOT / ".bench.lock"
@@ -487,6 +487,88 @@ def child_mfu_sweep():
             rows.append({"config": name,
                          "error": f"{type(e).__name__}: {e}"[:200]})
         print(json.dumps({"sweep": rows}), flush=True)
+
+
+def child_flash_autotune():
+    """On-chip tile autotune for the pallas ring-flash kernel
+    (ops/block_attention): time bq candidates at the kernel's REAL
+    production geometry — ring hops of max_seq/sp tokens (the kernel's
+    only caller is ring_attention fast="flash"; the single-device MFU
+    path uses jax's library kernel) — validate each hop's winner against
+    the einsum reference, and report the best ``GEOMX_FLASH_BLOCK_Q``
+    per hop size.  TPU-only (scheduled when the probe passes; results
+    persist via the LKG cache)."""
+    import jax
+    import jax.numpy as jnp
+
+    from geomx_tpu.ops.block_attention import (
+        _block_attn_ref, flash_block_attention)
+
+    B, H = 2, MFU_CFG["n_heads"]
+    D = MFU_CFG["d_model"] // MFU_CFG["n_heads"]
+    reps = 16
+    hops = {}
+    for sp in (4, 8):  # flagship sp mesh sizes; hop block = max_seq/sp
+        T = MFU_CFG["max_seq"] // sp
+        ks = jax.random.split(jax.random.PRNGKey(sp), 3)
+        q = jax.random.normal(ks[0], (B, T, H, D), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (B, T, H, D), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (B, T, H, D), jnp.bfloat16)
+        offs = jnp.array([T, 0], jnp.int32)  # below-diagonal hop (no mask)
+        rows = []
+        for bq in (512, 256, 128, 64):
+            if bq > T or T % bq:
+                continue
+            os.environ["GEOMX_FLASH_BLOCK_Q"] = str(bq)
+
+            @jax.jit
+            def run(q, k, v):
+                # thread the carry through the output so XLA cannot
+                # hoist the loop-invariant kernel out of the scan
+                def body(c, _):
+                    _m, _l, o = flash_block_attention(q, k, v, offs, True)
+                    return c + o[0, 0, 0, 0], None
+                c, _ = jax.lax.scan(body, jnp.float32(0), None, length=reps)
+                return c
+
+            try:
+                _ = float(run(q, k, v))  # compile + warmup
+                best = float("inf")
+                for _i in range(3):
+                    t0 = time.perf_counter()
+                    _ = float(run(q, k, v))
+                    best = min(best, time.perf_counter() - t0)
+                rows.append({"block_q": bq,
+                             "ms_per_call": round(best / reps * 1e3, 3)})
+            except Exception as e:  # noqa: BLE001 — keep sweeping
+                rows.append({"block_q": bq,
+                             "error": f"{type(e).__name__}: {e}"[:160]})
+        timed = [r for r in rows if "ms_per_call" in r]
+        if not timed:
+            hops[f"hop_{T}"] = {"rows": rows, "error": "none compiled"}
+            continue
+        winner = min(timed, key=lambda r: r["ms_per_call"])
+        os.environ["GEOMX_FLASH_BLOCK_Q"] = str(winner["block_q"])
+        _m, _l, o = flash_block_attention(q, k, v, offs, True)
+        _rm, _rl, ro = _block_attn_ref(q, k, v, offs, True)
+        err = float(jnp.max(jnp.abs(o - ro)))
+        if not err < 5e-2:  # bf16 tolerance, unit inputs
+            raise AssertionError(
+                f"hop {T} winner bq={winner['block_q']} exactness failed: "
+                f"max abs diff {err}")
+        hops[f"hop_{T}"] = {
+            "best_block_q": winner["block_q"],
+            "rows": rows,
+            "winner_max_abs_err_vs_ref": round(err, 5),
+        }
+    if not any("best_block_q" in h for h in hops.values()):
+        raise RuntimeError(f"no hop produced a winner: {hops}")
+    print(json.dumps({
+        "hops": hops,
+        "geometry": (f"B{B} H{H} D{D} bf16, ring hops of "
+                     f"max_seq/sp for sp in (4, 8)"),
+        "platform": jax.devices()[0].platform,
+    }))
 
 
 QUANT_MB = 64
@@ -945,6 +1027,7 @@ def _build_record() -> dict:
     for key, name in (("mfu", "mfu"), ("quantize", "quant"),
                       ("wan", "wan"), ("overlap", "overlap"),
                       ("overlap_tpu", "overlap_tpu"),
+                      ("flash_autotune", "flash_autotune"),
                       ("stress", "stress"), ("probe", "probe")):
         if name in _results:
             record[key] = _results[name]
@@ -1087,7 +1170,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--child",
                     choices=["cnn", "mfu", "mfu_sweep", "quant", "wan",
-                             "overlap", "overlap_tpu", "stress", "probe"])
+                             "overlap", "overlap_tpu", "stress", "probe",
+                             "flash_autotune"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -1110,7 +1194,8 @@ def main():
         {"cnn": child_cnn, "mfu": child_mfu, "mfu_sweep": child_mfu_sweep,
          "quant": child_quant, "wan": child_wan, "overlap": child_overlap,
          "overlap_tpu": child_overlap_tpu, "stress": child_stress,
-         "probe": child_probe}[args.child]()
+         "probe": child_probe,
+         "flash_autotune": child_flash_autotune}[args.child]()
         return
 
     signal.signal(signal.SIGTERM, _on_term)
@@ -1146,7 +1231,8 @@ def main():
             platform = _results.get("probe", {}).get("platform")
             if platform not in ("cpu", None):
                 for child, t in (("cnn", 300), ("mfu", 300),
-                                 ("quant", 180), ("overlap_tpu", 240)):
+                                 ("quant", 180), ("overlap_tpu", 240),
+                                 ("flash_autotune", 240)):
                     if not locked_do(child, t):
                         break
         return
@@ -1203,6 +1289,7 @@ def main():
             _do("mfu", 300)
             _do("quant", 180)
             _do("overlap_tpu", 240)
+            _do("flash_autotune", 240)
         else:
             with _lock:
                 _errors["tpu"] = (

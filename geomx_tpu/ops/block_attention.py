@@ -94,6 +94,22 @@ def _kernel(offs_ref, q_ref, k_ref, v_ref, m_ref, l_ref, o_ref, *,
 
 
 def _pick_bq(Tq: int) -> int:
+    """Q-block rows per grid step.  Default ladder prefers the largest
+    tile that divides Tq; ``GEOMX_FLASH_BLOCK_Q`` (set from the on-chip
+    autotune child, bench.py --child flash_autotune) overrides when it
+    divides Tq — tile choice is a pure performance knob, semantics are
+    offset-driven and identical for every bq."""
+    import os
+
+    override = os.environ.get("GEOMX_FLASH_BLOCK_Q")
+    if override:
+        try:
+            bq = int(override)
+        except ValueError:
+            bq = 0  # malformed value: fall through to the ladder —
+            #         never kill a training step over a perf knob
+        if 0 < bq <= Tq and Tq % bq == 0:
+            return bq
     for cand in (256, 128, 64, 32, 16, 8):
         if Tq % cand == 0:
             return min(cand, Tq)
