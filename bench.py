@@ -523,13 +523,15 @@ def child_flash_autotune():
 
             @jax.jit
             def run(q, k, v):
-                # thread the carry through the output so XLA cannot
-                # hoist the loop-invariant kernel out of the scan
-                def body(c, _):
-                    _m, _l, o = flash_block_attention(q, k, v, offs, True)
-                    return c + o[0, 0, 0, 0], None
-                c, _ = jax.lax.scan(body, jnp.float32(0), None, length=reps)
-                return c
+                # feed the kernel's output back into its own input so
+                # every iteration is genuinely data-dependent — a mere
+                # scalar carry would leave the kernel loop-invariant
+                # and free for XLA to hoist out of the scan
+                def body(qc, _):
+                    _m, _l, o = flash_block_attention(qc, k, v, offs, True)
+                    return qc + (1e-6 * o).astype(qc.dtype), None
+                qf, _ = jax.lax.scan(body, q, None, length=reps)
+                return qf[0, 0, 0, 0]
 
             try:
                 _ = float(run(q, k, v))  # compile + warmup

@@ -216,6 +216,22 @@ def _configure_worker(po, kv, args):
     kv.barrier()
 
 
+def _test_step_sleep_s(node) -> float:
+    """Per-node artificial per-step delay for acceptance runs that need
+    deterministic heterogeneity (the ESync matrix): env
+    ``GEOMX_TEST_STEP_SLEEP_MS='{"worker:1@p0": 60}'`` keyed by the
+    node's ``str()`` form (``role:rank@party``)."""
+    import json
+
+    raw = os.environ.get("GEOMX_TEST_STEP_SLEEP_MS")
+    if not raw:
+        return 0.0
+    try:
+        return float(json.loads(raw).get(str(node), 0)) / 1000.0
+    except (ValueError, AttributeError, TypeError):
+        return 0.0
+
+
 def _worker_demo(po, kv, args):
     """The reference demo workload (examples/cnn.py) for launcher smoke
     runs: tiny CNN on synthetic data."""
@@ -238,6 +254,61 @@ def _worker_demo(po, kv, args):
     kv.barrier()
     if kv.party == 0 and kv.rank == 0:
         time.sleep(0.5)  # let sibling parties drain their last rounds
+        shutdown_cluster(po)
+
+
+def _worker_demo_esync(po, kv, args):
+    """ESync acceptance workload: the esync client loop with optional
+    injected per-step heterogeneity, printing the per-round (assigned
+    steps, reach-server seconds) pairs the matrix asserts on."""
+    import jax
+    import numpy as np
+
+    from geomx_tpu.data import ShardedIterator, synthetic_classification
+    from geomx_tpu.models import create_cnn_state
+    from geomx_tpu.training import run_worker_esync
+
+    x, y = synthetic_classification(n=2048, shape=(12, 12, 1), seed=0)
+    _, params, grad_fn = create_cnn_state(
+        jax.random.PRNGKey(0), input_shape=(1, 12, 12, 1))
+    sleep_s = _test_step_sleep_s(po.node)
+    if sleep_s > 0:
+        inner = grad_fn
+
+        def grad_fn(p, xb, yb):  # noqa: F811 — deliberate wrap
+            time.sleep(sleep_s)
+            return inner(p, xb, yb)
+
+    widx = kv.party * kv.num_workers + kv.rank
+    _configure_worker(po, kv, args)
+    # ShardedIterator samples with replacement — never runs dry, which
+    # the esync loop needs (rounds x up-to-max_local_steps batches)
+    it = ShardedIterator(x, y, args.batch, widx, kv.num_all_workers)
+    # warm up ALL the jit compiles (grad + optimizer update) OUTSIDE the
+    # measured loop: round 0's step time seeds the planner's EWMA, and a
+    # multi-second compile spike would make every worker look equally
+    # slow for the whole short acceptance run
+    import optax
+
+    opt = optax.adam(1e-2)
+    xb, yb = next(iter(it))
+    _loss, _acc, g = grad_fn(params, xb, yb)
+    upd, _ = opt.update(g, opt.init(params), params)
+    optax.apply_updates(params, upd)  # discarded — warmup only
+    rounds_info: list = []
+    hist = run_worker_esync(kv, params, grad_fn, it, args.steps,
+                            optimizer=opt, barrier_init=True,
+                            max_local_steps=16, rounds_out=rounds_info)
+    # steps= counts SYNC rounds (the --steps contract); local steps vary
+    # per worker by design — that variance is the feature
+    print(f"{po.node}: steps={len(rounds_info)} "
+          f"first_loss={hist[0][0]:.4f} "
+          f"last_loss={hist[-1][0]:.4f} local_steps={len(hist)}",
+          flush=True)
+    print(f"{po.node}: esync_rounds={rounds_info!r}", flush=True)
+    kv.barrier()
+    if kv.party == 0 and kv.rank == 0:
+        time.sleep(0.5)
         shutdown_cluster(po)
 
 
@@ -313,6 +384,9 @@ def main(argv=None):
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--compression", default="none")
     ap.add_argument("--hfa", action="store_true")
+    ap.add_argument("--esync", action="store_true",
+                    help="straggler-balancing local steps (HFA-mode "
+                         "servers + per-round step assignment)")
     ap.add_argument("--p3", action="store_true")
     ap.add_argument("--tsengine", action="store_true")
     ap.add_argument("--tsengine-inter", action="store_true")
@@ -344,7 +418,9 @@ def main(argv=None):
                             num_global_servers=args.global_servers,
                             central_worker=central)
     cfg.compression = args.compression
-    cfg.use_hfa = args.hfa or cfg.use_hfa
+    # ESync exchanges weights like HFA — servers must run in HFA mode
+    # (ref: examples/cnn.py wires --esync the same way)
+    cfg.use_hfa = args.hfa or args.esync or cfg.use_hfa
     cfg.enable_p3 = args.p3 or cfg.enable_p3
     cfg.enable_intra_ts = args.tsengine or cfg.enable_intra_ts
     cfg.enable_inter_ts = (args.tsengine_inter or args.tsengine_inter_push
@@ -366,7 +442,9 @@ def main(argv=None):
                                           advertise=advertise)
     print(f"{node}: up", flush=True)
     if node.role is Role.WORKER:
-        if cfg.enable_p3:
+        if args.esync:
+            _worker_demo_esync(po, role_obj, args)
+        elif cfg.enable_p3:
             # P3 deployments train through the staged overlap loop —
             # that IS the feature (priority-scheduled per-stage rounds)
             _worker_demo_staged(po, role_obj, args)
@@ -408,6 +486,22 @@ def main(argv=None):
     if pc is not None and getattr(pc, "bsc_picks", 0) + getattr(
             pc, "fp16_picks", 0) > 0:
         feats.append(f"mpq_bsc={pc.bsc_picks} mpq_fp16={pc.fp16_picks}")
+    # DGT mode-3 observable: 4-bit requant chunks sent/decoded (the
+    # KVWorker apps hold the sender; every app holds a reassembler)
+    dgt4_tx = dgt4_rx = 0
+    for app in (getattr(role_obj, "worker", None),
+                getattr(role_obj, "up", None),
+                getattr(role_obj, "server", None)):
+        if app is None:
+            continue
+        s = getattr(app, "dgt_sender", None)
+        if s is not None:
+            dgt4_tx += getattr(s, "dgt4_chunks", 0)
+        r = getattr(app, "_dgt_reasm", None)
+        if r is not None:
+            dgt4_rx += getattr(r, "dgt4_decoded", 0)
+    if dgt4_tx or dgt4_rx:
+        feats.append(f"dgt4_tx={dgt4_tx} dgt4_rx={dgt4_rx}")
     if po.van.pq_overtakes:
         feats.append(f"pq_overtakes={po.van.pq_overtakes}")
     if feats:

@@ -149,6 +149,7 @@ def run_worker_esync(
     params_out: Optional[dict] = None,
     max_local_steps: int = 64,
     measure=None,
+    rounds_out: Optional[list] = None,
 ) -> List[Tuple[float, float]]:
     """ESync client loop (geomx_tpu.sched.esync; ref README.md:45 — the
     reference's planned-but-unintegrated straggler balancer, ESync
@@ -212,6 +213,11 @@ def run_worker_esync(
         params, comm_s = _hfa_sync_round(kv, params, treedef, len(leaves),
                                          buf, n, m, measure_comm=True)
         m.step_end()
+        if rounds_out is not None:
+            # acceptance observable: (assigned local steps, reach-server
+            # seconds) per round — heterogeneous workers must receive
+            # different assignments and their reach spread must shrink
+            rounds_out.append((ran, round(step_s * ran + comm_s, 4)))
         if ran > 0:
             # a dry data iterator (ran == 0) must not report: its
             # near-zero "step time" would make the planner believe this

@@ -73,6 +73,7 @@ class DgtSender:
         self.mode = config.enable_dgt
         self._contrib: Dict[Tuple[int, int], float] = {}
         self._steps = 0
+        self.dgt4_chunks = 0  # mode-3 observable: 4-bit requant count
 
     def current_k(self) -> float:
         """Adaptive k decays from k to k_min over training
@@ -128,6 +129,7 @@ class DgtSender:
                 packed, lo, hi = quant4(blk)
                 chunk_body = {"_dgt4": {"n": len(blk), "lo": lo, "hi": hi}}
                 blk = packed
+                self.dgt4_chunks += 1
             chunk = Message(
                 sender=msg.sender, recipient=msg.recipient, domain=msg.domain,
                 app_id=msg.app_id, customer_id=msg.customer_id,
@@ -171,6 +173,7 @@ class DgtReassembler:
 
         self._buf: Dict[tuple, dict] = {}
         self._mu = threading.Lock()
+        self.dgt4_decoded = 0  # mode-3 observable: 4-bit chunks decoded
         # finalized-round tombstones: stragglers (late retransmits of
         # reliable chunks) must not recreate buffer entries
         self._done = set()
@@ -218,6 +221,7 @@ class DgtReassembler:
                 dec = dequant4(chunk.vals, meta4["n"], meta4["lo"],
                                meta4["hi"])
                 vals[off:off + len(dec)] = dec
+                self.dgt4_decoded += 1
             else:
                 vals[off:off + len(chunk.vals)] = chunk.vals
         out = Message(

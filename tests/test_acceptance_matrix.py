@@ -14,8 +14,14 @@ fired*, not just that training finished:
   (``hfa_gated_key_rounds=``)
 - MPQ       → the size split sent big tensors BSC and small ones FP16
   (``mpq_bsc=``/``mpq_fp16=``)
+- ESync     → heterogeneous workers received *different* local-step
+  assignments and the reach-server spread shrank (``esync_rounds=``)
+- DGT mode 3 → unimportant chunks were 4-bit requantized on the wire and
+  decoded on the far tier (``dgt4_tx=``/``dgt4_rx=``)
 
-DGT and vanilla topologies are covered the same way in test_tcp.py.
+DGT mode 1 (real lossy UDP) and vanilla topologies are covered the same
+way in test_tcp.py; mid-run SIGKILL + relaunch of the global server is
+test_recovery.py::test_global_server_crash_restart_midtraining_resumes_checkpoint.
 """
 
 import os
@@ -113,6 +119,55 @@ def test_hfa_topology_k2_gating():
         1, 1, ["--hfa"], extra_env={"GEOMX_HFA_K2": "2"}, steps=4)
     gated = _stat(outputs, r"hfa_gated_key_rounds=(\d+)")
     assert gated > 0, f"K2 gate never fired: {outputs}"
+
+
+@pytest.mark.slow
+def test_esync_topology_heterogeneous_assignments():
+    """ref: README.md:45 (ESync, planned-but-unintegrated upstream) —
+    one party, two workers, rank 1 slowed 60 ms/step.  The state server
+    must hand the fast worker MORE local steps than the slow one, and
+    the party's reach-server spread must shrink once the planner has
+    samples."""
+    _topo, outputs = _launch_matrix(
+        1, 2, ["--esync"], steps=6,
+        extra_env={"GEOMX_TEST_STEP_SLEEP_MS": '{"worker:1@p0": 60}'})
+    rounds = {}  # node -> [(assigned_steps, reach_s), ...]
+    for node, out in outputs.items():
+        m = re.search(r"esync_rounds=(\[.*\])", out)
+        if m:
+            rounds[node] = eval(m.group(1))  # noqa: S307 — our own repr
+    assert set(rounds) == {"worker:0@p0", "worker:1@p0"}, outputs
+    fast, slow = rounds["worker:0@p0"], rounds["worker:1@p0"]
+    # the planner hands the fast worker MORE local steps than the slow
+    # one over the planned tail (round 0 runs before any samples exist)
+    fast_steps = sum(r[0] for r in fast[1:])
+    slow_steps = sum(r[0] for r in slow[1:])
+    assert fast_steps > slow_steps, (fast, slow)
+    # reach-server spread shrinks: in the last round the two workers
+    # reach the server within 2x of each other even though their
+    # PER-STEP times differ by far more — i.e. the fast worker's extra
+    # local steps absorbed the heterogeneity instead of barrier idling.
+    # (Absolute |fast-slow| of round 0 is useless as a baseline: both
+    # pay one-off jit compile there.)
+    f_ran, f_reach = fast[-1]
+    s_ran, s_reach = slow[-1]
+    per_step_ratio = (s_reach / max(s_ran, 1)) / max(
+        f_reach / max(f_ran, 1), 1e-9)
+    reach_ratio = max(f_reach, s_reach) / max(min(f_reach, s_reach), 1e-9)
+    assert per_step_ratio > 2.0, (fast, slow)   # heterogeneity was real
+    assert reach_ratio < 2.0, (fast, slow)      # ...and got balanced
+
+
+@pytest.mark.slow
+def test_dgt_mode3_topology_4bit_requant():
+    """ref: scripts/cpu/run_dgt.sh + ENABLE_DGT=3 (van.cc:750-824 TCP +
+    4-bit requant) — unimportant WAN chunks must actually ride the wire
+    4-bit-requantized and be decoded on the global tier."""
+    _topo, outputs = _launch_matrix(1, 1, ["--dgt", "3"])
+    tx = _stat(outputs, r"dgt4_tx=(\d+)")
+    rx = _stat(outputs, r"dgt4_rx=(\d+)")
+    assert tx > 0, f"no chunk was 4-bit requantized: {outputs}"
+    assert rx > 0, f"no 4-bit chunk was decoded: {outputs}"
 
 
 @pytest.mark.slow

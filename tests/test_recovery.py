@@ -262,7 +262,7 @@ def test_global_server_replacement_at_new_address(tmp_path):
 
 
 @pytest.mark.slow
-def test_global_server_crash_restart_midtraining(tmp_path):
+def test_global_server_crash_restart_midtraining_resumes_checkpoint(tmp_path):
     """Full multiprocess topology over TCP: SIGKILL the global server
     mid-training, relaunch it, and the workers still finish all steps
     (retry replays the in-flight round; the restart resumes from the
@@ -317,6 +317,9 @@ def test_global_server_crash_restart_midtraining(tmp_path):
             outputs[r] = p.communicate()[0]
         worker_out = outputs[str(topo.workers(0)[0])]
         assert "steps=25" in worker_out, worker_out[-2000:]
+        # the mechanism, not just the outcome: the relaunched tier-2
+        # process must have restored from the auto-checkpoint
+        assert "resumed from" in outputs[gs_role], outputs[gs_role][-2000:]
         for r, p in procs.items():
             assert p.returncode == 0, f"{r} rc={p.returncode}: {outputs[r][-800:]}"
     finally:
