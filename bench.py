@@ -774,6 +774,81 @@ def child_overlap_tpu():
     }))
 
 
+def child_lm():
+    """Flagship LM through the two-tier stack (VERDICT r3 item 5): the
+    same >=10 M-param transformer + MPQ the TCP acceptance test trains
+    (tests/test_acceptance_matrix.py::test_lm_flagship_tcp_topology),
+    in-proc for bench stability; reports tokens/s (steady: compile step
+    excluded) and WAN bytes/step."""
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.data import TokenIterator
+    from geomx_tpu.kvstore import Simulation
+    from geomx_tpu.training import build_flagship_lm, run_worker
+
+    cfg, params, n_params, grad_fn, data = build_flagship_lm()
+    batch, steps = 4, 3
+    sim = Simulation(Config(
+        topology=Topology(num_parties=2, workers_per_party=1),
+        compression="mpq"))
+    try:
+        ws = sim.all_workers()
+        ws[0].set_optimizer({"type": "adam", "lr": 1e-3})
+        for p in range(2):
+            sim.worker(p, 0).set_gradient_compression({"type": "mpq"})
+        hists = {}
+
+        def phase(n_steps):
+            errs = []
+
+            def one(widx):
+                try:
+                    kv = ws[widx]
+                    it = TokenIterator(data, batch, widx, len(ws))
+                    hists[widx] = run_worker(kv, params, grad_fn, it,
+                                             n_steps, barrier_init=False)
+                except Exception as e:  # noqa: BLE001 — re-raised below
+                    errs.append((widx, e))
+
+            ths = [threading.Thread(target=one, args=(i,), daemon=True)
+                   for i in range(len(ws))]
+            t0 = time.perf_counter()
+            for t in ths:
+                t.start()
+            # bounded join: one dead worker must not hang the other
+            # party's FSA merge for the child's whole timeout budget
+            deadline = time.monotonic() + 150
+            for t in ths:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if errs:
+                raise RuntimeError(f"lm worker(s) failed: {errs!r}")
+            if any(t.is_alive() for t in ths):
+                raise RuntimeError("lm phase deadlocked (150s)")
+            return time.perf_counter() - t0
+
+        # phase 1 pays the one-offs: INIT broadcast of the full model
+        # (~n_params*4 bytes on the WAN), jit compile, MPQ tracked-view
+        # setup.  Phase 2 is the steady state — its WAN delta and wall
+        # are what every subsequent training step sees.
+        warm_wall = phase(1)
+        base = sim.wan_bytes()["wan_send_bytes"]
+        steady_wall = phase(steps)
+        sent = sim.wan_bytes()["wan_send_bytes"] - base
+        print(json.dumps({
+            "n_params": n_params,
+            "model": (f"transformer d{cfg.d_model} L{cfg.n_layers} "
+                      f"ff{cfg.d_ff} seq{cfg.max_seq} batch{batch}"),
+            "topology": "2 parties x 1 worker, MPQ",
+            "tokens_per_sec_steady": round(
+                batch * cfg.max_seq * steps * len(ws) / steady_wall, 1),
+            "warmup_step_wall_s": round(warm_wall, 3),
+            "wan_bytes_per_step": round(sent / steps, 1),
+            "dense_wan_bytes_would_be": 2 * 2 * n_params * 4,
+            "last_loss": round(float(hists[0][-1][0]), 4),
+        }))
+    finally:
+        sim.shutdown()
+
+
 def child_stress():
     """Server merge throughput at scale (VERDICT r1 item 5): one party of
     4 workers pushing a 50M-element tensor (200 MB) through the two-tier
@@ -1030,7 +1105,8 @@ def _build_record() -> dict:
                       ("wan", "wan"), ("overlap", "overlap"),
                       ("overlap_tpu", "overlap_tpu"),
                       ("flash_autotune", "flash_autotune"),
-                      ("stress", "stress"), ("probe", "probe")):
+                      ("stress", "stress"), ("lm", "lm"),
+                      ("probe", "probe")):
         if name in _results:
             record[key] = _results[name]
         elif name in TPU_CHILDREN and name in lkg:
@@ -1173,7 +1249,7 @@ def main():
     ap.add_argument("--child",
                     choices=["cnn", "mfu", "mfu_sweep", "quant", "wan",
                              "overlap", "overlap_tpu", "stress", "probe",
-                             "flash_autotune"])
+                             "flash_autotune", "lm"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -1196,7 +1272,7 @@ def main():
         {"cnn": child_cnn, "mfu": child_mfu, "mfu_sweep": child_mfu_sweep,
          "quant": child_quant, "wan": child_wan, "overlap": child_overlap,
          "overlap_tpu": child_overlap_tpu, "stress": child_stress,
-         "probe": child_probe,
+         "probe": child_probe, "lm": child_lm,
          "flash_autotune": child_flash_autotune}[args.child]()
         return
 
@@ -1256,9 +1332,12 @@ def main():
 
     # CPU children on their own thread: a slow tunnel can't starve them
     def cpu_chain():
+        # flagship metrics first: under a tight driver deadline the tail
+        # children are the ones clipped
         _do("wan", 240, cpu_env)
+        _do("lm", 240, cpu_env)
+        _do("stress", 240, cpu_env)
         _do("overlap", 180, cpu_env)
-        _do("stress", 300, cpu_env)
 
     cpu_thread = threading.Thread(target=cpu_chain, daemon=True)
     cpu_thread.start()

@@ -257,6 +257,41 @@ def _worker_demo(po, kv, args):
         shutdown_cluster(po)
 
 
+def _worker_demo_lm(po, kv, args):
+    """Flagship LM workload over the real topology (VERDICT r3 item 5):
+    the transformer from models/transformer.py at a non-toy size
+    (>=10 M params) trained through the two-tier kvstore, printing
+    tokens/s and parameter count.  Size via GEOMX_LM_* env overrides."""
+    from geomx_tpu.data import TokenIterator
+    from geomx_tpu.training import build_flagship_lm, run_worker
+
+    cfg, params, n_params, grad_fn, data = build_flagship_lm()
+    widx = kv.party * kv.num_workers + kv.rank
+    _configure_worker(po, kv, args)
+    it = TokenIterator(data, args.batch, widx, kv.num_all_workers)
+    stamps = []
+
+    def log(step, _l, _a):
+        stamps.append(time.perf_counter())
+
+    hist = run_worker(kv, params, grad_fn, it, args.steps,
+                      barrier_init=True, log_fn=log)
+    # steady tokens/s excludes the first step (jit compile + INIT
+    # broadcast dominate it; bench.py's lm child splits the same way)
+    if len(stamps) > 1:
+        steady = (args.batch * cfg.max_seq * (len(stamps) - 1)
+                  / max(stamps[-1] - stamps[0], 1e-9))
+    else:
+        steady = float("nan")
+    print(f"{po.node}: steps={len(hist)} first_loss={hist[0][0]:.4f} "
+          f"last_loss={hist[-1][0]:.4f} n_params={n_params} "
+          f"tokens_per_sec={steady:.1f}", flush=True)
+    kv.barrier()
+    if kv.party == 0 and kv.rank == 0:
+        time.sleep(0.5)
+        shutdown_cluster(po)
+
+
 def _worker_demo_esync(po, kv, args):
     """ESync acceptance workload: the esync client loop with optional
     injected per-step heterogeneity, printing the per-round (assigned
@@ -382,6 +417,9 @@ def main(argv=None):
                          "instead of the static plan's slot")
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--workload", default="cnn", choices=["cnn", "lm"],
+                    help="worker demo: the reference CNN or the flagship "
+                         "transformer LM (>=10M params, GEOMX_LM_* sized)")
     ap.add_argument("--compression", default="none")
     ap.add_argument("--hfa", action="store_true")
     ap.add_argument("--esync", action="store_true",
@@ -401,6 +439,11 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if not args.role:
         ap.error("--role or GEOMX_ROLE required")
+    if args.esync and args.workload == "lm":
+        # --esync forces HFA-mode servers (weight averaging); the lm
+        # workload pushes GRADIENTS — dispatching it against HFA servers
+        # would silently train garbage
+        ap.error("--workload lm and --esync are mutually exclusive")
 
     from geomx_tpu.core.platform import apply_platform_from_env
 
@@ -442,7 +485,9 @@ def main(argv=None):
                                           advertise=advertise)
     print(f"{node}: up", flush=True)
     if node.role is Role.WORKER:
-        if args.esync:
+        if args.workload == "lm":
+            _worker_demo_lm(po, role_obj, args)
+        elif args.esync:
             _worker_demo_esync(po, role_obj, args)
         elif cfg.enable_p3:
             # P3 deployments train through the staged overlap loop —
@@ -502,6 +547,10 @@ def main(argv=None):
             dgt4_rx += getattr(r, "dgt4_decoded", 0)
     if dgt4_tx or dgt4_rx:
         feats.append(f"dgt4_tx={dgt4_tx} dgt4_rx={dgt4_rx}")
+    # WAN traffic observable (ref: send_bytes_/recv_bytes_ van.h:180-181)
+    if po.van.wan_send_bytes or po.van.wan_recv_bytes:
+        feats.append(f"wan_tx={po.van.wan_send_bytes} "
+                     f"wan_rx={po.van.wan_recv_bytes}")
     if po.van.pq_overtakes:
         feats.append(f"pq_overtakes={po.van.pq_overtakes}")
     if feats:

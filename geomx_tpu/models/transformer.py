@@ -358,3 +358,24 @@ def lm_loss_with_aux(apply_fn, params, tokens, aux_coef: float = 0.01):
     ``make_apply(..., return_aux=True)``."""
     logits, aux = apply_fn(params, tokens)
     return token_cross_entropy(logits, tokens) + aux_coef * aux
+
+
+def make_lm_grad_fn(cfg: "TransformerConfig"):
+    """Jitted ``grad_fn(params, x, y) -> (loss, acc, grads)`` with the
+    worker-loop signature (``training.run_worker``); y is ignored (the
+    LM objective shifts x).  Shared by the launcher's LM workload and
+    the bench's lm child so they train the identical step."""
+    apply_fn = make_apply(cfg)
+
+    @jax.jit
+    def grad_fn(p, x, _y):
+        def loss_fn(p):
+            logits = apply_fn(p, x)
+            loss = token_cross_entropy(logits, x)
+            acc = jnp.mean(jnp.argmax(logits[:, :-1], axis=-1) == x[:, 1:])
+            return loss, acc
+
+        (loss, acc), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        return loss, acc, g
+
+    return grad_fn

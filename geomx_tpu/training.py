@@ -137,6 +137,41 @@ def _hfa_sync_round(kv, params, treedef, n_leaves, buf, n, m,
     return unflatten_params(treedef, buf), comm_s
 
 
+def build_flagship_lm(batch_hint: int = 4):
+    """One shared builder for the flagship LM workload (>=10 M params)
+    so the TCP acceptance run (launch.py --workload lm) and the bench's
+    lm child train the IDENTICAL step — a size tweak applied to one
+    cannot silently diverge the other.  Size via GEOMX_LM_* env.
+    Returns ``(cfg, params, n_params, grad_fn, data)``."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from geomx_tpu.data import synthetic_lm
+    from geomx_tpu.models.transformer import (
+        TransformerConfig, init_params, make_lm_grad_fn)
+
+    def _e(name, dflt):
+        return int(os.environ.get(name, dflt))
+
+    cfg = TransformerConfig(
+        vocab=_e("GEOMX_LM_VOCAB", 8192),
+        d_model=_e("GEOMX_LM_DMODEL", 384),
+        n_heads=_e("GEOMX_LM_HEADS", 6),
+        n_layers=_e("GEOMX_LM_LAYERS", 4),
+        d_ff=_e("GEOMX_LM_DFF", 1536),
+        max_seq=_e("GEOMX_LM_SEQ", 128),
+        attn_impl="fast",
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    grad_fn = make_lm_grad_fn(cfg)
+    data = synthetic_lm(n=512, seq=cfg.max_seq, vocab=cfg.vocab, seed=0)
+    return cfg, params, n_params, grad_fn, data
+
+
 def run_worker_esync(
     kv: WorkerKVStore,
     params,

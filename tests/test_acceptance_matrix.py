@@ -171,6 +171,25 @@ def test_dgt_mode3_topology_4bit_requant():
 
 
 @pytest.mark.slow
+def test_lm_flagship_tcp_topology():
+    """VERDICT r3 item 5: the flagship transformer (>=10 M params)
+    through the real-process TCP topology with MPQ compression —
+    tokens/s reported, WAN bytes accounted, the size split active."""
+    _topo, outputs = _launch_matrix(
+        1, 1, ["--workload", "lm", "--compression", "mpq", "--batch", "4"],
+        steps=3, timeout=420)
+    worker_out = outputs["worker:0@p0"]
+    m = re.search(r"n_params=(\d+)", worker_out)
+    assert m and int(m.group(1)) >= 10_000_000, worker_out
+    assert re.search(r"tokens_per_sec=[\d.]+", worker_out), worker_out
+    # MPQ actually split (big tensors BSC, small fp16) on the WAN hop
+    assert _stat(outputs, r"mpq_bsc=(\d+)") > 0, outputs
+    assert _stat(outputs, r"mpq_fp16=(\d+)") > 0, outputs
+    # and the WAN ledger recorded the compressed traffic
+    assert _stat(outputs, r"wan_tx=(\d+)") > 0, outputs
+
+
+@pytest.mark.slow
 def test_mpq_topology_size_split():
     """ref: scripts/cpu/run_mpq.sh — tensors >= the size bound must go
     BSC while small ones go FP16.  The launcher's demo CNN is tiny, so
