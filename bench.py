@@ -796,6 +796,7 @@ def child_lm():
         for p in range(2):
             sim.worker(p, 0).set_gradient_compression({"type": "mpq"})
         hists = {}
+        cur_params = {i: params for i in range(len(ws))}
 
         def phase(n_steps):
             errs = []
@@ -804,8 +805,16 @@ def child_lm():
                 try:
                     kv = ws[widx]
                     it = TokenIterator(data, batch, widx, len(ws))
-                    hists[widx] = run_worker(kv, params, grad_fn, it,
-                                             n_steps, barrier_init=False)
+                    out = {}
+                    hists[widx] = run_worker(kv, cur_params[widx], grad_fn,
+                                             it, n_steps,
+                                             barrier_init=False,
+                                             params_out=out)
+                    # phase 2 must CONTINUE from phase 1's params — a
+                    # restart from the initial point would push a stale
+                    # gradient against the servers' trained state and
+                    # re-INIT the full model inside the timed window
+                    cur_params[widx] = out["params"]
                 except Exception as e:  # noqa: BLE001 — re-raised below
                     errs.append((widx, e))
 
@@ -847,6 +856,202 @@ def child_lm():
         }))
     finally:
         sim.shutdown()
+
+
+# inner script for the measured weak-scaling points: one process per
+# device count (xla_force_host_platform_device_count is fixed at backend
+# init).  Fixed PER-DEVICE work (batch 1/device), real XLA collectives.
+_SCALING_INNER = r"""
+import json, time
+from geomx_tpu.core.platform import apply_platform_from_env
+apply_platform_from_env()
+import jax, jax.numpy as jnp, numpy as np, optax, functools
+from geomx_tpu.models.transformer import (
+    TransformerConfig, init_params, make_apply, lm_loss)
+from geomx_tpu.parallel import make_mesh
+
+n = len(jax.devices())
+mesh = make_mesh({"dp": n, "sp": 1, "tp": 1})
+cfg = TransformerConfig(vocab=256, d_model=64, n_heads=4, n_layers=2,
+                        d_ff=128, max_seq=32, attn_impl="fast")
+params = init_params(cfg, jax.random.PRNGKey(0))
+apply_fn = make_apply(cfg, mesh=mesh)
+tx = optax.sgd(1e-3)
+opt = tx.init(params)
+tokens = jax.random.randint(jax.random.PRNGKey(1), (n, cfg.max_seq), 0,
+                            cfg.vocab, jnp.int32)  # batch 1 per device
+from jax.sharding import NamedSharding, PartitionSpec as P
+tokens = jax.device_put(tokens, NamedSharding(mesh, P("dp", None)))
+
+def step(carry, _):
+    p, s = carry
+    loss, g = jax.value_and_grad(lambda p_: lm_loss(apply_fn, p_, tokens))(p)
+    u, s = tx.update(g, s, p)
+    return (optax.apply_updates(p, u), s), loss
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def run(p, s):
+    (p, s), losses = jax.lax.scan(step, (p, s), None, length=4)
+    return p, s, losses[-1]
+
+t0 = time.perf_counter()
+params, opt, loss = run(params, opt)
+_ = float(loss)
+compile_s = time.perf_counter() - t0
+best = float("inf")
+for _ in range(2):
+    t0 = time.perf_counter()
+    params, opt, loss = run(params, opt)
+    _ = float(loss)
+    best = min(best, time.perf_counter() - t0)
+print(json.dumps({"devices": n, "compile_s": round(compile_s, 2),
+                  "step_wall_s": round(best / 4, 4),
+                  "loss_finite": bool(jnp.isfinite(loss))}))
+"""
+
+
+def child_scaling():
+    """Scaling-efficiency artifact (BASELINE.md metric #3; VERDICT r3
+    item 3).  Two explicitly-labeled halves:
+
+    - **measured**: weak-scaling points on 8/16/32 *virtual CPU*
+      devices — real GSPMD partitioning + XLA collectives, fixed
+      per-device work.  On this single-core host all virtual devices
+      share one core, so wall times prove the sharded program compiles
+      and stays numerically sane as the mesh grows; they are NOT chip
+      throughput.
+    - **modeled**: an ICI/DCN roofline for the HiPS topology (8-chip
+      v5e slice per party, parties over WAN), calibrated by measured
+      inputs where they exist: the lm child's WAN ledger
+      (BENCH_LM_WAN_BYTES_PER_STEP, passed by the orchestrator) and the
+      LKG-cached on-chip MFU.  Every other constant is a stated
+      assumption in the output.
+    """
+    from geomx_tpu.training import build_flagship_lm
+
+    measured = []
+    for n in (8, 16, 32):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_PLATFORM_NAME"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + f" --xla_force_host_platform_device_count={n}"
+                            ).strip()
+        try:
+            # 80 s per point: 3 points must fit the orchestrator's 300 s
+            # child budget WITH the modeled half — one slow compile must
+            # cost its point, not the whole scaling artifact
+            out = subprocess.run(
+                [sys.executable, "-c", _SCALING_INNER], env=env,
+                capture_output=True, text=True, timeout=80, cwd=ROOT)
+            row = json.loads(out.stdout.strip().splitlines()[-1])
+        except (subprocess.SubprocessError, ValueError, IndexError) as e:
+            row = {"devices": n, "error": f"{type(e).__name__}: {e}"[:160]}
+        measured.append(row)
+
+    # ---- modeled 8 -> 256-chip curve -----------------------------------
+    cfg, _params, n_params, _g, _d = build_flagship_lm()
+    batch_per_chip = 32
+    cfg_d = dict(vocab=cfg.vocab, d_model=cfg.d_model, n_heads=cfg.n_heads,
+                 n_layers=cfg.n_layers, d_ff=cfg.d_ff, max_seq=cfg.max_seq)
+    flops_chip, _n = _transformer_train_flops_per_step(
+        cfg_d, batch_per_chip, cfg.max_seq)
+
+    # measured calibration inputs (fall back to stated assumptions)
+    lkg_mfu, _at = (_load_lkg().get("mfu") or {}).get("result", {}), None
+    mfu = lkg_mfu.get("mfu")
+    mfu_src = "measured (LKG on-chip)" if mfu else "assumed"
+    mfu = mfu or 0.30
+    wan_env = os.environ.get("BENCH_LM_WAN_BYTES_PER_STEP")
+    if wan_env:
+        # lm child ledger: total WAN send bytes/step for 2 parties,
+        # push+pull -> per-party per-direction
+        wan_party_dir = float(wan_env) / 4.0
+        wan_src = "measured (lm child WAN ledger, MPQ)"
+    else:
+        # analytic MPQ: big tensors BSC (2 * ratio * (4B val + 4B idx))
+        # + small fp16; approximate all-big at ratio 0.01 with 2x cap
+        wan_party_dir = n_params * 0.02 * 8
+        wan_src = "analytic (BSC ratio 0.01, 2x cap)"
+
+    CHIPS_PER_PARTY = 8          # one v5e-8 slice per data center
+    V5E_ICI_BW = 100e9           # B/s effective allreduce BW per chip
+    DCN_BW = 1.25e9              # 10 Gbps inter-DC WAN per party
+    grad_bytes = n_params * 2    # bf16 grads on ICI
+
+    def t_step(chips, compressed=True, overlap=True, k2=1):
+        """Per-round wall.  ``k2``: HFA gate — the WAN hop fires every
+        k2-th round (ref MXNET_KVSTORE_USE_HFA/K2), amortizing t_dcn."""
+        parties = max(1, chips // CHIPS_PER_PARTY)
+        s = min(chips, CHIPS_PER_PARTY)
+        t_comp = flops_chip / (mfu * V5E_PEAK_BF16)
+        t_ici = 2 * grad_bytes * (s - 1) / s / V5E_ICI_BW
+        b_dir = wan_party_dir if compressed else n_params * 4
+        # each party's WAN link runs in parallel; MultiGPS shards the
+        # global tier so its ingress scales with the party count and
+        # never becomes the bottleneck term here
+        t_dcn = (2 * b_dir / DCN_BW if parties > 1 else 0.0) / k2
+        if overlap:  # P3 staged overlap hides comm behind compute
+            return max(t_comp, t_ici + t_dcn)
+        return t_comp + t_ici + t_dcn
+
+    # four cumulative feature tiers — the framework's WAN features are
+    # exactly what keeps weak-scaling efficiency up once parties > 1
+    tiers = {
+        "dense_bsp": dict(compressed=False, overlap=False, k2=1),
+        "mpq": dict(compressed=True, overlap=False, k2=1),
+        "mpq_p3_overlap": dict(compressed=True, overlap=True, k2=1),
+        "mpq_p3_hfa_k2_8": dict(compressed=True, overlap=True, k2=8),
+    }
+    curve = []
+    for chips in (8, 16, 32, 64, 128, 256):
+        row = {"chips": chips, "parties": max(1, chips // CHIPS_PER_PARTY)}
+        for name, kw in tiers.items():
+            row[f"efficiency_{name}"] = round(
+                t_step(8, **kw) / t_step(chips, **kw), 4)
+        curve.append(row)
+    # the reference's headline comparison (README.md:12 "up to 20x vs
+    # vanilla MXNet PS"): full WAN feature stack vs dense BSP at scale
+    full_vs_vanilla = round(
+        t_step(256, compressed=False, overlap=False, k2=1)
+        / t_step(256, **tiers["mpq_p3_hfa_k2_8"]), 2)
+
+    print(json.dumps({
+        "measured_virtual_mesh": {
+            "points": measured,
+            "semantics": ("real GSPMD sharding + XLA collectives on "
+                          "virtual CPU devices sharing ONE core: proves "
+                          "the sharded step compiles/runs at each mesh "
+                          "size, NOT chip throughput"),
+        },
+        "modeled_roofline": {
+            "workload": (f"flagship LM {n_params / 1e6:.1f}M params, "
+                         f"batch {batch_per_chip}/chip seq {cfg.max_seq}, "
+                         "weak scaling"),
+            "topology": f"{CHIPS_PER_PARTY}-chip v5e slice per party "
+                        "(ICI psum) + HiPS WAN tier (MPQ) per party",
+            "curve": curve,
+            "full_stack_vs_dense_bsp_speedup_at_256": full_vs_vanilla,
+            "reference_claim": "up to 20x vs vanilla PS "
+                               "(reference README.md:12)",
+            "calibration": {
+                "mfu": {"value": mfu, "source": mfu_src},
+                "wan_bytes_party_per_dir": {
+                    "value": round(wan_party_dir, 1), "source": wan_src},
+            },
+            "assumptions": {
+                "ici_allreduce_bw_per_chip_Bps": V5E_ICI_BW,
+                "dcn_bw_per_party_Bps": DCN_BW,
+                "v5e_peak_bf16_flops": V5E_PEAK_BF16,
+                "overlap": "P3 staged overlap hides comm behind compute "
+                           "(max instead of sum; sim-measured 1.4x, see "
+                           "overlap child)",
+            },
+            "semantics": "MODELED, not measured — roofline with the "
+                         "stated assumptions; measured inputs only where "
+                         "labeled",
+        },
+    }))
 
 
 def child_stress():
@@ -1106,7 +1311,7 @@ def _build_record() -> dict:
                       ("overlap_tpu", "overlap_tpu"),
                       ("flash_autotune", "flash_autotune"),
                       ("stress", "stress"), ("lm", "lm"),
-                      ("probe", "probe")):
+                      ("scaling", "scaling"), ("probe", "probe")):
         if name in _results:
             record[key] = _results[name]
         elif name in TPU_CHILDREN and name in lkg:
@@ -1249,7 +1454,7 @@ def main():
     ap.add_argument("--child",
                     choices=["cnn", "mfu", "mfu_sweep", "quant", "wan",
                              "overlap", "overlap_tpu", "stress", "probe",
-                             "flash_autotune", "lm"])
+                             "flash_autotune", "lm", "scaling"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -1272,7 +1477,7 @@ def main():
         {"cnn": child_cnn, "mfu": child_mfu, "mfu_sweep": child_mfu_sweep,
          "quant": child_quant, "wan": child_wan, "overlap": child_overlap,
          "overlap_tpu": child_overlap_tpu, "stress": child_stress,
-         "probe": child_probe, "lm": child_lm,
+         "probe": child_probe, "lm": child_lm, "scaling": child_scaling,
          "flash_autotune": child_flash_autotune}[args.child]()
         return
 
@@ -1336,6 +1541,13 @@ def main():
         # children are the ones clipped
         _do("wan", 240, cpu_env)
         _do("lm", 240, cpu_env)
+        # scaling's roofline is calibrated by the lm child's measured
+        # WAN ledger when available
+        scaling_env = dict(cpu_env)
+        lm_wan = _results.get("lm", {}).get("wan_bytes_per_step")
+        if lm_wan:
+            scaling_env["BENCH_LM_WAN_BYTES_PER_STEP"] = str(lm_wan)
+        _do("scaling", 300, scaling_env)
         _do("stress", 240, cpu_env)
         _do("overlap", 180, cpu_env)
 

@@ -439,11 +439,11 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if not args.role:
         ap.error("--role or GEOMX_ROLE required")
-    if args.esync and args.workload == "lm":
-        # --esync forces HFA-mode servers (weight averaging); the lm
-        # workload pushes GRADIENTS — dispatching it against HFA servers
-        # would silently train garbage
-        ap.error("--workload lm and --esync are mutually exclusive")
+    if (args.esync or args.hfa) and args.workload == "lm":
+        # --esync/--hfa force HFA-mode servers (weight averaging); the
+        # lm workload pushes GRADIENTS — dispatching it against HFA
+        # servers would silently train garbage
+        ap.error("--workload lm is mutually exclusive with --esync/--hfa")
 
     from geomx_tpu.core.platform import apply_platform_from_env
 

@@ -137,7 +137,7 @@ def _hfa_sync_round(kv, params, treedef, n_leaves, buf, n, m,
     return unflatten_params(treedef, buf), comm_s
 
 
-def build_flagship_lm(batch_hint: int = 4):
+def build_flagship_lm():
     """One shared builder for the flagship LM workload (>=10 M params)
     so the TCP acceptance run (launch.py --workload lm) and the bench's
     lm child train the IDENTICAL step — a size tweak applied to one
