@@ -25,7 +25,7 @@ from geomx_tpu.kvstore.common import APP_PS, Cmd, Ctrl
 from geomx_tpu.kvstore.keys import KeyPlan
 from geomx_tpu.ps import KVPairs, KVWorker, Postoffice
 from geomx_tpu.ps.postoffice import split_range
-from geomx_tpu.transport.message import Domain
+from geomx_tpu.transport.message import Control, Domain, Message
 
 
 class WorkerKVStore:
@@ -78,6 +78,8 @@ class WorkerKVStore:
         self._pending: List[int] = []
         self._last_push_ts: Dict[int, int] = {}
         self._mu = threading.Lock()
+        # dynamic membership: track the server's join/leave broadcasts
+        postoffice.add_control_hook(self._membership_hook)
 
     # ---- helpers ------------------------------------------------------------
     def _encode(self, tid: int, flat: np.ndarray, priority: int = 0) -> KVPairs:
@@ -189,6 +191,89 @@ class WorkerKVStore:
         self.ts_client.send_reply(msg.sender, it)
         self.ts_client.disseminate_async(msg.keys, msg.vals, msg.lens, it,
                                          Cmd.TS_AUTOPULL)
+
+    def _membership_hook(self, msg) -> bool:
+        """Persistent hook: the party server broadcasts the new
+        aggregation size on every join/leave; the per-step gradient
+        pre-scale (1/num_workers) must track it or post-join updates
+        stop being a mean."""
+        if (msg.control is Control.ADD_NODE and not msg.request
+                and isinstance(msg.body, dict)
+                and msg.body.get("event") == "membership"):
+            self.num_workers = int(msg.body["num_workers"])
+            return True
+        return False
+
+    def _addnode_rpc(self, body: dict, timeout: float) -> dict:
+        """One ADD_NODE request/reply round trip to the party server.
+        The reply hook is one-shot AND unregistered on exit — a stale
+        armed hook would swallow the reply meant for a later call."""
+        cv = threading.Condition()
+        reply: dict = {}
+
+        def hook(msg) -> bool:
+            if (msg.control is Control.ADD_NODE and not msg.request
+                    and not (isinstance(msg.body, dict)
+                             and "event" in msg.body)):
+                with cv:
+                    if "body" in reply:
+                        return False
+                    reply["body"] = msg.body or {}
+                    cv.notify_all()
+                return True
+            return False
+
+        self.po.add_control_hook(hook)
+        try:
+            self.po.van.send(Message(
+                recipient=self.po.topology.server(self.party),
+                control=Control.ADD_NODE, domain=Domain.LOCAL,
+                request=True, body=body))
+            with cv:
+                if not cv.wait_for(lambda: "body" in reply,
+                                   timeout=timeout):
+                    raise TimeoutError(
+                        f"{self.po.node}: ADD_NODE rpc timed out")
+        finally:
+            self.po.remove_control_hook(hook)
+        b = reply["body"]
+        if "error" in b:
+            raise RuntimeError(f"ADD_NODE rejected: {b['error']}")
+        return b
+
+    def join_party(self, timeout: float = 30.0,
+                   advertise: Optional[tuple] = None) -> dict:
+        """Register this worker with its party server MID-TRAINING
+        (ref: the runtime id assignment of ProcessAddNodeCommandAtScheduler
+        van.cc:41-112; here the party server owns the count — see
+        LocalServer._on_add_node).  The server folds this worker into
+        each key's aggregation count at that key's next fresh round (and
+        raises mid-flight rounds' targets, so push BEFORE the first pull
+        — a pull parked behind a round that waits for our push would
+        deadlock).  Idempotent server-side: retrying after a timeout
+        re-uses the assigned rank instead of double-counting.
+
+        The caller must initialize its own model replica (``init`` of
+        existing keys is a no-op server-side).  ``advertise``: (host,
+        port) for TCP deployments so peers can dial the out-of-plan
+        slot.  Returns the server's reply ({"rank", "num_workers"}).
+        Raises on an unsupported configuration (intra-TS / HFA)."""
+        body = {"node": str(self.po.node)}
+        if advertise is not None:
+            body["host"], body["port"] = advertise[0], int(advertise[1])
+        b = self._addnode_rpc(body, timeout)
+        self.num_workers = int(b["num_workers"])
+        return b
+
+    def leave_party(self, timeout: float = 30.0) -> dict:
+        """Gracefully leave the aggregation group (the inverse of
+        ``join_party``): call AFTER ``wait_all()`` — the server lowers
+        its per-round target at the boundary, and any round this worker
+        had not yet reached completes without it.  Leaving without this
+        call stalls every subsequent FSA round forever.  Idempotent
+        server-side (a replayed leave does not double-decrement)."""
+        return self._addnode_rpc(
+            {"action": "leave", "node": str(self.po.node)}, timeout)
 
     def push(self, tid: int, grad: np.ndarray, priority: int = 0,
              num_merge: int = 1, _count_round: bool = True) -> int:

@@ -40,7 +40,7 @@ from geomx_tpu.native.bindings import accumulate as _native_accumulate
 from geomx_tpu.optim import DCASGD, ServerOptimizer, Sgd, make_optimizer
 from geomx_tpu.ps import KVPairs, KVServer, KVWorker, Postoffice
 from geomx_tpu.ps.postoffice import split_range
-from geomx_tpu.transport.message import Domain, Message
+from geomx_tpu.transport.message import Control, Domain, Message
 
 
 def _handle_profiler_cmd(po: Postoffice, msg: Message, server: KVServer):
@@ -109,7 +109,7 @@ class _KeyState:
     """Per-ps-key aggregation state on the local server."""
 
     __slots__ = ("accum", "count", "parked_pulls", "in_flight", "version",
-                 "round", "row_sparse", "epoch", "priority")
+                 "round", "row_sparse", "epoch", "priority", "expected")
 
     def __init__(self):
         self.accum: Optional[np.ndarray] = None
@@ -127,6 +127,9 @@ class _KeyState:
         self.epoch = 0           # bumped by overwrite-inits: a pull-down
         #                          from before the bump must not clobber
         #                          the restored value of THIS key
+        self.expected = None     # workers this key's CURRENT round waits
+        #                          for; seeded from the server's join-
+        #                          adjusted target at each fresh round
         self.priority = 0        # P3: workers' push priority, inherited by
         #                          this key's WAN push-up and pull-down so
         #                          shallow layers outrank deep ones on the
@@ -144,6 +147,20 @@ class LocalServer:
         self.config = config or postoffice.config
         topo = postoffice.topology
         self.num_workers = topo.workers_per_party
+        # dynamic worker join (ref: ADD_NODE van.cc:41-112 — the
+        # reference's scheduler assigns ids at runtime; our addressing is
+        # plan-based, so the party SERVER owns rank assignment and the
+        # aggregation count).  ``_workers_target`` is adopted per key at
+        # the next fresh aggregation round (_KeyState.expected), never
+        # mid-round.
+        self._join_next_rank = topo.workers_per_party
+        self._workers_target = self.num_workers
+        self._members: Dict[str, int] = {}  # joined node str -> rank
+        #                                     (idempotency: a replayed
+        #                                     join/leave must not move
+        #                                     the count twice)
+        self.joined_workers = 0  # observability
+        self.left_workers = 0
         self.store: Dict[int, np.ndarray] = {}
         self._keys: Dict[int, _KeyState] = {}
         self._mu = threading.RLock()
@@ -153,6 +170,7 @@ class LocalServer:
         self._recent = RecentRequests()  # replayed-push dedup
         self.server = KVServer(APP_PS, 0, postoffice, self._handle)
         self.server.cmd_handler = self._on_cmd
+        postoffice.add_control_hook(self._on_add_node)
         # the "global worker" half (ref: kvstore_dist_server.h uses the
         # server's own KVWorker toward tier 2)
         self.up = KVWorker(
@@ -304,6 +322,112 @@ class LocalServer:
             self._recent.mark_done(msg)
             self.server.response(msg)
 
+    def _on_add_node(self, msg: Message) -> bool:
+        """Dynamic worker join (ref: ProcessAddNodeCommandAtScheduler
+        van.cc:41-112).  A new worker registers mid-training; the server
+        assigns the next free rank and raises the aggregation target,
+        which every key adopts at its NEXT fresh round — never
+        mid-aggregation.  Not supported together with the intra-party TS
+        overlay (its scheduler's member set is fixed at construction)."""
+        if msg.control is not Control.ADD_NODE or not msg.request:
+            return False
+        body = msg.body or {}
+        node_s = str(body.get("node", msg.sender))
+        if body.get("action") == "leave":
+            # graceful leave (the inverse fold): the worker promises no
+            # further pushes.  Mid-flight rounds get their target
+            # lowered; ones already satisfied complete NOW — they would
+            # otherwise stall forever waiting for the leaver.  Honest
+            # caveat: counting has no per-worker attribution, so if the
+            # leaver HAD contributed to a mid-flight round, one later
+            # push leaks into the next round (one stale gradient, the
+            # same staleness class the async tier tolerates).
+            with self._mu:
+                if node_s not in self._members:
+                    # replayed leave (or never-joined): idempotent no-op
+                    total = self._workers_target
+                    completed = []
+                else:
+                    del self._members[node_s]
+                    self._workers_target = max(1, self._workers_target - 1)
+                    self.left_workers += 1
+                    total = self._workers_target
+                    completed = []
+                    for k, st in self._keys.items():
+                        if st.accum is not None and st.expected:
+                            st.expected = max(1, st.expected - 1)
+                            if st.count >= st.expected:
+                                completed.append(k)
+                if completed:
+                    # complete UNDER the lock (RLock re-entry): dropping
+                    # it first races a concurrent push completing the
+                    # same key, and a double _round_complete crashes on
+                    # the already-taken accumulator
+                    self._round_complete(completed)
+            self._broadcast_membership(total)
+            self.po.van.send(msg.reply_to(control=Control.ADD_NODE, body={
+                "num_workers": total}))
+            return True
+        if self.ts_client is not None or self.hfa_enabled:
+            self.po.van.send(msg.reply_to(control=Control.ADD_NODE, body={
+                "error": "dynamic join unsupported with intra-party TS "
+                         "or HFA (fixed member sets / weight-mean "
+                         "normalization)"}))
+            return True
+        with self._mu:
+            if node_s in self._members:
+                # replayed join (client retry after a lost reply): same
+                # rank, no double count
+                rank = self._members[node_s]
+                total = self._workers_target
+            else:
+                rank = self._join_next_rank
+                self._join_next_rank += 1
+                self._workers_target += 1
+                self._members[node_s] = rank
+                total = self._workers_target
+                self.joined_workers += 1
+                # mid-flight rounds must ALSO wait for the joiner: its
+                # first pushes land in whatever round is open, and with
+                # the old target a static worker's push would complete
+                # the round early and leak a contribution forward
+                for st in self._keys.values():
+                    if st.accum is not None and st.expected:
+                        st.expected += 1
+        # TCP deployments announce the joiner's bind address alongside;
+        # add_address inserts the OUT-OF-PLAN slot (update_address alone
+        # would ignore it as a stale broadcast)
+        if "host" in body and "node" in body:
+            fab = self.po.van.fabric
+            add = getattr(fab, "add_address",
+                          getattr(fab, "update_address", None))
+            if add is not None:
+                add(body["node"], (body["host"], int(body["port"])))
+        self._broadcast_membership(total)
+        self.po.van.send(msg.reply_to(control=Control.ADD_NODE, body={
+            "rank": rank, "num_workers": total}))
+        return True
+
+    def _broadcast_membership(self, total: int):
+        """Tell every party worker the new aggregation size — their
+        1/num_workers gradient pre-scale must track membership or the
+        post-join update stops being a mean (static plan workers +
+        joined members)."""
+        targets = {str(w): w for w in self.po.topology.workers(
+            self.po.node.party)}
+        with self._mu:
+            extra = list(self._members)
+        for n in extra:
+            targets.setdefault(n, NodeId.parse(n))
+        for n in targets.values():
+            try:
+                self.po.van.send(Message(
+                    recipient=n, control=Control.ADD_NODE,
+                    domain=Domain.LOCAL, request=False,
+                    body={"event": "membership", "num_workers": total}))
+            except (KeyError, OSError):
+                pass  # a down/unknown worker learns on its next join
+
     def _handle_push(self, msg: Message, kvs: KVPairs):
         state = self._recent.check(msg)
         if state == "pending":
@@ -327,6 +451,8 @@ class LocalServer:
                 st = self._keys.setdefault(k, _KeyState())
                 if st.accum is None:
                     st.accum = _adopt_or_copy(v, msg.donated)
+                    # fold joins in at the round boundary
+                    st.expected = self._workers_target
                 else:
                     # native threaded merge for big tensors (the server
                     # hot loop; ref: kvstore_dist_server.h:1277-1296)
@@ -335,7 +461,7 @@ class LocalServer:
                         self.config.server_merge_threads)
                 st.count += num_merge
                 st.priority = msg.priority
-                if st.count >= self.num_workers:
+                if st.count >= (st.expected or self.num_workers):
                     completed.append(k)
         if not self.sync_mode:
             # async local tier: no rounds — clear the aggregation state
@@ -414,10 +540,11 @@ class LocalServer:
             st = self._keys.setdefault(key, _KeyState())
             if st.accum is None:
                 st.accum = np.zeros_like(self.store[key], dtype=np.float32)
+                st.expected = self._workers_target
             np.add.at(st.accum.reshape(-1, cols), row_ids, rows)
             st.count += 1
             st.row_sparse = True
-            if st.count >= self.num_workers:
+            if st.count >= (st.expected or self.num_workers):
                 completed.append(key)
         self._recent.mark_done(msg)
         self.server.response(msg)

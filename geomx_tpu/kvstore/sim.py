@@ -80,6 +80,24 @@ class Simulation:
     def worker(self, party: int, rank: int) -> WorkerKVStore:
         return self.workers[str(NodeId.parse(f"worker:{rank}@p{party}"))]
 
+    def add_worker(self, party: int) -> WorkerKVStore:
+        """Dynamically join a NEW worker to a running party (ref:
+        ADD_NODE van.cc:41-112): stand up its postoffice on the live
+        fabric, register with the party server, and return the client.
+        The server folds it into each key's count at the next fresh
+        round; the caller still has to init/pull its replica and start
+        pushing (see WorkerKVStore.join_party)."""
+        rank = sum(1 for w in self.workers.values()
+                   if w.party == party)
+        n = NodeId.parse(f"worker:{rank}@p{party}")
+        po = Postoffice(n, self.topology, self.fabric, self.config)
+        po.start()
+        self.offices[str(n)] = po
+        kv = WorkerKVStore(po, self.config)
+        kv.join_party()
+        self.workers[str(n)] = kv
+        return kv
+
     def all_workers(self) -> List[WorkerKVStore]:
         return [self.workers[str(w)] for w in self.topology.all_workers()]
 

@@ -232,9 +232,12 @@ def _test_step_sleep_s(node) -> float:
         return 0.0
 
 
-def _worker_demo(po, kv, args):
+def _worker_demo(po, kv, args, join_advertise=None):
     """The reference demo workload (examples/cnn.py) for launcher smoke
-    runs: tiny CNN on synthetic data."""
+    runs: tiny CNN on synthetic data.  ``join_advertise``: this worker
+    is an out-of-plan DYNAMIC JOINER — register with the party server
+    before training, leave gracefully after, and stay out of the
+    cluster's barriers (the static plan doesn't count us)."""
     import jax
     import numpy as np
 
@@ -242,13 +245,30 @@ def _worker_demo(po, kv, args):
     from geomx_tpu.models import create_cnn_state
     from geomx_tpu.training import run_worker
 
+    joining = join_advertise is not None or args.join
     x, y = synthetic_classification(n=512, shape=(12, 12, 1), seed=0)
     _, params, grad_fn = create_cnn_state(
         jax.random.PRNGKey(0), input_shape=(1, 12, 12, 1))
-    widx = kv.party * kv.num_workers + kv.rank
-    _configure_worker(po, kv, args)
-    it = ShardedIterator(x, y, args.batch, widx, kv.num_all_workers)
-    hist = run_worker(kv, params, grad_fn, it, args.steps, barrier_init=True)
+    if joining:
+        info = kv.join_party(advertise=join_advertise)
+        print(f"{po.node}: joined as rank {info['rank']} "
+              f"(num_workers={info['num_workers']})", flush=True)
+        # shard by the POST-join party size: the static plan's indexing
+        # would alias another worker's shard (widx past num_all_workers
+        # wraps into a subset of worker 0's slice)
+        widx, num_all = int(info["rank"]), int(info["num_workers"])
+    else:
+        _configure_worker(po, kv, args)
+        widx, num_all = kv.party * kv.num_workers + kv.rank, \
+            kv.num_all_workers
+    it = ShardedIterator(x, y, args.batch, widx, num_all)
+    hist = run_worker(kv, params, grad_fn, it, args.steps,
+                      barrier_init=not joining)
+    if joining:
+        kv.wait_all()
+        kv.leave_party()
+        print(f"{po.node}: steps={len(hist)} left cleanly", flush=True)
+        return
     print(f"{po.node}: steps={len(hist)} first_loss={hist[0][0]:.4f} "
           f"last_loss={hist[-1][0]:.4f}", flush=True)
     kv.barrier()
@@ -420,6 +440,11 @@ def main(argv=None):
     ap.add_argument("--workload", default="cnn", choices=["cnn", "lm"],
                     help="worker demo: the reference CNN or the flagship "
                          "transformer LM (>=10M params, GEOMX_LM_* sized)")
+    ap.add_argument("--join", action="store_true",
+                    help="this worker is OUT-OF-PLAN: register with the "
+                         "party server mid-training (ADD_NODE), train, "
+                         "then leave gracefully; requires --advertise "
+                         "for TCP so peers can dial the new slot")
     ap.add_argument("--compression", default="none")
     ap.add_argument("--hfa", action="store_true")
     ap.add_argument("--esync", action="store_true",
@@ -444,6 +469,10 @@ def main(argv=None):
         # lm workload pushes GRADIENTS — dispatching it against HFA
         # servers would silently train garbage
         ap.error("--workload lm is mutually exclusive with --esync/--hfa")
+    if args.join and (args.esync or args.hfa or args.p3
+                      or args.tsengine or args.workload != "cnn"):
+        ap.error("--join supports the plain cnn workload only (TS/HFA "
+                 "member sets are fixed; see LocalServer._on_add_node)")
 
     from geomx_tpu.core.platform import apply_platform_from_env
 
@@ -493,6 +522,8 @@ def main(argv=None):
             # P3 deployments train through the staged overlap loop —
             # that IS the feature (priority-scheduled per-stage rounds)
             _worker_demo_staged(po, role_obj, args)
+        elif args.join:
+            _worker_demo(po, role_obj, args, join_advertise=advertise)
         else:
             _worker_demo(po, role_obj, args)
     elif node.role is Role.MASTER_WORKER:
@@ -551,6 +582,11 @@ def main(argv=None):
     if po.van.wan_send_bytes or po.van.wan_recv_bytes:
         feats.append(f"wan_tx={po.van.wan_send_bytes} "
                      f"wan_rx={po.van.wan_recv_bytes}")
+    # dynamic membership observable (ADD_NODE joins/leaves served)
+    if getattr(role_obj, "joined_workers", 0) or getattr(
+            role_obj, "left_workers", 0):
+        feats.append(f"joined={role_obj.joined_workers} "
+                     f"left={role_obj.left_workers}")
     if po.van.pq_overtakes:
         feats.append(f"pq_overtakes={po.van.pq_overtakes}")
     if feats:

@@ -142,6 +142,16 @@ class Postoffice:
         with self._lock:
             self._control_hooks.append(hook)
 
+    def remove_control_hook(self, hook: Callable[[Message], bool]):
+        """Unregister a hook added by add_control_hook (one-shot RPC
+        hooks must not leak — a stale armed hook swallows the reply
+        meant for a later call)."""
+        with self._lock:
+            try:
+                self._control_hooks.remove(hook)
+            except ValueError:
+                pass
+
     # ---- dispatch -----------------------------------------------------------
     def _heartbeat_loop(self, stop_ev: threading.Event):
         """Periodic HEARTBEAT to my scheduler(s) (ref: van.cc:1128-1140).

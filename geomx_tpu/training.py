@@ -398,13 +398,18 @@ def run_worker(
     # global server; pre-divide by party size so the update is the all-worker
     # mean (the reference examples normalize client-side the same way,
     # ref: examples/cnn_hfa.py pushes param/num_local_workers)
-    scale = 1.0 / kv.num_workers if normalize else 1.0
     history: List[Tuple[float, float]] = []
     buf: List[Optional[np.ndarray]] = [None] * len(leaves)
 
     for step, (x, y) in enumerate(data_iter):
         if step >= steps:
             break
+        # re-read per step: dynamic join/leave changes the party size
+        # mid-training (the server broadcasts the new count, the client
+        # hook updates kv.num_workers) — a scale frozen at start would
+        # weight this worker's contribution wrongly after a membership
+        # change
+        scale = 1.0 / kv.num_workers if normalize else 1.0
         m.step_start()
         with m.phase("grad"):
             loss, acc, grads = grad_fn(params, x, y)

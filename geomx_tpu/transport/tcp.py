@@ -343,6 +343,16 @@ class TcpFabric:
             self._established.add(dest)
         return conn
 
+    def add_address(self, node: str, addr: Tuple[str, int]) -> None:
+        """Explicitly register an OUT-OF-PLAN peer (a dynamically joined
+        worker, ref: ADD_NODE van.cc:41-112).  Distinct from
+        ``update_address``, which deliberately ignores unknown nodes as
+        stale broadcasts."""
+        with self._registry_mu:
+            if node not in self.plan:
+                self.plan[node] = addr
+        self.update_address(node, addr)
+
     def update_address(self, node: str, addr: Tuple[str, int]) -> None:
         """Re-point a peer's address (replacement node at a new
         host:port).  Drops any live connection to the old address and

@@ -1,0 +1,262 @@
+"""Dynamic worker join (VERDICT r3 item 7; ref: ADD_NODE runtime id
+assignment + node-table broadcast, ps-lite van.cc:41-112).
+
+The build's topology is a static plan (documented divergence), so the
+party SERVER owns rank assignment and the aggregation count: a new
+worker registers mid-training and is folded into each key's count at
+that key's next fresh aggregation round — never mid-round.
+"""
+
+import numpy as np
+import pytest
+
+from geomx_tpu.core.config import Config, Topology
+from geomx_tpu.kvstore import Simulation
+
+
+def _round(workers, tid, grads):
+    for w, g in zip(workers, grads):
+        w.push(tid, g)
+    outs = [w.pull_sync(tid) for w in workers]
+    for w in workers:
+        w.wait_all()
+    return outs
+
+
+def test_worker_joins_midtraining_and_count_shifts():
+    """Start 2 workers, train, add a third: the server's round count
+    shifts to 3 at the next round boundary and training continues with
+    all three contributions aggregated."""
+    sim = Simulation(Config(
+        topology=Topology(num_parties=1, workers_per_party=2)))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(4, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        g = np.ones(4, np.float32)
+
+        # round 1: two workers; server applies -lr * sum = -2
+        outs = _round(ws, 0, [g, g])
+        np.testing.assert_allclose(outs[0], -2.0 * np.ones(4))
+
+        # join a third worker mid-training
+        w3 = sim.add_worker(0)
+        assert w3.num_workers == 3
+        srv = sim.local_servers[0]
+        assert srv.joined_workers == 1
+        # the joiner initializes its replica (no-op server-side) and
+        # pulls current weights before contributing
+        w3.init(0, np.zeros(4, np.float32))
+        np.testing.assert_allclose(w3.pull_sync(0), -2.0 * np.ones(4))
+
+        # round 2: THREE workers must now complete the round — if the
+        # server still counted to 2, the third push would leak into a
+        # phantom next round and desync every later pull
+        outs = _round(ws + [w3], 0, [g, g, g])
+        for o in outs:
+            np.testing.assert_allclose(o, -5.0 * np.ones(4))
+
+        # round 3: still 3
+        outs = _round(ws + [w3], 0, [g, g, g])
+        for o in outs:
+            np.testing.assert_allclose(o, -8.0 * np.ones(4))
+    finally:
+        sim.shutdown()
+
+
+def test_join_mid_round_extends_open_round():
+    """A join landing while a round is mid-aggregation EXTENDS that
+    round's target: the joiner's first pushes land in whatever round is
+    open, and completing it early at the old count would leak a static
+    worker's push into the next round (advisor r4).  So the open round
+    waits for all three — no contribution is lost or carried over."""
+    sim = Simulation(Config(
+        topology=Topology(num_parties=1, workers_per_party=2)))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(4, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        g = np.ones(4, np.float32)
+
+        # first worker pushes: round is now mid-aggregation (1 of 2)
+        ws[0].push(0, g)
+        w3 = sim.add_worker(0)  # join lands mid-round -> target 3
+        ws[1].push(0, g)        # 2 of 3: round still open
+        w3.init(0, np.zeros(4, np.float32))
+        w3.push(0, g)           # 3 of 3: completes with everyone
+        np.testing.assert_allclose(ws[0].pull_sync(0), -3.0 * np.ones(4))
+        for w in ws + [w3]:
+            w.wait_all()
+
+        # membership broadcast reached the static workers too: their
+        # 1/num_workers gradient pre-scale must track the new size
+        assert ws[0].num_workers == 3 and ws[1].num_workers == 3
+
+        # next round counts all three as well
+        outs = _round(ws + [w3], 0, [g, g, g])
+        for o in outs:
+            np.testing.assert_allclose(o, -6.0 * np.ones(4))
+    finally:
+        sim.shutdown()
+
+
+def test_leave_restores_count_and_releases_stalled_round():
+    """Graceful leave: the target drops at the boundary, and a round the
+    leaver never reached completes without it instead of stalling."""
+    sim = Simulation(Config(
+        topology=Topology(num_parties=1, workers_per_party=2)))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(4, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        g = np.ones(4, np.float32)
+        w3 = sim.add_worker(0)
+        w3.init(0, np.zeros(4, np.float32))
+
+        outs = _round(ws + [w3], 0, [g, g, g])  # 3-way round: -3
+        np.testing.assert_allclose(outs[0], -3.0 * np.ones(4))
+
+        # the two static workers push the NEXT round (2 of 3) — it
+        # stalls until the third contributor's fate resolves
+        ws[0].push(0, g)
+        ws[1].push(0, g)
+        res = w3.leave_party()
+        assert res["num_workers"] == 2
+        assert sim.local_servers[0].left_workers == 1
+        # the leave released the stalled round at count 2
+        np.testing.assert_allclose(ws[0].pull_sync(0), -5.0 * np.ones(4))
+        for w in ws:
+            w.wait_all()
+
+        # subsequent rounds count 2 again
+        outs = _round(ws, 0, [g, g])
+        np.testing.assert_allclose(outs[0], -7.0 * np.ones(4))
+    finally:
+        sim.shutdown()
+
+
+def test_join_rejected_under_intra_ts():
+    sim = Simulation(Config(
+        topology=Topology(num_parties=1, workers_per_party=2),
+        enable_intra_ts=True))
+    try:
+        with pytest.raises(RuntimeError, match="unsupported"):
+            sim.add_worker(0)
+    finally:
+        sim.shutdown()
+
+
+@pytest.mark.slow
+def test_worker_joins_over_real_tcp():
+    """Process-level join (the reference's ADD_NODE is inherently
+    multi-process, van.cc:41-112): a full TCP topology trains while an
+    out-of-plan worker process registers via --join --advertise, trains
+    a couple of rounds, and leaves gracefully; everyone exits 0 and the
+    server's exit stats show the join+leave."""
+    import os
+    import re
+    import subprocess
+    import sys
+    import time
+
+    from tests.test_tcp import free_base_port
+
+    cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    topo = Topology(num_parties=1, workers_per_party=2)
+    base = free_base_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu")
+
+    def spawn(role, extra):
+        return subprocess.Popen(
+            [sys.executable, "-m", "geomx_tpu.launch", "--role", role,
+             "--parties", "1", "--workers", "2",
+             "--base-port", str(base)] + extra,
+            cwd=cwd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+
+    procs = {str(n): spawn(str(n), ["--steps", "8"])
+             for n in topo.all_nodes()}
+    # the joiner: out-of-plan rank 2, binds past the plan's ports.
+    # Launched immediately — it registers while the static workers are
+    # still in jax compile, and runs fewer steps than they do so its
+    # rounds are a prefix of theirs (leave covers the rest)
+    join_role = "worker:2@p0"
+    procs[join_role] = spawn(join_role, [
+        "--steps", "2", "--join",
+        "--advertise", f"127.0.0.1:{base + 40}"])
+    try:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if all(p.poll() is not None for p in procs.values()):
+                break
+            time.sleep(0.5)
+        outputs = {}
+        for r, p in procs.items():
+            if p.poll() is None:
+                p.kill()
+            outputs[r] = p.communicate()[0]
+        for r, p in procs.items():
+            assert p.returncode == 0, \
+                f"{r} rc={p.returncode}: {outputs[r][-1000:]}"
+        assert "joined as rank 2" in outputs[join_role], outputs[join_role]
+        assert "left cleanly" in outputs[join_role], outputs[join_role]
+        srv_out = outputs["server:0@p0"]
+        m = re.search(r"joined=(\d+) left=(\d+)", srv_out)
+        assert m and m.group(1) == "1" and m.group(2) == "1", srv_out
+        for w in ("worker:0@p0", "worker:1@p0"):
+            assert "steps=8" in outputs[w], outputs[w][-500:]
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+
+def test_joined_worker_trains_a_model():
+    """End-to-end: CNN training continues across a join and the loss
+    keeps improving with three contributors."""
+    import jax
+
+    from geomx_tpu.data import ShardedIterator, synthetic_classification
+    from geomx_tpu.models import create_cnn_state
+    from geomx_tpu.training import flatten_params, run_worker
+
+    sim = Simulation(Config(
+        topology=Topology(num_parties=1, workers_per_party=2)))
+    try:
+        x, y = synthetic_classification(n=256, shape=(8, 8, 1), seed=0)
+        _, params, grad_fn = create_cnn_state(
+            jax.random.PRNGKey(0), input_shape=(1, 8, 8, 1))
+        ws = sim.all_workers()
+        ws[0].set_optimizer({"type": "adam", "lr": 0.01})
+
+        import threading
+
+        hist = {}
+
+        def train(kv, widx, nw, steps):
+            it = ShardedIterator(x, y, 16, widx, nw)
+            hist[widx] = run_worker(kv, params, grad_fn, it, steps,
+                                    barrier_init=False)
+
+        ths = [threading.Thread(target=train, args=(w, i, 2, 3))
+               for i, w in enumerate(ws)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+
+        w3 = sim.add_worker(0)
+        ths = [threading.Thread(target=train, args=(w, i, 3, 3))
+               for i, w in enumerate(ws + [w3])]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert len(hist[2]) == 3  # the joiner trained full rounds
+        losses = [h[0] for h in hist[0]]
+        assert np.isfinite(losses).all()
+    finally:
+        sim.shutdown()
