@@ -155,10 +155,12 @@ class LocalServer:
         # mid-round.
         self._join_next_rank = topo.workers_per_party
         self._workers_target = self.num_workers
-        self._members: Dict[str, int] = {}  # joined node str -> rank
-        #                                     (idempotency: a replayed
-        #                                     join/leave must not move
-        #                                     the count twice)
+        # membership registry, seeded with the STATIC plan's workers so
+        # a plan worker can leave too (idempotency: a replayed
+        # join/leave must not move the count twice)
+        self._members: Dict[str, int] = {
+            str(w): w.rank
+            for w in topo.workers(postoffice.node.party)}
         self.joined_workers = 0  # observability
         self.left_workers = 0
         self.store: Dict[int, np.ndarray] = {}
@@ -390,17 +392,22 @@ class LocalServer:
                 # mid-flight rounds must ALSO wait for the joiner: its
                 # first pushes land in whatever round is open, and with
                 # the old target a static worker's push would complete
-                # the round early and leak a contribution forward
+                # the round early and leak a contribution forward.
+                # Honest transition caveat: contributions already in the
+                # open round were pre-scaled by the OLD 1/num_workers,
+                # the joiner's by the new one, so that single round's
+                # applied update is up to (1 + 1/old_n - 1/new_n)x the
+                # true mean — the same one-round transient class as the
+                # leave-side push leak and async staleness
                 for st in self._keys.values():
                     if st.accum is not None and st.expected:
                         st.expected += 1
         # TCP deployments announce the joiner's bind address alongside;
-        # add_address inserts the OUT-OF-PLAN slot (update_address alone
-        # would ignore it as a stale broadcast)
+        # add_address inserts the OUT-OF-PLAN slot (update_address would
+        # ignore an unknown node as a stale broadcast, so it is no
+        # fallback here)
         if "host" in body and "node" in body:
-            fab = self.po.van.fabric
-            add = getattr(fab, "add_address",
-                          getattr(fab, "update_address", None))
+            add = getattr(self.po.van.fabric, "add_address", None)
             if add is not None:
                 add(body["node"], (body["host"], int(body["port"])))
         self._broadcast_membership(total)

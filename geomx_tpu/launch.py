@@ -253,6 +253,17 @@ def _worker_demo(po, kv, args, join_advertise=None):
         info = kv.join_party(advertise=join_advertise)
         print(f"{po.node}: joined as rank {info['rank']} "
               f"(num_workers={info['num_workers']})", flush=True)
+        # adopt the CLUSTER's current weights before contributing — a
+        # gradient computed at our own random init point would fold one
+        # garbage step into everyone's mean.  init (no-op server-side)
+        # publishes shapes; the pulls fetch the live replica.
+        from geomx_tpu.training import flatten_params
+
+        leaves, treedef = flatten_params(params)
+        for tid, leaf in enumerate(leaves):
+            kv.init(tid, leaf)
+        pulled = [kv.pull_sync(tid) for tid in range(len(leaves))]
+        params = jax.tree_util.tree_unflatten(treedef, pulled)
         # shard by the POST-join party size: the static plan's indexing
         # would alias another worker's shard (widx past num_all_workers
         # wraps into a subset of worker 0's slice)
@@ -473,6 +484,10 @@ def main(argv=None):
                       or args.tsengine or args.workload != "cnn"):
         ap.error("--join supports the plain cnn workload only (TS/HFA "
                  "member sets are fixed; see LocalServer._on_add_node)")
+    if args.join and not args.advertise:
+        # without an advertised bind address the out-of-plan node has no
+        # slot in the TCP plan and dies with a bare KeyError at bind
+        ap.error("--join requires --advertise HOST:PORT")
 
     from geomx_tpu.core.platform import apply_platform_from_env
 

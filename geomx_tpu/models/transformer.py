@@ -119,7 +119,13 @@ def param_specs(cfg: TransformerConfig) -> Dict:
     w2/down.  MoE experts shard over the same axis (ep aliases tp on
     small meshes — each device owns E/tp experts)."""
     specs: Dict[str, Any] = {
-        "embed": P(None, "tp"),
+        # vocab-parallel (Megatron-style), NOT d_model-sharded: a
+        # d-sharded embedding makes the residual stream enter every
+        # layer sharded on d, and GSPMD then all-gathers the activations
+        # in front of EVERY qkv/ffn matmul (measured: 10 activation
+        # all-gathers per 2-layer step vs 0 with vocab-parallel — see
+        # tests/test_moe_collectives.py, the r4 collective audit)
+        "embed": P("tp", None),
         "pos": P(None, None),
         "ln_f": P(None),
         "layers": [],

@@ -14,7 +14,12 @@ Design notes (why this shape and not a sort/scatter kernel):
   ``P("tp")`` (ep aliases tp: each device owns E/tp experts) and
   activations replicated over tp, XLA partitions the dispatch einsum
   with zero communication and inserts exactly one psum at the combine —
-  the same collective footprint as the Megatron MLP it replaces.
+  the same collective footprint as the Megatron MLP it replaces.  This
+  is no longer just a claim: tests/test_moe_collectives.py compiles the
+  sharded train step and asserts ZERO all-gather/all-to-all in the
+  optimized HLO, matching the dense-FFN peer (the audit also caught and
+  fixed a d_model-sharded embedding that was gathering the residual
+  stream in front of every matmul — see models/transformer.param_specs).
 - Shapes are static: capacity ``C = ceil(S*k*cf/E)`` is computed from
   static dims, tokens past capacity are dropped (standard GShard
   semantics), and the schedule contains no data-dependent control flow

@@ -138,6 +138,29 @@ def test_leave_restores_count_and_releases_stalled_round():
         sim.shutdown()
 
 
+def test_static_plan_worker_can_leave():
+    """The membership registry is seeded with the static plan, so a PLAN
+    worker's leave lowers the target too (advisor r4: it used to be
+    silently treated as a replayed leave, stalling every later round)."""
+    sim = Simulation(Config(
+        topology=Topology(num_parties=1, workers_per_party=2)))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(4, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        g = np.ones(4, np.float32)
+        _round(ws, 0, [g, g])
+        res = ws[1].leave_party()
+        assert res["num_workers"] == 1
+        # worker 0 trains on alone — rounds complete at count 1
+        ws[0].push(0, g)
+        np.testing.assert_allclose(ws[0].pull_sync(0), -3.0 * np.ones(4))
+        ws[0].wait_all()
+    finally:
+        sim.shutdown()
+
+
 def test_join_rejected_under_intra_ts():
     sim = Simulation(Config(
         topology=Topology(num_parties=1, workers_per_party=2),

@@ -1,0 +1,89 @@
+"""Collective-footprint audit of the sharded MoE path (VERDICT r3 weak
+item 4: the dryrun proved compile+sync, not that GSPMD actually honors
+parallel/moe.py's zero-communication dispatch claim).
+
+The module docstring promises: with experts sharded ``P("tp")`` and
+activations replicated over tp, XLA partitions the dispatch einsum with
+**zero communication** and inserts **one psum at the combine** — the
+same footprint as the Megatron MLP.  These tests compile the full
+tp-sharded train step on the virtual mesh and count the collectives in
+the optimized HLO, so a sharding-spec regression that silently inserts
+an all-gather (the usual failure: a spec change makes GSPMD replicate
+the [G,S,E,C] dispatch tensor) fails here instead of shipping as a
+mystery slowdown.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from geomx_tpu.models.transformer import (
+    TransformerConfig, init_params, lm_loss_with_aux, make_apply,
+    param_specs,
+)
+from geomx_tpu.parallel import make_mesh
+
+
+def _collective_counts(hlo: str) -> dict:
+    ops = ("all-gather", "all-to-all", "all-reduce", "reduce-scatter",
+           "collective-permute")
+    out = {}
+    for op in ops:
+        # count op *instructions* (e.g. "all-gather(" / "all-gather-start("),
+        # not mentions in metadata
+        out[op] = len(re.findall(rf"= \S+ {op}(?:-start)?\(", hlo))
+    return out
+
+
+def _compile_step(cfg, mesh):
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    apply_fn = make_apply(cfg, mesh=mesh, return_aux=True)
+    from jax.sharding import NamedSharding
+    specs = param_specs(cfg)
+    params = jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params, specs)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab, (2, cfg.max_seq)), jnp.int32)
+
+    def loss(p):
+        return lm_loss_with_aux(apply_fn, p, tokens)
+
+    lowered = jax.jit(jax.value_and_grad(loss)).lower(params)
+    return lowered.compile().as_text()
+
+
+def test_moe_dispatch_inserts_no_gather_or_all_to_all():
+    """Fwd+bwd of the MoE flagship on a tp mesh: dispatch/combine must
+    lower to local einsums + reductions only."""
+    mesh = make_mesh({"dp": 1, "sp": 1, "tp": 4})
+    cfg = TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=32, moe_every=2, n_experts=4, moe_top_k=2,
+        compute_dtype=jnp.float32)
+    counts = _collective_counts(_compile_step(cfg, mesh))
+    assert counts["all-gather"] == 0, counts
+    assert counts["all-to-all"] == 0, counts
+    # communication exists (the combine psum + grad reductions) but it
+    # is all reduction-shaped
+    assert counts["all-reduce"] + counts["reduce-scatter"] > 0, counts
+
+
+def test_moe_collective_count_matches_dense_ffn_peer():
+    """The claim's second half: MoE's collective FOOTPRINT equals the
+    Megatron dense-FFN peer's on the same mesh (same op kinds, no extra
+    gather/all-to-all) — per-token FLOPs scale, communication doesn't."""
+    mesh = make_mesh({"dp": 1, "sp": 1, "tp": 4})
+    moe_cfg = TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=32, moe_every=2, n_experts=4, moe_top_k=2,
+        compute_dtype=jnp.float32)
+    dense_cfg = TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=32, compute_dtype=jnp.float32)
+    moe = _collective_counts(_compile_step(moe_cfg, mesh))
+    dense = _collective_counts(_compile_step(dense_cfg, mesh))
+    for op in ("all-gather", "all-to-all"):
+        assert moe[op] == dense[op] == 0, (moe, dense)
