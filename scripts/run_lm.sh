@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Flagship LM acceptance: the 10.3M-param transformer through the full
+# TCP topology with MPQ compression (no reference counterpart — GeoMX's
+# example matrix is CNN-only; this is the TPU-native flagship workload).
+# Size via GEOMX_LM_* (docs/env-vars.md).
+set -euo pipefail
+HERE="$(cd "$(dirname "$0")" && pwd)"
+PARTIES="${PARTIES:-1}" WORKERS="${WORKERS:-1}" STEPS="${STEPS:-3}" \
+  exec "$HERE/run_cluster.sh" --workload lm --compression mpq \
+       --batch "${BATCH:-4}" "$@"
