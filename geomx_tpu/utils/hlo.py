@@ -1,0 +1,68 @@
+"""Optimized-HLO collective audit helpers.
+
+Shared by the MoE/tp collective-footprint tests
+(tests/test_moe_collectives.py) and the multichip dryrun
+(__graft_entry__.dryrun_multichip) so the regexes — including the
+async-start tuple-shape handling — live in exactly one place.
+
+HLO instruction forms handled::
+
+    %x = f32[2,32]{1,0} all-gather(%y), ...
+    %x = (f32[2,32]{1,0}, f32[2,32]{1,0}) all-gather-start(%y), ...
+
+The sync form's shape is a single ``dtype[dims]``; the async start's is
+a tuple (whose inner spaces defeat naive ``= \\S+ op(`` patterns), so
+matching keys on the opcode token itself.  ``*-done`` ops are the
+completion halves of starts and are not counted (that would double
+count one collective).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+COLLECTIVE_OPS = ("all-gather", "all-to-all", "all-reduce",
+                  "reduce-scatter", "collective-permute")
+
+_DTYPE_B = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+            "pred": 1, "s8": 1, "u8": 1, "f64": 8, "s64": 8,
+            "u8[": 1, "c64": 8}
+
+
+def _op_lines(hlo: str, op: str):
+    """Instruction lines computing ``op`` (sync or async-start form)."""
+    pat = re.compile(rf" {re.escape(op)}(?:-start)?\(")
+    for ln in hlo.splitlines():
+        if " = " in ln and pat.search(ln):
+            yield ln
+
+
+def collective_counts(hlo: str) -> Dict[str, int]:
+    """Count collective instructions per op kind in optimized HLO text."""
+    return {op: sum(1 for _ in _op_lines(hlo, op))
+            for op in COLLECTIVE_OPS}
+
+
+def _result_bytes(line: str) -> int:
+    """Largest array in the instruction's result shape (a tuple for
+    async starts — taking the max avoids double-counting the buffer
+    the start form repeats)."""
+    lhs = line.split(" = ", 1)[1]
+    op_at = re.search(r" [a-z-]+(?:\.\d+)?\(", lhs)
+    shape_txt = lhs[:op_at.start()] if op_at else lhs
+    best = 0
+    for dtype, dims in re.findall(r"(\w+)\[([\d,]*)\]", shape_txt):
+        n = 1
+        for d in filter(None, dims.split(",")):
+            n *= int(d)
+        best = max(best, n * _DTYPE_B.get(dtype, 4))
+    return best
+
+
+def large_gathers(hlo: str, threshold_bytes: int = 16 * 1024) -> List[str]:
+    """all-gather instructions whose result exceeds the threshold —
+    the 'activations/dispatch got replicated' regression signal (tiny
+    index/router gathers of a few KB are normal on sp meshes)."""
+    return [ln.strip()[:160] for ln in _op_lines(hlo, "all-gather")
+            if _result_bytes(ln) > threshold_bytes]

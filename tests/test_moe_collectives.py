@@ -13,8 +13,6 @@ the [G,S,E,C] dispatch tensor) fails here instead of shipping as a
 mystery slowdown.
 """
 
-import re
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -24,17 +22,7 @@ from geomx_tpu.models.transformer import (
     param_specs,
 )
 from geomx_tpu.parallel import make_mesh
-
-
-def _collective_counts(hlo: str) -> dict:
-    ops = ("all-gather", "all-to-all", "all-reduce", "reduce-scatter",
-           "collective-permute")
-    out = {}
-    for op in ops:
-        # count op *instructions* (e.g. "all-gather(" / "all-gather-start("),
-        # not mentions in metadata
-        out[op] = len(re.findall(rf"= \S+ {op}(?:-start)?\(", hlo))
-    return out
+from geomx_tpu.utils.hlo import collective_counts as _collective_counts
 
 
 def _compile_step(cfg, mesh):
