@@ -181,7 +181,11 @@ def test_lm_flagship_tcp_topology():
     tokens/s reported, WAN bytes accounted, the size split active."""
     _topo, outputs = _launch_matrix(
         1, 1, ["--workload", "lm", "--compression", "mpq", "--batch", "4"],
-        steps=3, timeout=420)
+        steps=3, timeout=420,
+        # size bound tuned to the flagship's leaf sizes (the reference's
+        # MXNET_KVSTORE_SIZE_LOWER_BOUND knob): 147k-element qkv/wo
+        # belong on BSC, not fp16 — same setting as bench child_lm
+        extra_env={"GEOMX_MPQ_SIZE_BOUND": "100000"})
     worker_out = outputs["worker:0@p0"]
     m = re.search(r"n_params=(\d+)", worker_out)
     assert m and int(m.group(1)) >= 10_000_000, worker_out
