@@ -257,7 +257,14 @@ class WorkerKVStore:
         existing keys is a no-op server-side).  ``advertise``: (host,
         port) for TCP deployments so peers can dial the out-of-plan
         slot.  Returns the server's reply ({"rank", "num_workers"}).
-        Raises on an unsupported configuration (intra-TS / HFA)."""
+        Raises on an unsupported configuration (intra-TS / HFA).
+
+        Known limitation: membership lives in the party server's memory
+        (like the reference scheduler's node table, which is also
+        RAM-only) — if the party server restarts mid-training, joined
+        workers must ``join_party`` again; until they do, rounds count
+        to the static plan size and a joiner's pushes skew one round's
+        mean (same transient class as the leave-side push leak)."""
         body = {"node": str(self.po.node)}
         if advertise is not None:
             body["host"], body["port"] = advertise[0], int(advertise[1])

@@ -1,0 +1,39 @@
+"""Unit coverage for the shared HLO collective-audit helpers
+(geomx_tpu/utils/hlo.py) — both the sync instruction form and the
+async tuple-shaped ``*-start`` form the regexes must handle (the r4
+review found the naive pattern silently missed the tuple form)."""
+
+from geomx_tpu.utils.hlo import (
+    collective_counts, large_gathers)
+
+HLO = """
+  %a = f32[2,32]{1,0} all-gather(%y), dims={1}
+  %b = (f32[4,2048]{1,0}, f32[4,2048]{1,0}) all-gather-start(%z), dims={0}
+  %c = f32[4,2048]{1,0} all-gather-done(%b)
+  %d = f32[8]{0} all-reduce(%w), to_apply=%sum
+  %e = (f32[8]{0}, f32[8]{0}) all-reduce-start(%w), to_apply=%sum
+  %f = bf16[16,128]{1,0} collective-permute(%v), source_target_pairs={{0,1}}
+  %g = s32[] constant(0), metadata={op_name="not all-gather text"}
+"""
+
+
+def test_counts_sync_and_async_start_not_done():
+    c = collective_counts(HLO)
+    assert c["all-gather"] == 2          # sync + async-start
+    assert c["all-reduce"] == 2
+    assert c["collective-permute"] == 1
+    assert c["all-to-all"] == 0
+    assert c["reduce-scatter"] == 0
+
+
+def test_large_gathers_sizes_tuple_forms():
+    big = large_gathers(HLO)  # default 16KB threshold
+    assert len(big) == 1 and "all-gather-start" in big[0], big
+    # both gathers exceed a 1-byte threshold; the -done never counts
+    assert len(large_gathers(HLO, threshold_bytes=1)) == 2
+
+
+def test_bf16_byte_accounting():
+    hlo = "  %x = bf16[64,128]{1,0} all-gather(%y), dims={0}\n"
+    assert large_gathers(hlo, threshold_bytes=16_383)  # 16384 B > 16383
+    assert not large_gathers(hlo, threshold_bytes=16_384)
