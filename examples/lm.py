@@ -31,7 +31,8 @@ from geomx_tpu.core.config import Config, Topology
 from geomx_tpu.data import TokenIterator, synthetic_lm
 from geomx_tpu.kvstore import Simulation
 from geomx_tpu.models.transformer import (
-    TransformerConfig, init_params, make_apply, token_cross_entropy,
+    AUX_COEF, TransformerConfig, init_params, make_apply,
+    token_cross_entropy,
 )
 from geomx_tpu.training import run_worker
 
@@ -95,7 +96,7 @@ def main():
         def loss_fn(p):
             out = apply_fn(p, x)
             logits, aux = out if use_aux else (out, 0.0)
-            loss = token_cross_entropy(logits, x) + 0.01 * aux
+            loss = token_cross_entropy(logits, x) + AUX_COEF * aux
             acc = jnp.mean(
                 jnp.argmax(logits[:, :-1], axis=-1) == x[:, 1:])
             return loss, acc

@@ -359,7 +359,11 @@ def lm_loss(apply_fn, params, tokens):
     return token_cross_entropy(apply_fn(params, tokens), tokens)
 
 
-def lm_loss_with_aux(apply_fn, params, tokens, aux_coef: float = 0.01):
+AUX_COEF = 0.01  # MoE load-balancing aux weight — the ONE definition
+#                  (make_lm_grad_fn and examples/lm.py reuse it)
+
+
+def lm_loss_with_aux(apply_fn, params, tokens, aux_coef: float = AUX_COEF):
     """LM loss + MoE load-balancing aux.  ``apply_fn`` must come from
     ``make_apply(..., return_aux=True)``."""
     logits, aux = apply_fn(params, tokens)
@@ -370,14 +374,18 @@ def make_lm_grad_fn(cfg: "TransformerConfig"):
     """Jitted ``grad_fn(params, x, y) -> (loss, acc, grads)`` with the
     worker-loop signature (``training.run_worker``); y is ignored (the
     LM objective shifts x).  Shared by the launcher's LM workload and
-    the bench's lm child so they train the identical step."""
-    apply_fn = make_apply(cfg)
+    the bench's lm child so they train the identical step.  Top-k MoE
+    configs train with the load-balancing aux folded in (the same
+    objective examples/lm.py uses)."""
+    use_aux = cfg.moe_every > 0 and cfg.moe_top_k > 0
+    apply_fn = make_apply(cfg, return_aux=use_aux)
 
     @jax.jit
     def grad_fn(p, x, _y):
         def loss_fn(p):
-            logits = apply_fn(p, x)
-            loss = token_cross_entropy(logits, x)
+            out = apply_fn(p, x)
+            logits, aux = out if use_aux else (out, 0.0)
+            loss = token_cross_entropy(logits, x) + AUX_COEF * aux
             acc = jnp.mean(jnp.argmax(logits[:, :-1], axis=-1) == x[:, 1:])
             return loss, acc
 

@@ -155,6 +155,7 @@ def build_flagship_lm():
     def _e(name, dflt):
         return int(os.environ.get(name, dflt))
 
+    moe_experts = _e("GEOMX_LM_MOE_EXPERTS", 0)
     cfg = TransformerConfig(
         vocab=_e("GEOMX_LM_VOCAB", 8192),
         d_model=_e("GEOMX_LM_DMODEL", 384),
@@ -163,6 +164,15 @@ def build_flagship_lm():
         d_ff=_e("GEOMX_LM_DFF", 1536),
         max_seq=_e("GEOMX_LM_SEQ", 128),
         attn_impl="fast",
+        # GEOMX_LM_MOE_EXPERTS > 0 makes every 2nd layer a top-k routed
+        # MoE (real EP) — the flagship's expert gradients then ride the
+        # same PS stack as the dense leaves.  top_k clamps to the expert
+        # count (top_k > E would raise an opaque trace-time error from
+        # lax.top_k inside every worker)
+        moe_every=2 if moe_experts > 0 else 0,
+        n_experts=max(moe_experts, 1),
+        moe_top_k=(min(_e("GEOMX_LM_MOE_TOP_K", 2), moe_experts)
+                   if moe_experts > 0 else 0),
     )
     params = init_params(cfg, jax.random.PRNGKey(0))
     n_params = sum(int(np.prod(p.shape))
