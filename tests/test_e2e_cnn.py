@@ -3,12 +3,14 @@
 The reference's correctness oracle is "accuracy climbs like vanilla"
 (ref: SURVEY.md §4 convergence-as-oracle).  2 parties × 2 workers, FSA,
 server-side Adam; loss must drop and all workers must hold identical
-weights after each round."""
+weights after each round — plus the per-codec convergence-parity matrix
+(each compression config's loss curve tracks the vanilla run's)."""
 
 import threading
 
 import jax
 import numpy as np
+import pytest
 
 from geomx_tpu.core.config import Config, Topology
 from geomx_tpu.data import ShardedIterator, synthetic_classification
@@ -62,3 +64,96 @@ def test_cnn_trains_through_hips():
         assert sim.wan_bytes()["wan_send_bytes"] > 0
     finally:
         sim.shutdown()
+
+
+def _train_one_config(compression, steps=36):
+    """Same model/data/seed through the two-tier stack under one codec
+    config; returns (loss history of worker 0, WAN bytes sent)."""
+    sim = Simulation(Config(
+        topology=Topology(num_parties=1, workers_per_party=2)))
+    try:
+        x, y = synthetic_classification(n=512, shape=(12, 12, 1), seed=1)
+        _, params, grad_fn = create_cnn_state(
+            jax.random.PRNGKey(0), input_shape=(1, 12, 12, 1))
+        histories = {}
+        errors = []
+        lock = threading.Lock()
+
+        def worker_main(rank):
+            try:
+                kv = sim.worker(0, rank)
+                if rank == 0:
+                    kv.set_optimizer({"type": "adam", "lr": 0.01})
+                    if compression is not None:
+                        kv.set_gradient_compression(compression)
+                kv.barrier()
+                it = ShardedIterator(x, y, 16, rank, 2, seed=2)
+                hist = run_worker(kv, params, grad_fn, it, steps=steps)
+                with lock:
+                    histories[rank] = hist
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                with lock:
+                    errors.append((rank, e))
+
+        threads = [threading.Thread(target=worker_main, args=(r,))
+                   for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        # a rejected codec config must surface as ITS error, not as the
+        # other worker stalling into the join timeout
+        assert not errors, f"worker failed under {compression}: {errors}"
+        assert len(histories) == 2, f"worker hung under {compression}"
+        return ([loss for loss, _acc in histories[0]],
+                sim.wan_bytes()["wan_send_bytes"])
+    finally:
+        sim.shutdown()
+
+
+@pytest.mark.slow
+def test_codec_convergence_parity():
+    """The reference's de-facto acceptance criterion, SURVEY §4.3:
+    'correctness of a comms feature = accuracy curve matches vanilla'.
+    Train the identical run under each codec and compare loss drops.
+    Exact-ish codecs (fp16) must match vanilla closely; sparsifying
+    codecs (bsc/mpq) trade per-step fidelity for bytes and must still
+    achieve most of vanilla's improvement — at a horizon long enough
+    for DGC's residual accumulation to cycle most coordinates (top-5%
+    per step needs tens of steps, which is why the reference's oracle
+    runs full epochs); 2-bit (threshold ternary + residual) is the
+    lossiest and must still clearly learn."""
+    # ratio 0.10, not the reference's 0.01 default: the top-k fraction
+    # must be meaningful relative to MODEL size (~102k params here vs
+    # the multi-million-param models the 1% default assumes) —
+    # measured: ratio 0.05 recovers 47% of vanilla's drop at this
+    # horizon, 0.10 recovers 98%
+    runs = {name: _train_one_config(comp) for name, comp in {
+        "vanilla": None,
+        "fp16": {"type": "fp16"},
+        "2bit": {"type": "2bit", "threshold": 0.05},
+        "bsc": {"type": "bsc", "ratio": 0.10},
+        "mpq": {"type": "mpq", "ratio": 0.10, "size_bound": 2_000},
+    }.items()}
+    losses = {k: v[0] for k, v in runs.items()}
+    wan = {k: v[1] for k, v in runs.items()}
+    # the codecs must have actually engaged — identical-to-vanilla WAN
+    # traffic would mean SET_COMPRESSION silently no-oped and every
+    # parity ratio below passed vacuously
+    for name in ("fp16", "2bit", "bsc", "mpq"):
+        assert wan[name] < 0.9 * wan["vanilla"], (name, wan)
+
+    def drop(h):
+        # first vs mean-of-last-3: single-step noise must not decide
+        return h[0] - float(np.mean(h[-3:]))
+
+    van = drop(losses["vanilla"])
+    assert van > 0.2, f"vanilla failed to learn: {losses['vanilla']}"
+    # fp16 is numerically tight: within 25% of vanilla's improvement
+    assert drop(losses["fp16"]) > 0.75 * van, (losses["vanilla"],
+                                               losses["fp16"])
+    # sparsifiers keep most of the improvement
+    for name in ("bsc", "mpq"):
+        assert drop(losses[name]) > 0.5 * van, (name, van, losses[name])
+    # 2-bit must clearly learn (its trajectory is legitimately different)
+    assert drop(losses["2bit"]) > 0.25 * van, (van, losses["2bit"])
