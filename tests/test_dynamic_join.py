@@ -161,6 +161,38 @@ def test_static_plan_worker_can_leave():
         sim.shutdown()
 
 
+def test_join_under_wan_compression():
+    """Join interplay with the WAN codec path: a joiner folds into a
+    party whose push-ups ride BSC — the pull-direction compressor's
+    per-subscriber tracked views and the join are independent, so
+    training must continue and the WAN must stay compressed."""
+    sim = Simulation(Config(
+        topology=Topology(num_parties=1, workers_per_party=2),
+        compression="bsc"))
+    try:
+        ws = sim.all_workers()
+        rng = np.random.default_rng(0)
+        for w in ws:
+            w.init(0, np.zeros(4096, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        ws[0].set_gradient_compression({"type": "bsc", "ratio": 0.05})
+        g = rng.standard_normal(4096).astype(np.float32)
+        _round(ws, 0, [g, g])
+        base = sim.wan_bytes()["wan_send_bytes"]
+
+        w3 = sim.add_worker(0)
+        w3.init(0, np.zeros(4096, np.float32))
+        outs = _round(ws + [w3], 0, [g, g, g])
+        # all three replicas agree post-join
+        np.testing.assert_allclose(outs[0], outs[2], rtol=1e-5, atol=1e-6)
+        # and the WAN hop stayed sparse (well under the dense 2x16KB
+        # push+pull a vanilla round would cost)
+        sent = sim.wan_bytes()["wan_send_bytes"] - base
+        assert sent < 0.5 * (2 * 4096 * 4), sent
+    finally:
+        sim.shutdown()
+
+
 def test_join_rejected_under_intra_ts():
     sim = Simulation(Config(
         topology=Topology(num_parties=1, workers_per_party=2),
