@@ -18,12 +18,20 @@ already-computed result was lost.  The rules now:
   stdout;
 - **SIGTERM/SIGINT flush**: the handler kills running children, prints
   the current record, and exits 0;
-- **tunnel probe**: one tiny device call (60 s cap) gates all TPU
-  children — a dead tunnel costs one probe, not per-child timeouts;
-- CPU children (wan/overlap/stress) run on a **parallel thread** so a
-  slow tunnel cannot starve them of budget, and vice versa.
+- **tunnel probe**: one tiny device call (120 s cap — cold backend
+  init can exceed 75 s) gates all TPU children — a dead tunnel costs
+  two probes, not per-child timeouts;
+- CPU children run on a **parallel thread** so a slow tunnel cannot
+  starve them of budget, and vice versa — flagship metrics first so a
+  tight deadline clips the tail, not the headline blocks;
+- **last-known-good cache** (r4): every on-chip result persists to
+  TPU_LKG.json as it lands (flock-guarded, commit-stamped); a dead
+  tunnel at bench time falls back to the cache with staleness markers,
+  and scripts/tpu_watch.py probes in the background all session so one
+  live window lands the round's numbers.
 
-Benchmarks:
+Benchmarks (TPU: cnn/mfu/quant/overlap_tpu/flash_autotune; CPU:
+wan/lm/scaling/stress/overlap):
 - **cnn**   CIFAR-10-shape CNN images/sec/chip (BASELINE.md metric #1).
   The step loop runs on-device via lax.scan — one dispatch per
   measurement — because the axon tunnel adds O(100ms) per Python
@@ -32,8 +40,16 @@ Benchmarks:
   bf16: achieved TFLOP/s vs the chip's peak (VERDICT r1 item 1).
 - **quant** on-chip pallas 2-bit quantization throughput vs the host
   C++/numpy codec (VERDICT r1 item 2).
+- **flash_autotune** on-chip Q-tile sweep for the pallas ring-flash
+  kernel at the real hop geometry (feeds GEOMX_FLASH_BLOCK_Q).
 - **wan**   WAN bytes/step per codec config on the full two-tier stack
-  (CPU, in-proc sim).
+  (CPU, in-proc sim) + the 50M-element MultiGPS×BSC flagship ledger.
+- **lm**    the 10.3M-param flagship LM through 2 parties with MPQ:
+  steady tokens/s + WAN bytes/step (BASELINE.md metric #2 at scale).
+- **scaling** weak-scaling points on virtual meshes + the modeled
+  8->256-chip ICI/DCN roofline (BASELINE.md metric #3).
+- **stress** 200 MB x 4-worker server merge throughput.
+- **overlap** P3 staged overlap vs BSP under a serialized WAN.
 
 vs_baseline: BASELINE.md's north star is >=0.9x the per-chip throughput
 of an A100 running the reference CUDA build on the same CNN.  No A100
