@@ -16,6 +16,7 @@ orders sends under P3's priority queue.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -204,36 +205,60 @@ class WorkerKVStore:
             return True
         return False
 
-    def _addnode_rpc(self, body: dict, timeout: float) -> dict:
-        """One ADD_NODE request/reply round trip to the party server.
-        The reply hook is one-shot AND unregistered on exit — a stale
-        armed hook would swallow the reply meant for a later call."""
+    def _addnode_rpc(self, body: dict, timeout: float,
+                     attempts: int = 3) -> dict:
+        """ADD_NODE request/reply round trip to the party server.
+
+        Control messages are outside the resender (it covers data
+        traffic), so the request is retried here: the server handler is
+        idempotent by node id (a replayed join re-uses the assigned
+        rank, a replayed leave is a no-op), which is exactly what makes
+        client-side retry safe under drop injection / lossy links.  The
+        reply hook is one-shot AND unregistered on exit — a stale armed
+        hook would swallow the reply meant for a later call."""
         cv = threading.Condition()
         reply: dict = {}
+        # correlation token: retries make the server reply more than
+        # once, and a STALE duplicate (e.g. from an earlier join) must
+        # not satisfy a later call whose own request was lost — the
+        # server echoes the token and the hook matches it
+        with self._mu:
+            self._addnode_seq = getattr(self, "_addnode_seq", 0) + 1
+            token = f"{self.po.node}#{self._addnode_seq}"
+        body = dict(body, token=token)
 
         def hook(msg) -> bool:
+            b = msg.body if isinstance(msg.body, dict) else {}
             if (msg.control is Control.ADD_NODE and not msg.request
-                    and not (isinstance(msg.body, dict)
-                             and "event" in msg.body)):
+                    and "event" not in b and b.get("token") == token):
                 with cv:
                     if "body" in reply:
                         return False
-                    reply["body"] = msg.body or {}
+                    reply["body"] = b
                     cv.notify_all()
                 return True
             return False
 
         self.po.add_control_hook(hook)
         try:
-            self.po.van.send(Message(
-                recipient=self.po.topology.server(self.party),
-                control=Control.ADD_NODE, domain=Domain.LOCAL,
-                request=True, body=body))
-            with cv:
-                if not cv.wait_for(lambda: "body" in reply,
-                                   timeout=timeout):
-                    raise TimeoutError(
-                        f"{self.po.node}: ADD_NODE rpc timed out")
+            deadline = time.monotonic() + timeout
+            per_try = timeout / attempts
+            for i in range(attempts):
+                self.po.van.send(Message(
+                    recipient=self.po.topology.server(self.party),
+                    control=Control.ADD_NODE, domain=Domain.LOCAL,
+                    request=True, body=body))
+                # never exceed the caller's total timeout contract
+                wait = min(per_try, max(deadline - time.monotonic(), 0.0))
+                with cv:
+                    if cv.wait_for(lambda: "body" in reply, timeout=wait):
+                        break
+                if time.monotonic() >= deadline:
+                    break
+            if "body" not in reply:
+                raise TimeoutError(
+                    f"{self.po.node}: ADD_NODE rpc timed out "
+                    f"({attempts} attempts)")
         finally:
             self.po.remove_control_hook(hook)
         b = reply["body"]

@@ -368,13 +368,13 @@ class LocalServer:
                     self._round_complete(completed)
             self._broadcast_membership(total)
             self.po.van.send(msg.reply_to(control=Control.ADD_NODE, body={
-                "num_workers": total}))
+                "num_workers": total, "token": body.get("token")}))
             return True
         if self.ts_client is not None or self.hfa_enabled:
             self.po.van.send(msg.reply_to(control=Control.ADD_NODE, body={
                 "error": "dynamic join unsupported with intra-party TS "
                          "or HFA (fixed member sets / weight-mean "
-                         "normalization)"}))
+                         "normalization)", "token": body.get("token")}))
             return True
         with self._mu:
             if node_s in self._members:
@@ -412,7 +412,8 @@ class LocalServer:
                 add(body["node"], (body["host"], int(body["port"])))
         self._broadcast_membership(total)
         self.po.van.send(msg.reply_to(control=Control.ADD_NODE, body={
-            "rank": rank, "num_workers": total}))
+            "rank": rank, "num_workers": total,
+            "token": body.get("token")}))
         return True
 
     def _broadcast_membership(self, total: int):

@@ -193,6 +193,33 @@ def test_join_under_wan_compression():
         sim.shutdown()
 
 
+def test_join_survives_drop_injection():
+    """ADD_NODE is a control message outside the resender; the client
+    RPC retries (and the server handler is idempotent by node id), so a
+    join must succeed across a lossy fabric and must not double-count
+    when a reply — not the request — was the drop."""
+    from geomx_tpu.transport.van import FaultPolicy
+
+    sim = Simulation(Config(
+        topology=Topology(num_parties=1, workers_per_party=2),
+        resend_timeout_ms=100),  # recovers dropped DATA traffic; the
+        #                          ADD_NODE rpc has its own retry
+        fault=FaultPolicy(drop_rate=0.3, seed=7))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(4, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        w3 = sim.add_worker(0)  # retries under 30% drop
+        assert w3.num_workers == 3
+        srv = sim.local_servers[0]
+        # idempotency: however many requests got through, ONE member
+        assert srv._workers_target == 3, srv._workers_target
+        assert srv.joined_workers >= 1
+    finally:
+        sim.shutdown()
+
+
 def test_join_rejected_under_intra_ts():
     sim = Simulation(Config(
         topology=Topology(num_parties=1, workers_per_party=2),
