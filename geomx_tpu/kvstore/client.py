@@ -38,6 +38,7 @@ class WorkerKVStore:
         self.rank = postoffice.node.rank
         self.party = postoffice.node.party
         self.num_workers = topo.workers_per_party        # in my party
+        self._membership_seen = -1   # last applied broadcast stamp
         self.num_all_workers = topo.num_workers_total    # ref: GetAllWorkerSize
         slice_elems = 0
         if self.config.enable_p3:
@@ -197,11 +198,21 @@ class WorkerKVStore:
         """Persistent hook: the party server broadcasts the new
         aggregation size on every join/leave; the per-step gradient
         pre-scale (1/num_workers) must track it or post-join updates
-        stop being a mean."""
+        stop being a mean.  Broadcasts are stamped with the server's
+        membership sequence; a stale stamp (two concurrent membership
+        changes, sends racing) must not roll the pre-scale back to an
+        older target — that would be a PERSISTENT mean-scale error, not
+        a transient."""
         if (msg.control is Control.ADD_NODE and not msg.request
                 and isinstance(msg.body, dict)
                 and msg.body.get("event") == "membership"):
-            self.num_workers = int(msg.body["num_workers"])
+            seq = msg.body.get("seq")
+            with self._mu:
+                if seq is not None:
+                    if seq <= self._membership_seen:
+                        return True  # stale broadcast: already ahead
+                    self._membership_seen = seq
+                self.num_workers = int(msg.body["num_workers"])
             return True
         return False
 
@@ -272,11 +283,14 @@ class WorkerKVStore:
         (ref: the runtime id assignment of ProcessAddNodeCommandAtScheduler
         van.cc:41-112; here the party server owns the count — see
         LocalServer._on_add_node).  The server folds this worker into
-        each key's aggregation count at that key's next fresh round (and
-        raises mid-flight rounds' targets, so push BEFORE the first pull
-        — a pull parked behind a round that waits for our push would
-        deadlock).  Idempotent server-side: retrying after a timeout
-        re-uses the assigned rank instead of double-counting.
+        each key's aggregation count immediately (open rounds' targets
+        included), and the natural bootstrap order — pull the current
+        model, then start pushing — is safe: the server serves pulls
+        from workers that have not contributed to the open round out of
+        the last COMPLETED round, so our bootstrap pulls never park
+        behind rounds that can only complete with our own push.
+        Idempotent server-side: retrying after a timeout re-uses the
+        assigned rank instead of double-counting.
 
         The caller must initialize its own model replica (``init`` of
         existing keys is a no-op server-side).  ``advertise``: (host,
@@ -293,8 +307,14 @@ class WorkerKVStore:
         body = {"node": str(self.po.node)}
         if advertise is not None:
             body["host"], body["port"] = advertise[0], int(advertise[1])
+        # an explicit (re)join resets the stale-broadcast baseline: a
+        # RESTARTED party server counts its membership seq from 0 again,
+        # and a high watermark from its previous life would make us
+        # discard every broadcast of the new one forever
+        with self._mu:
+            self._membership_seen = -1
         b = self._addnode_rpc(body, timeout)
-        self.num_workers = int(b["num_workers"])
+        self._apply_membership(b)
         return b
 
     def leave_party(self, timeout: float = 30.0) -> dict:
@@ -304,11 +324,27 @@ class WorkerKVStore:
         had not yet reached completes without it.  Leaving without this
         call stalls every subsequent FSA round forever.  Idempotent
         server-side (a replayed leave does not double-decrement)."""
-        return self._addnode_rpc(
+        b = self._addnode_rpc(
             {"action": "leave", "node": str(self.po.node)}, timeout)
+        self._apply_membership(b)
+        return b
+
+    def _apply_membership(self, body: dict):
+        """Apply an ADD_NODE reply's (num_workers, seq) through the SAME
+        stale-guard as membership broadcasts: a reply built before a
+        racing join/leave must not roll the 1/num_workers pre-scale back
+        after the newer broadcast already landed."""
+        seq = body.get("seq")
+        with self._mu:
+            if seq is not None and seq <= self._membership_seen:
+                return
+            if seq is not None:
+                self._membership_seen = seq
+            self.num_workers = int(body["num_workers"])
 
     def push(self, tid: int, grad: np.ndarray, priority: int = 0,
-             num_merge: int = 1, _count_round: bool = True) -> int:
+             num_merge: int = 1, _count_round: bool = True,
+             body: Optional[dict] = None) -> int:
         """Async push of a gradient (ref: kvstore_dist.h:460-528).
 
         **Aliasing contract (public API)**: when ``grad`` is already
@@ -324,7 +360,10 @@ class WorkerKVStore:
         pushes once for everyone, ref: num_merge counting van.cc:1197-1252).
         """
         flat = np.asarray(grad, dtype=np.float32).ravel()
-        fields = {"body": {"num_merge": int(num_merge)}} if num_merge > 1 else {}
+        body_out = dict(body) if body else {}
+        if num_merge > 1:
+            body_out["num_merge"] = int(num_merge)
+        fields = {"body": body_out} if body_out else {}
         ts = self.worker.zpush(self._encode(tid, flat, priority),
                                cmd=Cmd.DEFAULT, priority=priority, **fields)
         with self._mu:

@@ -34,6 +34,10 @@ class ServerOptimizer:
         self.state: Dict[int, dict] = {}
 
     def update(self, key: int, weight: np.ndarray, grad: np.ndarray) -> np.ndarray:
+        """Return the NEW weight array.  Contract: ``weight`` may be a
+        frozen (``writeable=False``) array aliased by in-flight pull
+        responses — implementations must never write it in place (numpy
+        would raise); build the result functionally or in ``grad``."""
         raise NotImplementedError
 
     def update_scaled(self, key: int, weight: np.ndarray,

@@ -102,6 +102,110 @@ def test_join_mid_round_extends_open_round():
         sim.shutdown()
 
 
+def test_join_bootstrap_pull_does_not_deadlock():
+    """Advisor r4 HIGH: the joiner's natural bootstrap order is pull
+    the current model, THEN push.  Join raises the open round's target
+    to include the joiner, so under the old serving rule (park any pull
+    while count > 0) the joiner's own bootstrap pull parked behind a
+    round that only its push could complete — a deadlock that also
+    wedged the static workers.  Non-contributor pulls are now served
+    from the last completed round, so the bootstrap pull returns
+    immediately even with a round open and waiting for the joiner."""
+    sim = Simulation(Config(
+        topology=Topology(num_parties=1, workers_per_party=2)))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(4, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        g = np.ones(4, np.float32)
+        _round(ws, 0, [g, g])                   # store = -2
+
+        # the join lands first (target -> 3), THEN the static workers
+        # push: the open round now waits for the joiner's contribution
+        w3 = sim.add_worker(0)
+        w3.init(0, np.zeros(4, np.float32))
+        ws[0].push(0, g)
+        ws[1].push(0, g)
+        # the bootstrap pull: the open round (2 of 3) can only complete
+        # with w3's own push — under the old serving rule this parked
+        # forever (and the statics' pulls behind it).  Non-contributors
+        # are now served the last completed round's weights.
+        pulled = w3.pull_sync(0)                # old rule: hangs forever
+        np.testing.assert_allclose(pulled, -2.0 * np.ones(4))
+
+        # the joiner contributes: the waiting round completes for all
+        w3.push(0, g)
+        outs = [w.pull_sync(0) for w in ws + [w3]]
+        for o in outs:
+            np.testing.assert_allclose(o, -5.0 * np.ones(4))
+        for w in ws + [w3]:
+            w.wait_all()
+    finally:
+        sim.shutdown()
+
+
+def test_lagging_worker_pull_serves_last_completed_round():
+    """A worker one round behind (others already pushed round r+1) asks
+    for round r's weights: it must get the store's last-completed value,
+    not park behind the open r+1 round (which its own push feeds)."""
+    sim = Simulation(Config(
+        topology=Topology(num_parties=1, workers_per_party=2)))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(4, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        g = np.ones(4, np.float32)
+        _round(ws, 0, [g, g])                   # round r completes: -2
+        ws[0].push(0, g)                        # r+1 opens (1 of 2)
+        # ws[1] has not contributed to r+1 — its pull gets round r
+        np.testing.assert_allclose(ws[1].pull_sync(0), -2.0 * np.ones(4))
+        ws[1].push(0, g)                        # r+1 completes: -4
+        np.testing.assert_allclose(ws[0].pull_sync(0), -4.0 * np.ones(4))
+        for w in ws:
+            w.wait_all()
+    finally:
+        sim.shutdown()
+
+
+def test_leave_and_push_completion_race_is_single():
+    """Advisor r4 MEDIUM: a push deciding completion (outside the lock)
+    racing a leave that lowers the target must not run _round_complete
+    twice for one key — the second call would crash taking the
+    already-None accumulator.  Hammer the interleaving: many rounds
+    where the last static push and a leave/rejoin land back to back."""
+    import threading
+
+    sim = Simulation(Config(
+        topology=Topology(num_parties=1, workers_per_party=3)))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(64, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.01})
+        g = np.ones(64, np.float32)
+        for _ in range(10):
+            ws[0].push(0, g)
+            ws[1].push(0, g)
+            # racing pair: the completing third push vs a leave that
+            # also sees count >= lowered target
+            t_push = threading.Thread(target=ws[2].push, args=(0, g))
+            t_leave = threading.Thread(target=ws[2].leave_party)
+            t_push.start(); t_leave.start()
+            t_push.join(); t_leave.join()
+            # both statics can still pull (no crashed server thread)
+            out = ws[0].pull_sync(0)
+            assert np.isfinite(out).all()
+            ws[0].wait_all(); ws[1].wait_all(); ws[2].wait_all()
+            # rejoin for the next iteration
+            ws[2].join_party()
+        srv = sim.local_servers[0]
+        assert srv.left_workers == 10 and srv.joined_workers == 10
+    finally:
+        sim.shutdown()
+
+
 def test_leave_restores_count_and_releases_stalled_round():
     """Graceful leave: the target drops at the boundary, and a round the
     leaver never reached completes without it instead of stalling."""
@@ -220,13 +324,145 @@ def test_join_survives_drop_injection():
         sim.shutdown()
 
 
-def test_join_rejected_under_intra_ts():
+def test_join_under_intra_ts():
+    """VERDICT r4 item 6: join used to be rejected under the intra-party
+    TS overlay (fixed member set).  The membership broadcast now updates
+    the TsScheduler's dissemination targets and the TsPushScheduler's
+    pairing threshold, so a joiner both receives overlay relays and
+    participates in the merge tree."""
+    import threading
+
+    import jax
+
+    from geomx_tpu.data import ShardedIterator, synthetic_classification
+    from geomx_tpu.models import create_cnn_state
+    from geomx_tpu.training import run_worker
+
     sim = Simulation(Config(
         topology=Topology(num_parties=1, workers_per_party=2),
         enable_intra_ts=True))
     try:
-        with pytest.raises(RuntimeError, match="unsupported"):
-            sim.add_worker(0)
+        x, y = synthetic_classification(n=256, shape=(8, 8, 1), seed=0)
+        _, params, grad_fn = create_cnn_state(
+            jax.random.PRNGKey(0), input_shape=(1, 8, 8, 1))
+        ws = sim.all_workers()
+        ws[0].set_optimizer({"type": "adam", "lr": 0.01})
+        hist = {}
+
+        def train(kv, widx, nw, steps):
+            it = ShardedIterator(x, y, 16, widx, nw, seed=1)
+            hist[widx] = run_worker(kv, params, grad_fn, it, steps,
+                                    barrier_init=False)
+
+        ths = [threading.Thread(target=train, args=(w, i, 2, 2))
+               for i, w in enumerate(ws)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=120)
+        assert len(hist) == 2, "static TS round hung"
+
+        w3 = sim.add_worker(0)
+        # scheduler member sets tracked the join
+        for sched in sim.ts_schedulers:
+            assert str(w3.po.node) in sched.members
+        ths = [threading.Thread(target=train, args=(w, i, 3, 2))
+               for i, w in enumerate(ws + [w3])]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=120)
+        assert len(hist) == 3, "post-join TS round hung"
+        assert len(hist[2]) == 2  # the joiner trained full rounds
+        assert np.isfinite([h[0] for h in hist[2]]).all()
+    finally:
+        sim.shutdown()
+
+
+def test_join_under_hfa_renormalizes_weight_mean():
+    """VERDICT r4 item 6: join under HFA.  Workers push weight/n; a
+    transition round mixes denominators (statics at old n, joiner at
+    new n) and a leave can complete a round short — either way the
+    accumulated Σ w_i/n_i is renormalized by Σ 1/n_i (announced per
+    push as hfa_n), so the party 'mean' stays a convex combination and
+    the weights are never scale-inflated."""
+    sim = Simulation(Config(
+        topology=Topology(num_parties=1, workers_per_party=2),
+        use_hfa=True, hfa_k2=1))
+    try:
+        ws = sim.all_workers()
+        w_val = 6.0 * np.ones(4, np.float32)
+        for w in ws:
+            w.init(0, w_val.copy())
+        # HFA round at n=2: both push w/2 with hfa_n=2 -> mean = 6
+        for w in ws:
+            w.push(0, w_val / 2, body={"hfa_n": 2})
+        np.testing.assert_allclose(ws[0].pull_sync(0), w_val)
+        for w in ws:
+            w.wait_all()
+
+        w3 = sim.add_worker(0)
+        w3.init(0, w_val.copy())
+        assert w3.num_workers == 3
+        # transition round: statics still at n=2 (stale pre-scale),
+        # joiner at n=3.  Unnormalized sum = 6/2+6/2+6/3 = 8 (a 1.33x
+        # weight inflation); renormalized by S = 1/2+1/2+1/3 -> 6.
+        ws[0].push(0, w_val / 2, body={"hfa_n": 2})
+        ws[1].push(0, w_val / 2, body={"hfa_n": 2})
+        w3.push(0, w_val / 3, body={"hfa_n": 3})
+        np.testing.assert_allclose(ws[0].pull_sync(0), w_val, rtol=1e-6)
+        for w in ws + [w3]:
+            w.wait_all()
+
+        # leave completes a round short: 2 of 3 pushed, leaver exits.
+        # Σ w/3 * 2 = 4 would SHRINK the weights; renormalized -> 6.
+        ws[0].push(0, w_val / 3, body={"hfa_n": 3})
+        ws[1].push(0, w_val / 3, body={"hfa_n": 3})
+        w3.leave_party()
+        np.testing.assert_allclose(ws[0].pull_sync(0), w_val, rtol=1e-6)
+        for w in ws:
+            w.wait_all()
+    finally:
+        sim.shutdown()
+
+
+def test_party_leave_lowers_global_tier_target():
+    """VERDICT r4 item 6: graceful PARTY leave.  The global tier's
+    aggregation target (num_global_workers) drops at the round
+    boundary; a round the leaving party never reached completes with
+    the remaining parties instead of stalling forever.  (The
+    reference's global membership is static; recovery is a TODO at
+    van.cc:224 — this goes beyond it.)"""
+    sim = Simulation(Config(
+        topology=Topology(num_parties=3, workers_per_party=1)))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(4, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        g = np.ones(4, np.float32)
+        # global tier applies the PARTY mean: -lr * (3g)/3 = -1
+        outs = _round(ws, 0, [g, g, g])
+        np.testing.assert_allclose(outs[0], -1.0 * np.ones(4))
+
+        # parties 0 and 1 push the next round; party 2 leaves instead
+        ws[0].push(0, g)
+        ws[1].push(0, g)
+        res = sim.local_servers[2].leave_global()
+        for gs_reply in res.values():
+            assert gs_reply["num_global_workers"] == 2
+        # the stalled round completes with two parties: -(2g)/2 = -1
+        np.testing.assert_allclose(ws[0].pull_sync(0), -2.0 * np.ones(4))
+        ws[0].wait_all(); ws[1].wait_all()
+
+        # subsequent rounds count 2 parties
+        outs = _round(ws[:2], 0, [g, g])
+        np.testing.assert_allclose(outs[0], -3.0 * np.ones(4))
+
+        # replayed leave is idempotent
+        res = sim.local_servers[2].leave_global()
+        for gs_reply in res.values():
+            assert gs_reply["num_global_workers"] == 2
     finally:
         sim.shutdown()
 
