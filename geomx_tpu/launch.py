@@ -243,12 +243,23 @@ def _worker_demo(po, kv, args, join_advertise=None):
 
     from geomx_tpu.data import ShardedIterator, synthetic_classification
     from geomx_tpu.models import create_cnn_state
-    from geomx_tpu.training import run_worker
+    from geomx_tpu.training import run_worker, run_worker_hfa
 
     joining = join_advertise is not None or args.join
     x, y = synthetic_classification(n=512, shape=(12, 12, 1), seed=0)
     _, params, grad_fn = create_cnn_state(
         jax.random.PRNGKey(0), input_shape=(1, 12, 12, 1))
+
+    def train(kv, params, it, steps, barrier_init):
+        # HFA servers average WEIGHTS — pushing gradients at them (the
+        # pre-r5 --hfa path) silently replaced the model with a mean
+        # gradient.  The HFA client loop is the only correct driver.
+        if args.hfa:
+            return run_worker_hfa(kv, params, grad_fn, it, steps,
+                                  k1=args.hfa_k1,
+                                  barrier_init=barrier_init)
+        return run_worker(kv, params, grad_fn, it, steps,
+                          barrier_init=barrier_init)
     if joining:
         info = kv.join_party(advertise=join_advertise)
         print(f"{po.node}: joined as rank {info['rank']} "
@@ -273,8 +284,7 @@ def _worker_demo(po, kv, args, join_advertise=None):
         widx, num_all = kv.party * kv.num_workers + kv.rank, \
             kv.num_all_workers
     it = ShardedIterator(x, y, args.batch, widx, num_all)
-    hist = run_worker(kv, params, grad_fn, it, args.steps,
-                      barrier_init=not joining)
+    hist = train(kv, params, it, args.steps, barrier_init=not joining)
     if joining:
         kv.wait_all()
         kv.leave_party()
@@ -458,6 +468,10 @@ def main(argv=None):
                          "for TCP so peers can dial the new slot")
     ap.add_argument("--compression", default="none")
     ap.add_argument("--hfa", action="store_true")
+    ap.add_argument("--hfa-k1", type=int,
+                    default=int(os.environ.get("GEOMX_HFA_K1", "2")),
+                    help="HFA local steps between weight syncs "
+                         "(ref: MXNET_KVSTORE_HFA_K1)")
     ap.add_argument("--esync", action="store_true",
                     help="straggler-balancing local steps (HFA-mode "
                          "servers + per-round step assignment)")
@@ -480,10 +494,13 @@ def main(argv=None):
         # lm workload pushes GRADIENTS — dispatching it against HFA
         # servers would silently train garbage
         ap.error("--workload lm is mutually exclusive with --esync/--hfa")
-    if args.join and (args.esync or args.hfa or args.p3
-                      or args.tsengine or args.workload != "cnn"):
-        ap.error("--join supports the plain cnn workload only (TS/HFA "
-                 "member sets are fixed; see LocalServer._on_add_node)")
+    if args.join and (args.esync or args.p3 or args.workload != "cnn"):
+        # TS and HFA joins are supported (membership broadcasts update
+        # the schedulers' member sets; hfa_n renormalizes the weight
+        # mean) — esync's per-round step plan and p3's staged loop
+        # don't have a joiner bootstrap yet
+        ap.error("--join supports the cnn workload (plain, --hfa or "
+                 "--tsengine); not esync/p3/lm")
     if args.join and not args.advertise:
         # without an advertised bind address the out-of-plan node has no
         # slot in the TCP plan and dies with a bare KeyError at bind

@@ -56,7 +56,29 @@ class TsPushScheduler:
         self._mu = threading.Lock()
         # iter -> list of (asker Message, num_merge, enqueue_time)
         self._pending: Dict[int, List[Tuple[Message, int, float]]] = {}
+        self._member_seq = -1
+        postoffice.add_control_hook(self._on_membership)
         postoffice.add_control_hook(self._on_control)
+
+    def _on_membership(self, msg: Message) -> bool:
+        """Track the party's live worker count (seq-stamped broadcast
+        from the server): ``num_merge >= num_workers`` is the "holder
+        has everything, go to the server" decision, so a stale count
+        under dynamic membership either elects too early (a joiner's
+        contribution rides the NEXT round) or never (leaver counted
+        forever -> every holder waits out the pairing TTL)."""
+        body = msg.body if isinstance(msg.body, dict) else {}
+        if (msg.control is not Control.ADD_NODE or msg.request
+                or body.get("event") != "membership"):
+            return False
+        seq = body.get("seq")
+        with self._mu:
+            if seq is not None and seq <= self._member_seq:
+                return False  # stale; let other hooks see it too
+            if seq is not None:
+                self._member_seq = seq
+            self.num_workers = int(body["num_workers"])
+        return False  # not exclusive: the pull scheduler consumes it too
 
     def _on_control(self, msg: Message) -> bool:
         import time as _time
@@ -66,6 +88,15 @@ class TsPushScheduler:
         body = msg.body or {}
         it = body.get("iter", 0)  # any hashable round token (int or str)
         nm = int(body.get("num_merge", 1))
+        # pairing bucket: STRING tokens (the inter-party servers' per-key
+        # "key:round" form) pair exactly; INTEGER tokens are per-worker
+        # call counters, which drift across dynamic membership (a joiner
+        # starts at 1 while statics are at round r) — but worker-tier
+        # participants are always in the same BSP round (no worker can
+        # advance before the round completes), so one shared bucket is
+        # safe and keeps a joiner pair-able instead of timing out every
+        # round's TTL
+        bucket = it if isinstance(it, str) else "__worker_round__"
         replies = []
         now = _time.monotonic()
         with self._mu:
@@ -77,25 +108,27 @@ class TsPushScheduler:
                                     if now - e[2] < self.pending_ttl_s]
                 if not self._pending[k]:
                     del self._pending[k]
-            pend = self._pending.setdefault(it, [])
+            pend = self._pending.setdefault(bucket, [])
             if nm >= self.num_workers:
                 # this node holds everything → send to server
-                replies.append((msg, {"action": "server"}))
-                self._pending.pop(it, None)
+                replies.append((msg, {"action": "server", "iter": it}))
+                self._pending.pop(bucket, None)
             elif pend:
-                other, other_nm, _ = pend.pop(0)
-                # the longer-waiting node receives; the newcomer sends
+                other, other_nm, _t, other_it = pend.pop(0)
+                # the longer-waiting node receives; the newcomer sends.
+                # Each reply echoes ITS asker's own token — that is what
+                # the asker's waiter is keyed on (cross-token pairing
+                # would otherwise strand the older asker)
                 replies.append((other, {"action": "recv",
                                         "peer": str(msg.sender),
-                                        "num_merge": other_nm + nm}))
+                                        "num_merge": other_nm + nm,
+                                        "iter": other_it}))
                 replies.append((msg, {"action": "send",
-                                      "peer": str(other.sender)}))
+                                      "peer": str(other.sender),
+                                      "peer_iter": other_it, "iter": it}))
             else:
-                pend.append((msg, nm, now))
+                pend.append((msg, nm, now, it))
         for req, body_out in replies:
-            # echo the round token so concurrent per-key merges on one
-            # node can route the reply to the right waiter
-            body_out["iter"] = it
             self.po.van.send(req.reply_to(control=Control.REPLY,
                                           body=body_out))
         return True
@@ -257,8 +290,12 @@ class TsPushWorker:
             if action == "server":
                 return grads, num_merge
             if action == "send":
+                # label the relay with the RECEIVER's round token (the
+                # scheduler echoes it as peer_iter): the receiver's
+                # waiter is keyed on its own counter, which can differ
+                # from ours under dynamic membership
                 self._send_grads(NodeId.parse(reply["peer"]), grads,
-                                 num_merge, it)
+                                 num_merge, reply.get("peer_iter", it))
                 return None
             # recv: wait for the peer's set, merge (ref: WorkersMerge —
             # elementwise sum of contributions), carry the summed count

@@ -79,7 +79,6 @@ def run_worker_hfa(
         kv.init(tid, leaf, barrier=barrier_init)
     params = unflatten_params(treedef, leaves)
     opt_state = optimizer.init(params)
-    n = kv.num_workers
     history: List[Tuple[float, float]] = []
     buf: List[Optional[np.ndarray]] = [None] * len(leaves)
 
@@ -95,7 +94,7 @@ def run_worker_hfa(
             params = _optax.apply_updates(params, updates)
         if (step + 1) % k1 == 0:
             params, _ = _hfa_sync_round(kv, params, treedef, len(leaves),
-                                        buf, n, m)
+                                        buf, m)
         m.step_end()
         history.append((float(loss), float(acc)))
         if log_fn is not None:
@@ -105,7 +104,7 @@ def run_worker_hfa(
     return history
 
 
-def _hfa_sync_round(kv, params, treedef, n_leaves, buf, n, m,
+def _hfa_sync_round(kv, params, treedef, n_leaves, buf, m,
                     measure_comm: bool = False):
     """One weight-exchange sync: push party-mean weights, pull the
     merged result (shared by the HFA and ESync loops — one place for
@@ -121,9 +120,15 @@ def _hfa_sync_round(kv, params, treedef, n_leaves, buf, n, m,
 
     w_leaves, _ = jax.tree_util.tree_flatten(params)
     comm_s = None
+    # re-read the party size EVERY sync: dynamic join/leave moves it
+    # mid-training (membership broadcast -> kv.num_workers), and the
+    # denominator each push used is announced as ``hfa_n`` so the
+    # server can renormalize a transition round's mixed-scale mean
+    n = kv.num_workers
     t1 = _time.perf_counter()
     with m.phase("push"):
-        push_ts = [kv.push(tid, np.asarray(w) / n, priority=-tid)
+        push_ts = [kv.push(tid, np.asarray(w) / n, priority=-tid,
+                           body={"hfa_n": n})
                    for tid, w in enumerate(w_leaves)]
         if measure_comm:
             for pts in push_ts:
@@ -231,7 +236,6 @@ def run_worker_esync(
         kv.init(tid, leaf, barrier=barrier_init)
     params = unflatten_params(treedef, leaves)
     opt_state = optimizer.init(params)
-    n = kv.num_workers
     history: List[Tuple[float, float]] = []
     buf: List[Optional[np.ndarray]] = [None] * len(leaves)
 
@@ -256,7 +260,7 @@ def run_worker_esync(
                 history.append((float(loss), float(acc)))
         step_s = (_time.perf_counter() - t0) / max(ran, 1)
         params, comm_s = _hfa_sync_round(kv, params, treedef, len(leaves),
-                                         buf, n, m, measure_comm=True)
+                                         buf, m, measure_comm=True)
         m.step_end()
         if rounds_out is not None:
             # acceptance observable: (assigned local steps, reach-server
