@@ -905,30 +905,48 @@ tokens = jax.random.randint(jax.random.PRNGKey(1), (n, cfg.max_seq), 0,
 from jax.sharding import NamedSharding, PartitionSpec as P
 tokens = jax.device_put(tokens, NamedSharding(mesh, P("dp", None)))
 
-def step(carry, _):
-    p, s = carry
-    loss, g = jax.value_and_grad(lambda p_: lm_loss(apply_fn, p_, tokens))(p)
-    u, s = tx.update(g, s, p)
-    return (optax.apply_updates(p, u), s), loss
-
+# tokens MUST be a jit argument, not a closure: a closed-over array is
+# baked into the module as a (replicated) constant, which silently
+# un-shards the batch — every device then computes the full batch with
+# ZERO collectives and the scaling points measure nothing (r5 bug:
+# the audit's all-reduce count of 0 exposed it)
 @functools.partial(jax.jit, donate_argnums=(0, 1))
-def run(p, s):
+def run(p, s, tok):
+    def step(carry, _):
+        p_, s_ = carry
+        loss, g = jax.value_and_grad(
+            lambda pp: lm_loss(apply_fn, pp, tok))(p_)
+        u, s_ = tx.update(g, s_, p_)
+        return (optax.apply_updates(p_, u), s_), loss
     (p, s), losses = jax.lax.scan(step, (p, s), None, length=4)
     return p, s, losses[-1]
 
+# per-point collective audit on the OPTIMIZED HLO (VERDICT r4 item 7):
+# the collective mix must scale as expected as the mesh grows — the
+# all-reduce count per step stays constant under pure dp weak scaling
+# (one grad reduction per pytree fusion group, independent of n), and
+# no sharded-size all-gather may exceed the regression bound
+from geomx_tpu.utils.hlo import collective_counts, large_gathers
 t0 = time.perf_counter()
-params, opt, loss = run(params, opt)
-_ = float(loss)
+lowered = run.lower(params, opt, tokens)
+compiled = lowered.compile()
 compile_s = time.perf_counter() - t0
+hlo = compiled.as_text()
+audit = {"collectives": collective_counts(hlo),
+         "large_gathers": large_gathers(hlo, threshold_bytes=16 * 1024)}
+
+params, opt, loss = compiled(params, opt, tokens)  # warmup execute
+_ = float(loss)
 best = float("inf")
-for _ in range(2):
+for _ in range(3):                          # >= 3 timed reps per point
     t0 = time.perf_counter()
-    params, opt, loss = run(params, opt)
+    params, opt, loss = compiled(params, opt, tokens)
     _ = float(loss)
     best = min(best, time.perf_counter() - t0)
 print(json.dumps({"devices": n, "compile_s": round(compile_s, 2),
                   "step_wall_s": round(best / 4, 4),
-                  "loss_finite": bool(jnp.isfinite(loss))}))
+                  "loss_finite": bool(jnp.isfinite(loss)),
+                  "audit": audit}))
 """
 
 
@@ -952,7 +970,15 @@ def child_scaling():
     from geomx_tpu.training import build_flagship_lm
 
     measured = []
-    for n in (8, 16, 32):
+    t_start = time.monotonic()
+    points_budget = float(os.environ.get("BENCH_SCALING_POINTS_S", "200"))
+    for n in (8, 16, 32, 64):
+        if time.monotonic() - t_start > points_budget - 30:
+            # the modeled half (instant) must always land — drop the
+            # remaining points, visibly, instead of timing out the child
+            measured.append({"devices": n,
+                             "error": "skipped: scaling points budget"})
+            continue
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["JAX_PLATFORM_NAME"] = "cpu"
@@ -960,16 +986,33 @@ def child_scaling():
                             + f" --xla_force_host_platform_device_count={n}"
                             ).strip()
         try:
-            # 80 s per point: 3 points must fit the orchestrator's 300 s
-            # child budget WITH the modeled half — one slow compile must
-            # cost its point, not the whole scaling artifact
+            # 70 s per point: 4 points must fit the orchestrator's child
+            # budget WITH the modeled half — one slow compile must cost
+            # its point, not the whole scaling artifact
             out = subprocess.run(
                 [sys.executable, "-c", _SCALING_INNER], env=env,
-                capture_output=True, text=True, timeout=80, cwd=ROOT)
+                capture_output=True, text=True, timeout=70, cwd=ROOT)
             row = json.loads(out.stdout.strip().splitlines()[-1])
         except (subprocess.SubprocessError, ValueError, IndexError) as e:
             row = {"devices": n, "error": f"{type(e).__name__}: {e}"[:160]}
         measured.append(row)
+    # cross-point collective-mix invariant (VERDICT r4 item 7): under
+    # pure-dp weak scaling the per-step all-reduce count must NOT grow
+    # with the mesh — growth would mean GSPMD re-partitioned the step
+    # into per-device reductions (a scaling bug the wall clocks of a
+    # shared-core host can't see)
+    ar_counts = {r["devices"]: r["audit"]["collectives"].get(
+        "all-reduce", 0) for r in measured if "audit" in r}
+    # constant AND non-zero: zero all-reduces would mean the batch was
+    # silently un-sharded (exactly the baked-in-constant bug this audit
+    # caught in r5) — not a healthy scaling point
+    audit_ok = (len(set(ar_counts.values())) <= 1
+                and all(c > 0 for c in ar_counts.values())
+                ) if ar_counts else None
+    # None (not a vacuous True) when no point produced an audit
+    gather_free = (all(not r["audit"]["large_gathers"]
+                       for r in measured if "audit" in r)
+                   if ar_counts else None)
 
     # ---- modeled 8 -> 256-chip curve -----------------------------------
     cfg, _params, n_params, _g, _d = build_flagship_lm()
@@ -998,81 +1041,178 @@ def child_scaling():
 
     CHIPS_PER_PARTY = 8          # one v5e-8 slice per data center
     V5E_ICI_BW = 100e9           # B/s effective allreduce BW per chip
-    DCN_BW = 1.25e9              # 10 Gbps inter-DC WAN per party
+    M_GLOBAL = 4                 # MultiGPS global servers (tier-2 shards)
+    OVERLAP_MEASURED = 1.53      # staged-loop speedup vs serial, SIM-
+    #                              measured (overlap child) — NOT on-chip
     grad_bytes = n_params * 2    # bf16 grads on ICI
 
-    def t_step(chips, compressed=True, overlap=True, k2=1):
-        """Per-round wall.  ``k2``: HFA gate — the WAN hop fires every
-        k2-th round (ref MXNET_KVSTORE_USE_HFA/K2), amortizing t_dcn."""
+    def t_step(chips, compressed, overlap, k2, mfu_v, dcn):
+        """Per-round wall under one (mfu, dcn, overlap-model) scenario.
+
+        ``k2``: HFA gate — the WAN hop fires every k2-th round (ref
+        MXNET_KVSTORE_USE_HFA/K2), amortizing t_dcn.  The WAN term takes
+        the max of the per-party uplink and the GLOBAL-TIER INGRESS:
+        all parties' push-ups land on M_GLOBAL MultiGPS shards, so once
+        parties > M_GLOBAL x (uplink/ingress ratio) the central party's
+        aggregate bandwidth is the bottleneck — modeled, not assumed
+        away (VERDICT r4 weak 2).  ``overlap``: "sum" = no hiding,
+        "max" = perfect P3 hiding, "measured" = the sim-measured 1.53x
+        staged-loop speedup applied to the serial sum (clamped at the
+        perfect-hiding floor)."""
         parties = max(1, chips // CHIPS_PER_PARTY)
         s = min(chips, CHIPS_PER_PARTY)
-        t_comp = flops_chip / (mfu * V5E_PEAK_BF16)
+        t_comp = flops_chip / (mfu_v * V5E_PEAK_BF16)
         t_ici = 2 * grad_bytes * (s - 1) / s / V5E_ICI_BW
         b_dir = wan_party_dir if compressed else n_params * 4
-        # each party's WAN link runs in parallel; MultiGPS shards the
-        # global tier so its ingress scales with the party count and
-        # never becomes the bottleneck term here
-        t_dcn = (2 * b_dir / DCN_BW if parties > 1 else 0.0) / k2
-        if overlap:  # P3 staged overlap hides comm behind compute
-            return max(t_comp, t_ici + t_dcn)
-        return t_comp + t_ici + t_dcn
+        if parties > 1:
+            per_dir = max(b_dir / dcn,                    # party uplink
+                          parties * b_dir / (M_GLOBAL * dcn))  # ingress
+            t_dcn = 2 * per_dir / k2
+        else:
+            t_dcn = 0.0
+        t_comm = t_ici + t_dcn
+        if overlap == "max":
+            return max(t_comp, t_comm)
+        if overlap == "measured":
+            return max(max(t_comp, t_comm),
+                       (t_comp + t_comm) / OVERLAP_MEASURED)
+        return t_comp + t_comm
+
+    # sensitivity grid (VERDICT r4 item 2): mfu x DCN x overlap-model.
+    # 0.43 is the r2 builder-reported on-chip MFU (unverified), 0.30 the
+    # roofline's standing assumption, 0.20 a pessimistic floor.
+    MFU_GRID = (0.20, 0.30, 0.43)
+    DCN_GRID = (0.5e9, 1.25e9, 5e9)
+    OVERLAP_GRID = ("sum", "max", "measured")
 
     # four cumulative feature tiers — the framework's WAN features are
-    # exactly what keeps weak-scaling efficiency up once parties > 1
+    # exactly what keeps weak-scaling efficiency up once parties > 1.
+    # Non-overlap tiers pin overlap="sum"; overlap tiers sweep it.
     tiers = {
-        "dense_bsp": dict(compressed=False, overlap=False, k2=1),
-        "mpq": dict(compressed=True, overlap=False, k2=1),
-        "mpq_p3_overlap": dict(compressed=True, overlap=True, k2=1),
-        "mpq_p3_hfa_k2_8": dict(compressed=True, overlap=True, k2=8),
+        "dense_bsp": dict(compressed=False, k2=1, overlaps=("sum",)),
+        "mpq": dict(compressed=True, k2=1, overlaps=("sum",)),
+        "mpq_p3_overlap": dict(compressed=True, k2=1,
+                               overlaps=OVERLAP_GRID),
+        "mpq_p3_hfa_k2_8": dict(compressed=True, k2=8,
+                                overlaps=OVERLAP_GRID),
     }
+
+    def eff_band(chips, tier):
+        effs = [t_step(8, tier["compressed"], ov, tier["k2"], m, d)
+                / t_step(chips, tier["compressed"], ov, tier["k2"], m, d)
+                for m in MFU_GRID for d in DCN_GRID
+                for ov in tier["overlaps"]]
+        effs.sort()
+        return {"min": round(effs[0], 4),
+                "median": round(effs[len(effs) // 2], 4),
+                "max": round(effs[-1], 4)}
+
     curve = []
     for chips in (8, 16, 32, 64, 128, 256):
         row = {"chips": chips, "parties": max(1, chips // CHIPS_PER_PARTY)}
-        for name, kw in tiers.items():
-            row[f"efficiency_{name}"] = round(
-                t_step(8, **kw) / t_step(chips, **kw), 4)
+        for name, tier in tiers.items():
+            row[f"efficiency_{name}"] = eff_band(chips, tier)
         curve.append(row)
     # the reference's headline comparison (README.md:12 "up to 20x vs
-    # vanilla MXNet PS"): full WAN feature stack vs dense BSP at scale
-    full_vs_vanilla = round(
-        t_step(256, compressed=False, overlap=False, k2=1)
-        / t_step(256, **tiers["mpq_p3_hfa_k2_8"]), 2)
+    # vanilla MXNet PS"): full WAN feature stack vs dense BSP at scale,
+    # quoted as a BAND across the sensitivity grid with the worst case
+    # first (honest counterpart of the reference's "up to")
+    ratios = sorted(
+        t_step(256, False, "sum", 1, m, d)
+        / t_step(256, True, ov, 8, m, d)
+        for m in MFU_GRID for d in DCN_GRID for ov in OVERLAP_GRID)
+    full_vs_vanilla = {
+        "worst": round(ratios[0], 2),
+        "median": round(ratios[len(ratios) // 2], 2),
+        "best": round(ratios[-1], 2),
+    }
 
     print(json.dumps({
         "measured_virtual_mesh": {
             "points": measured,
+            "allreduce_count_constant_across_mesh": audit_ok,
+            "allreduce_counts": ar_counts,
+            "no_large_gathers": gather_free,
             "semantics": ("real GSPMD sharding + XLA collectives on "
                           "virtual CPU devices sharing ONE core: proves "
                           "the sharded step compiles/runs at each mesh "
-                          "size, NOT chip throughput"),
+                          "size with the expected collective mix, NOT "
+                          "chip throughput"),
         },
         "modeled_roofline": {
             "workload": (f"flagship LM {n_params / 1e6:.1f}M params, "
                          f"batch {batch_per_chip}/chip seq {cfg.max_seq}, "
                          "weak scaling"),
             "topology": f"{CHIPS_PER_PARTY}-chip v5e slice per party "
-                        "(ICI psum) + HiPS WAN tier (MPQ) per party",
+                        "(ICI psum) + HiPS WAN tier (MPQ) per party; "
+                        f"global tier = {M_GLOBAL} MultiGPS shards with "
+                        "an explicit ingress term",
             "curve": curve,
+            "curve_semantics": ("each efficiency is a min/median/max "
+                                "BAND over the sensitivity grid "
+                                "mfu x dcn x overlap-model"),
             "full_stack_vs_dense_bsp_speedup_at_256": full_vs_vanilla,
             "reference_claim": "up to 20x vs vanilla PS "
                                "(reference README.md:12)",
+            "sensitivity_grid": {
+                "mfu": list(MFU_GRID),
+                "dcn_Bps": list(DCN_GRID),
+                "overlap_models": list(OVERLAP_GRID),
+                "note": ("0.43 = r2 builder-reported on-chip MFU "
+                         "(unverified), 0.30 = standing assumption, "
+                         "0.20 = pessimistic floor; overlap 'measured' "
+                         "= sim-measured 1.53x staged-loop speedup"),
+            },
+            "hfa_staleness_cost": {
+                "note": ("k2=8 divides WAN rounds by 8 at a CONVERGENCE "
+                         "cost, not for free: the long-horizon parity "
+                         "child trains hfa_k2_8 vs vanilla for 200 "
+                         "steps — see the parity block's "
+                         "accuracy_delta_vs_vanilla for the measured "
+                         "cost at the demo scale"),
+            },
             "calibration": {
-                "mfu": {"value": mfu, "source": mfu_src},
+                "mfu": {"value": mfu, "source": mfu_src,
+                        "role": "center of the sensitivity grid only"},
                 "wan_bytes_party_per_dir": {
                     "value": round(wan_party_dir, 1), "source": wan_src},
             },
             "assumptions": {
                 "ici_allreduce_bw_per_chip_Bps": V5E_ICI_BW,
-                "dcn_bw_per_party_Bps": DCN_BW,
                 "v5e_peak_bf16_flops": V5E_PEAK_BF16,
-                "overlap": "P3 staged overlap hides comm behind compute "
-                           "(max instead of sum; sim-measured 1.4x, see "
-                           "overlap child)",
+                "multigps_global_servers": M_GLOBAL,
             },
             "semantics": "MODELED, not measured — roofline with the "
                          "stated assumptions; measured inputs only where "
-                         "labeled",
+                         "labeled; efficiencies carry sensitivity bands",
         },
+    }))
+
+
+def child_parity():
+    """Long-horizon convergence parity (VERDICT r4 item 3; ref:
+    examples/cnn.py:128-131 accuracy-as-oracle, SURVEY §4.3): 200-step
+    runs of every WAN feature vs vanilla on the identical model/data/
+    seed; reports per-config FINAL held-out accuracy and the delta.
+    The same harness gates the test suite
+    (tests/test_parity_horizon.py) — one code path, two consumers."""
+    from geomx_tpu.utils.parity import run_parity_matrix
+
+    results = run_parity_matrix(steps=200)
+    worst = None
+    for name, r in results.items():
+        d = r.get("accuracy_delta_vs_vanilla")
+        if d is not None and (worst is None or d < worst[1]):
+            worst = (name, d)
+    print(json.dumps({
+        "configs": results,
+        "steps": 200,
+        "worst_delta": {"config": worst[0], "delta": worst[1]}
+        if worst else None,
+        "semantics": ("final held-out accuracy after 200 steps through "
+                      "the 2-party HiPS stack, per WAN feature, vs the "
+                      "vanilla run (same model/data/seed); negative "
+                      "delta = the feature costs accuracy at horizon"),
     }))
 
 
@@ -1119,6 +1259,11 @@ def child_stress():
                 len(ws) * (N * 4 / 1e9) * rounds / dt, 3),
             "native_axpy_gb_per_s": round((N * 4 / 1e9) / axpy_dt, 2),
             "native_available": bindings.available(),
+            # auto-calibrated merge backend: "numpy" means the native
+            # threaded path measured slower on this host (e.g. a 1-core
+            # cpuset) and disabled itself — never a pessimization
+            # (VERDICT r4 weak 7)
+            "axpy_backend": bindings.axpy_backend(),
         }))
     finally:
         sim.shutdown()
@@ -1333,7 +1478,8 @@ def _build_record() -> dict:
                       ("overlap_tpu", "overlap_tpu"),
                       ("flash_autotune", "flash_autotune"),
                       ("stress", "stress"), ("lm", "lm"),
-                      ("scaling", "scaling"), ("probe", "probe")):
+                      ("scaling", "scaling"), ("parity", "parity"),
+                      ("probe", "probe")):
         if name in _results:
             record[key] = _results[name]
         elif name in TPU_CHILDREN and name in lkg:
@@ -1354,11 +1500,60 @@ def _build_record() -> dict:
     return record
 
 
+DETAIL_PATH = ROOT / "BENCH_DETAIL.json"
+
+
+def _compact(record: dict) -> dict:
+    """The driver snapshots only the TAIL of stdout (BENCH_r04's 'tail'
+    is 2000 chars and its 'parsed' came up empty because the full record
+    outgrew it), so the LAST line must be a compact, self-contained
+    headline; the full record lives in BENCH_DETAIL.json in the repo."""
+    out = {k: record.get(k) for k in (
+        "metric", "value", "unit", "vs_baseline", "vs_modeled_a100",
+        "value_source") if record.get(k) is not None}
+    wan = record.get("wan") or {}
+    if wan.get("reduction"):
+        out["wan_reduction"] = wan["reduction"]
+    lm = record.get("lm") or {}
+    if lm.get("tokens_per_sec"):
+        out["lm_tokens_per_sec"] = lm["tokens_per_sec"]
+    sc = ((record.get("scaling") or {}).get("modeled_roofline") or {})
+    if sc.get("full_stack_vs_dense_bsp_speedup_at_256"):
+        out["full_stack_vs_dense_bsp_at_256_band"] = sc[
+            "full_stack_vs_dense_bsp_speedup_at_256"]
+    mesh = ((record.get("scaling") or {}).get("measured_virtual_mesh")
+            or {})
+    if mesh.get("allreduce_count_constant_across_mesh") is not None:
+        out["mesh_audit_ok"] = (
+            mesh["allreduce_count_constant_across_mesh"]
+            and mesh.get("no_large_gathers"))
+    par = record.get("parity") or {}
+    if par.get("worst_delta"):
+        out["parity_worst_accuracy_delta"] = par["worst_delta"]
+    if record.get("errors"):
+        out["errors"] = {k: str(v)[:80] for k, v in
+                         record["errors"].items()}
+    out["elapsed_s"] = record.get("elapsed_s")
+    out["detail_file"] = DETAIL_PATH.name
+    return out
+
+
 def _emit():
-    """Print the current full record as one JSON line (last line wins)."""
+    """Persist the full record to BENCH_DETAIL.json and print the
+    compact headline as one JSON line (last line wins)."""
     with _lock:
-        line = json.dumps(_build_record())
-    sys.stdout.write(line + "\n")
+        # write+replace INSIDE the lock: _emit runs concurrently from
+        # the cpu_chain thread and the TPU/main thread, and two threads
+        # sharing one PID-keyed temp path would tear the detail file
+        record = _build_record()
+        try:
+            tmp = DETAIL_PATH.with_suffix(
+                f".json.{os.getpid()}.{threading.get_ident()}.tmp")
+            tmp.write_text(json.dumps(record, indent=1))
+            tmp.replace(DETAIL_PATH)
+        except OSError:
+            pass  # detail is best-effort; the stdout line must go out
+    sys.stdout.write(json.dumps(_compact(record)) + "\n")
     sys.stdout.flush()
 
 
@@ -1476,7 +1671,7 @@ def main():
     ap.add_argument("--child",
                     choices=["cnn", "mfu", "mfu_sweep", "quant", "wan",
                              "overlap", "overlap_tpu", "stress", "probe",
-                             "flash_autotune", "lm", "scaling"])
+                             "flash_autotune", "lm", "scaling", "parity"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -1500,6 +1695,7 @@ def main():
          "quant": child_quant, "wan": child_wan, "overlap": child_overlap,
          "overlap_tpu": child_overlap_tpu, "stress": child_stress,
          "probe": child_probe, "lm": child_lm, "scaling": child_scaling,
+         "parity": child_parity,
          "flash_autotune": child_flash_autotune}[args.child]()
         return
 
@@ -1535,9 +1731,14 @@ def main():
         if locked_do("probe", 180):
             platform = _results.get("probe", {}).get("platform")
             if platform not in ("cpu", None):
-                for child, t in (("cnn", 300), ("mfu", 300),
-                                 ("quant", 180), ("overlap_tpu", 240),
-                                 ("flash_autotune", 240)):
+                # exactness-first: quant (on-chip 2-bit round-trip
+                # assert) and flash_autotune (per-hop winner validated
+                # against the einsum reference) land correctness
+                # evidence even if the tunnel window closes before the
+                # perf children finish (VERDICT r4 item 8)
+                for child, t in (("quant", 180), ("flash_autotune", 240),
+                                 ("cnn", 300), ("mfu", 300),
+                                 ("overlap_tpu", 240)):
                     if not locked_do(child, t):
                         break
         return
@@ -1561,17 +1762,18 @@ def main():
     def cpu_chain():
         # flagship metrics first: under a tight driver deadline the tail
         # children are the ones clipped
-        _do("wan", 240, cpu_env)
-        _do("lm", 240, cpu_env)
+        _do("wan", 180, cpu_env)
+        _do("lm", 210, cpu_env)
         # scaling's roofline is calibrated by the lm child's measured
         # WAN ledger when available
         scaling_env = dict(cpu_env)
         lm_wan = _results.get("lm", {}).get("wan_bytes_per_step")
         if lm_wan:
             scaling_env["BENCH_LM_WAN_BYTES_PER_STEP"] = str(lm_wan)
-        _do("scaling", 300, scaling_env)
-        _do("stress", 240, cpu_env)
-        _do("overlap", 180, cpu_env)
+        _do("scaling", 260, scaling_env)
+        _do("parity", 280, cpu_env)
+        _do("stress", 180, cpu_env)
+        _do("overlap", 150, cpu_env)
 
     cpu_thread = threading.Thread(target=cpu_chain, daemon=True)
     cpu_thread.start()

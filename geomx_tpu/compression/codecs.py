@@ -34,6 +34,7 @@ geomx_tpu/ops as jax/pallas kernels.
 
 from __future__ import annotations
 
+import weakref
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -276,6 +277,37 @@ class MpqSelector:
         return self.fp16
 
 
+def _sampled_topk_indices(delta: np.ndarray, ratio: float,
+                          rng: np.random.Generator,
+                          sample_rate: float = 0.005) -> np.ndarray:
+    """Approximate top-|ratio| selection via a sampled quantile
+    threshold + one capped scan — the reference's own BSC selection
+    scheme (random-sample 0.5%, threshold from the sample, ref:
+    gradient_compression.cc:191-269).  ~6x cheaper than the exact
+    introselect at the 16.7M MultiGPS shard size (no full-array
+    partition; the only full passes are sequential scans), at the cost
+    of a payload that floats around the target ratio (hard-capped at
+    2x, floor 1 entry)."""
+    n = len(delta)
+    sample_n = max(int(n * sample_rate), min(n, 64))
+    sample = np.abs(delta[rng.integers(0, n, size=sample_n)])
+    thr = float(np.quantile(sample, max(0.0, 1.0 - ratio)))
+    cap = max(1, int(2 * ratio * n))
+    nlib = _native()
+    if nlib is not None:
+        idx = np.empty(cap, dtype=np.int64)
+        cnt = nlib.geo_select_threshold(delta, n, thr, cap, idx)
+        return idx[:cnt]
+    mag = np.abs(delta)
+    idx = np.flatnonzero(mag >= thr)
+    if len(idx) == 0:
+        return np.array([int(np.argmax(mag))], dtype=np.int64)
+    if len(idx) > cap:
+        top = np.argpartition(mag[idx], -cap)[-cap:]
+        idx = idx[top]
+    return idx
+
+
 class BroadcastCompressor:
     """Pull-direction sparsifier (the second 'Bi' in Bi-Sparse).
 
@@ -299,6 +331,21 @@ class BroadcastCompressor:
         self._view: Dict[Tuple[str, int], np.ndarray] = {}
         self._ver: Dict[Tuple[str, int], int] = {}
         self._init_values: Dict[int, np.ndarray] = {}
+        # (subscriber, key) -> lineage token.  Two views share content
+        # iff they share (lineage, ver): both start at "init" (the
+        # propagated INIT value) and advance by the same cached deltas;
+        # a dense RESYNC forks the subscriber onto a unique lineage —
+        # its version numbers can collide with sparse-path peers'
+        # (new_ver = max(echo, tracked)+1), so version alone must NEVER
+        # authorize payload sharing (that applies a delta computed
+        # against a different base: silent permanent replica corruption)
+        self._lineage: Dict[Tuple[str, int], str] = {}
+        # key -> (weakref(weights), lineage, ver, vals, idx): one top-k
+        # per round serves every same-lineage-and-version subscriber.
+        # weakref: a strong ref would pin the previous round's full
+        # store array (~200 MB at the 50M hot path) until next compress
+        self._payload_cache: Dict[int, tuple] = {}
+        self._rng = np.random.default_rng(1234)  # sampled-threshold
         self.resyncs = 0  # forced dense resyncs (observability)
 
     def ensure_base(self, key: int, init_value: np.ndarray):
@@ -313,10 +360,15 @@ class BroadcastCompressor:
         re-seed their INIT bases from trained weights that echo-0
         subscribers never held)."""
         self.ensure_base(key, new_init)
+        self._payload_cache.pop(key, None)
         for pair in [p for p in self._view if p[1] == key]:
             del self._view[pair]
         for pair in [p for p in self._ver if p[1] == key]:
             del self._ver[pair]
+        for pair in [p for p in self._lineage if p[1] == key]:
+            # every subscriber re-enters sparse-from-INIT against the
+            # NEW propagated value: back to the shared "init" lineage
+            del self._lineage[pair]
 
     def compress(self, subscriber: str, key: int, weights: np.ndarray,
                  echo_ver: int = 0):
@@ -351,20 +403,39 @@ class BroadcastCompressor:
             w = np.ascontiguousarray(weights, dtype=np.float32)
             self._view[(subscriber, key)] = w.copy()
             self._ver[(subscriber, key)] = new_ver
+            # fork onto a unique lineage: this subscriber's future
+            # versions may numerically collide with sparse-path peers',
+            # and the payload cache must never treat that as shared
+            # content (confirmed corruption: one lost response -> peer's
+            # delta applied to the resynced base, permanently wrong)
+            self._lineage[(subscriber, key)] = f"resync{self.resyncs}"
             return w, "f32", new_ver
-        # asarray, not astype: weights is the (frozen) f32 store array in
-        # the hot path and astype would memcpy it before the subtract
-        delta = np.ascontiguousarray(
-            np.asarray(weights, np.float32) - base)
-        k = max(1, int(len(delta) * self.ratio))
-        nlib = _native()
-        if nlib is not None:
-            idx = np.empty(k, dtype=np.int64)
-            cnt = nlib.geo_topk_abs(delta, len(delta), k, idx)
-            idx = idx[:cnt]
+        # same-round payload reuse across subscribers (the 50M MultiGPS
+        # hot path, VERDICT r4 item 4): subscribers on the SAME lineage
+        # at the SAME version hold bit-identical views (both are INIT
+        # plus the identical sequence of cached deltas), so the
+        # (vals, idx) computed for the first subscriber of this
+        # (weights, lineage, ver) triple serves the rest for the cost
+        # of a scatter instead of a full selection scan.  Version alone
+        # is NOT sufficient — a resynced subscriber's version collides
+        # with sparse-path peers' (see _lineage).  Identity of the
+        # weights ARRAY (via weakref, `is`, never id()) scopes the
+        # cache to one optimizer round without pinning the old store.
+        lineage = self._lineage.get((subscriber, key), "init")
+        cached = self._payload_cache.get(key)
+        if (cached is not None and cached[0]() is weights
+                and cached[1] == lineage and cached[2] == tracked):
+            vals, idx = cached[3], cached[4]
         else:
-            idx = np.argpartition(np.abs(delta), -k)[-k:]
-        vals = delta[idx]
+            # asarray, not astype: weights is the (frozen) f32 store
+            # array in the hot path; astype would memcpy before the
+            # subtract
+            delta = np.ascontiguousarray(
+                np.asarray(weights, np.float32) - base)
+            idx = _sampled_topk_indices(delta, self.ratio, self._rng)
+            vals = delta[idx]
+            self._payload_cache[key] = (weakref.ref(weights), lineage,
+                                        tracked, vals, idx)
         base[idx] += vals
         new_ver = tracked + 1
         self._view[(subscriber, key)] = base

@@ -65,14 +65,24 @@ void geo_dgc_update(float* v, float* u, const float* g, int64_t n, float m) {
 int64_t geo_topk_abs(const float* u, int64_t n, int64_t k, int64_t* idx_out) {
   if (k <= 0 || n <= 0) return 0;
   if (k > n) k = n;
-  std::vector<int64_t> idx(n);
-  std::iota(idx.begin(), idx.end(), 0);
-  std::nth_element(idx.begin(), idx.begin() + (k - 1), idx.end(),
-                   [&](int64_t a, int64_t b) {
-                     return std::fabs(u[a]) > std::fabs(u[b]);
-                   });
-  std::copy(idx.begin(), idx.begin() + k, idx_out);
-  return k;
+  // select on a VALUE array, not an index array: nth_element with
+  // indirect fabs(u[idx]) comparisons walks u at random (one cache
+  // miss per compare) and measured ~2x slower than numpy's
+  // argpartition at 16M elements; direct float compares on a
+  // sequential copy are the fast path
+  std::vector<float> mag(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) mag[i] = std::fabs(u[i]);
+  std::nth_element(mag.begin(), mag.begin() + (k - 1), mag.end(),
+                   std::greater<float>());
+  const float thr = mag[k - 1];
+  // two sequential passes: strictly-greater hits first (at most k-1 of
+  // them), then ties at the threshold until k are collected
+  int64_t cnt = 0;
+  for (int64_t i = 0; i < n && cnt < k; ++i)
+    if (std::fabs(u[i]) > thr) idx_out[cnt++] = i;
+  for (int64_t i = 0; i < n && cnt < k; ++i)
+    if (std::fabs(u[i]) == thr) idx_out[cnt++] = i;
+  return cnt;
 }
 
 // Threshold selection with hard cap: gather indices with |u| >= thr; if

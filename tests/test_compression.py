@@ -201,3 +201,42 @@ def test_fp16_halves_wan_bytes():
     plain = run(None)
     fp16 = run("fp16")
     assert fp16 < plain * 0.65, (plain, fp16)
+
+
+def test_pull_compressor_resync_never_shares_peer_payload():
+    """r5 regression (confirmed corruption): the pull compressor's
+    same-round payload cache must key on (lineage, version), not
+    version alone.  A lost response forces subscriber b onto a dense
+    resync whose NEW version can numerically collide with a's
+    sparse-path version; sharing a's cached delta would apply it
+    against b's resynced base — permanently wrong replica (error stuck
+    ~2.75 while a converges).  With the lineage fork, b resyncs once
+    and both replicas keep tracking the weights."""
+    from geomx_tpu.compression.codecs import BroadcastCompressor
+
+    bc = BroadcastCompressor(ratio=0.05)
+    n = 4096
+    rng = np.random.default_rng(0)
+    init = np.zeros(n, np.float32)
+    bc.ensure_base(0, init)
+    w = init.copy()
+    replicas = {"a": init.copy(), "b": init.copy()}
+    vers = {"a": 0, "b": 0}
+    for r in range(40):
+        w = w + rng.standard_normal(n).astype(np.float32) * 0.1
+        wf = w.copy()
+        wf.flags.writeable = False  # the store serves frozen aliases
+        for s in ("a", "b"):
+            payload, tag, ver = bc.compress(s, 0, wf, echo_ver=vers[s])
+            if s == "b" and r == 3:
+                continue  # b's response is LOST: replica + echo stay stale
+            if tag == "f32":
+                replicas[s] = np.array(payload, copy=True)
+            else:
+                replicas[s] = BroadcastCompressor.decompress_into(
+                    replicas[s], payload)
+            vers[s] = ver
+    assert bc.resyncs == 1  # exactly the one heal for the lost response
+    for s, rep in replicas.items():
+        err = float(np.max(np.abs(rep - w)))
+        assert err < 1.0, (s, err)  # broken cache: b stuck at ~2.75
