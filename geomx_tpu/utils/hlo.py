@@ -27,7 +27,7 @@ COLLECTIVE_OPS = ("all-gather", "all-to-all", "all-reduce",
 
 _DTYPE_B = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
             "pred": 1, "s8": 1, "u8": 1, "f64": 8, "s64": 8,
-            "u8[": 1, "c64": 8}
+            "c64": 8}
 
 
 def _op_lines(hlo: str, op: str):

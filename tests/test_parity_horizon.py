@@ -1,0 +1,50 @@
+"""Long-horizon convergence parity — the reference's acceptance
+criterion promoted to a gate (VERDICT r4 item 3; ref: examples/cnn.py:
+128-131 prints per-iteration test accuracy, SURVEY §4.3
+convergence-as-oracle).
+
+Each WAN feature trains the identical model/data/seed for 200 steps
+through the 2-party HiPS stack; its FINAL held-out accuracy must land
+within its ε of the vanilla run's.  This is where BSC's residual
+cycling, HFA's milestone staleness and DGT's lossy tail would show up —
+they are invisible at the 8-step horizon of the per-codec loss oracle
+(tests/test_e2e_cnn.py::test_codec_convergence_parity)."""
+
+import numpy as np
+import pytest
+
+from geomx_tpu.utils.parity import PARITY_CONFIGS, run_parity_matrix
+
+
+@pytest.mark.slow
+def test_long_horizon_accuracy_parity():
+    results = run_parity_matrix(steps=200)
+    assert "error" not in results["vanilla"], results["vanilla"]
+    van = results["vanilla"]["final_accuracy"]
+    # the synthetic task must be learnable-but-not-saturating, or every
+    # delta below is vacuous
+    assert 0.45 < van <= 0.995, results["vanilla"]
+    failures = {}
+    for name, spec in PARITY_CONFIGS.items():
+        if name == "vanilla":
+            continue
+        r = results[name]
+        if "error" in r:
+            failures[name] = r["error"]
+            continue
+        # the feature must actually have engaged: codecs shrink WAN
+        # bytes, hfa shrinks WAN rounds — all leave a byte signature
+        if name in ("fp16", "2bit", "bsc", "mpq", "hfa_k2_8"):
+            if not r["wan_send_bytes"] < 0.9 * results["vanilla"][
+                    "wan_send_bytes"]:
+                failures[name] = f"feature did not engage: {r}"
+                continue
+        if r["final_accuracy"] < van - spec["eps"]:
+            failures[name] = (f"final acc {r['final_accuracy']} < "
+                              f"vanilla {van} - eps {spec['eps']}")
+    assert not failures, failures
+    # every config genuinely learned (not just 'close to a bad vanilla')
+    for name, r in results.items():
+        if "final_accuracy" in r:
+            assert r["final_accuracy"] > 0.4, (name, r)
+            assert np.isfinite(r["final_loss"]), (name, r)
