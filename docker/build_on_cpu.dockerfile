@@ -1,0 +1,42 @@
+# CPU image for pseudo-distributed / multi-host deployment of the
+# framework (parity with the reference's docker surface:
+# /root/reference/docker/build_on_cpu.dockerfile builds the MXNet fork;
+# here the compute substrate is jax[cpu], so the image is pip-only plus
+# the g++ toolchain for the native codec library).
+#
+#   docker build -f docker/build_on_cpu.dockerfile -t geomx-tpu:cpu .
+#   docker run --rm geomx-tpu:cpu                     # runs the CNN demo
+#   docker compose -f docker/compose.cluster.yml up   # full 2-party HiPS
+#
+# For TPU hosts use the TPU VM's base image and `pip install jax[tpu]`
+# instead — everything else is identical (docs/deployment.md).
+
+FROM python:3.12-slim
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends build-essential make \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/geomx_tpu
+
+# jax[cpu] pulls numpy/scipy wheels; flax/optax are the model layer
+RUN pip install --no-cache-dir "jax[cpu]" flax optax einops pytest
+
+COPY geomx_tpu ./geomx_tpu
+COPY scripts ./scripts
+COPY examples ./examples
+COPY tests ./tests
+COPY pytest.ini ./
+
+# pre-build the native codec library (ctypes loads it at import;
+# the build also happens lazily at first import if skipped)
+RUN make -s -C geomx_tpu/native libgeocodecs.so || true
+
+ENV JAX_PLATFORMS=cpu \
+    PYTHONUNBUFFERED=1
+
+# default command: the reference demo — single-process simulated
+# 2-party HiPS CNN run (examples/cnn.py mirrors reference
+# examples/cnn.py).  The compose file overrides this with per-role
+# geomx_tpu.launch commands for the real multi-process topology.
+CMD ["python", "examples/cnn.py", "--steps", "8"]
