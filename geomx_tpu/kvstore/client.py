@@ -206,6 +206,13 @@ class WorkerKVStore:
         if (msg.control is Control.ADD_NODE and not msg.request
                 and isinstance(msg.body, dict)
                 and msg.body.get("event") == "membership"):
+            from geomx_tpu.transport.van import apply_member_addrs
+
+            # out-of-plan members' addresses first (not seq-guarded:
+            # an address is never stale the way a count is, and a TS
+            # relay to the joiner may be imminent)
+            apply_member_addrs(self.po.van.fabric,
+                               msg.body.get("addrs"), str(self.po.node))
             seq = msg.body.get("seq")
             with self._mu:
                 if seq is not None:
@@ -295,8 +302,12 @@ class WorkerKVStore:
         The caller must initialize its own model replica (``init`` of
         existing keys is a no-op server-side).  ``advertise``: (host,
         port) for TCP deployments so peers can dial the out-of-plan
-        slot.  Returns the server's reply ({"rank", "num_workers"}).
-        Raises on an unsupported configuration (intra-TS / HFA).
+        slot (rebroadcast to the whole party — TS relays and scheduler
+        replies dial it too).  Returns the server's reply ({"rank",
+        "num_workers"}).  Join works under every mode, including
+        intra-party TSEngine (scheduler member sets track membership
+        broadcasts) and HFA (the weight mean renormalizes via the
+        per-push ``hfa_n`` denominator).
 
         Known limitation: membership lives in the party server's memory
         (like the reference scheduler's node table, which is also

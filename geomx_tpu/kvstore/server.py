@@ -205,6 +205,9 @@ class LocalServer:
         # mid-round.
         self._join_next_rank = topo.workers_per_party
         self._workers_target = self.num_workers
+        # out-of-plan members' advertised TCP addresses, rebroadcast so
+        # peers/schedulers can dial them (TS relays, ask replies)
+        self._member_addrs: Dict[str, tuple] = {}
         # monotone stamp on membership broadcasts: two concurrent
         # join/leave broadcasts can arrive out of order, and the workers'
         # 1/num_workers pre-scale must converge to the LATEST target, not
@@ -415,6 +418,7 @@ class LocalServer:
                     completed = []
                 else:
                     del self._members[node_s]
+                    self._member_addrs.pop(node_s, None)
                     self._workers_target = max(1, self._workers_target - 1)
                     self._membership_seq += 1
                     self.left_workers += 1
@@ -482,11 +486,17 @@ class LocalServer:
         # TCP deployments announce the joiner's bind address alongside;
         # add_address inserts the OUT-OF-PLAN slot (update_address would
         # ignore an unknown node as a stale broadcast, so it is no
-        # fallback here)
+        # fallback here).  The address is also recorded for membership
+        # broadcasts: under the TS overlay PEERS relay to the joiner and
+        # the SCHEDULER replies to its asks, so every party node's
+        # fabric needs the out-of-plan slot, not just this server's
         if "host" in body and "node" in body:
+            addr = (body["host"], int(body["port"]))
+            with self._mu:
+                self._member_addrs[str(body["node"])] = addr
             add = getattr(self.po.van.fabric, "add_address", None)
             if add is not None:
-                add(body["node"], (body["host"], int(body["port"])))
+                add(body["node"], addr)
         self._broadcast_membership()
         # seq rides the reply for the same reason as on leave replies
         self.po.van.send(msg.reply_to(control=Control.ADD_NODE, body={
@@ -507,6 +517,8 @@ class LocalServer:
             total = self._workers_target
             seq = self._membership_seq
             extra = list(self._members)
+            addrs = {n: list(a) for n, a in self._member_addrs.items()
+                     if n in self._members}
         targets = {str(w): w for w in self.po.topology.workers(
             self.po.node.party)}
         for n in extra:
@@ -516,7 +528,7 @@ class LocalServer:
         # threshold live there (TsScheduler/TsPushScheduler hooks)
         sched = self.po.topology.scheduler(self.po.node.party)
         body = {"event": "membership", "num_workers": total, "seq": seq,
-                "members": sorted(extra)}
+                "members": sorted(extra), "addrs": addrs}
         for n in list(targets.values()) + [sched]:
             try:
                 self.po.van.send(Message(

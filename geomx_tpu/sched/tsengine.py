@@ -74,6 +74,12 @@ class TsScheduler:
                 or body.get("event") != "membership"
                 or "members" not in body):
             return False
+        from geomx_tpu.transport.van import apply_member_addrs
+
+        # the scheduler must be able to DIAL a dynamic joiner (ask
+        # replies, and choosing it as a relay target presumes peers can)
+        apply_member_addrs(self.po.van.fabric, body.get("addrs"),
+                           str(self.po.node))
         seq = body.get("seq")
         with self._mu:
             if seq is not None and seq > self._member_seq:

@@ -255,6 +255,27 @@ class InProcFabric:
             self._serial_q.put(None)
 
 
+def apply_member_addrs(fabric, addrs, self_node: str) -> None:
+    """Install out-of-plan members' advertised addresses (the
+    membership broadcast's ``addrs`` map) into an address-planned
+    fabric.  No-op on fabrics without ``add_address`` (in-proc).  Under
+    the TS overlay PEERS relay to a dynamic joiner and the SCHEDULER
+    replies to its asks, so every party node needs the slot — not just
+    the server the joiner registered with.  Repeated broadcasts are
+    harmless: ``update_address`` returns early on an unchanged
+    address."""
+    add = getattr(fabric, "add_address", None)
+    if add is None or not addrs:
+        return
+    for n, a in addrs.items():
+        if n == self_node:
+            continue
+        try:
+            add(n, (a[0], int(a[1])))
+        except (TypeError, ValueError, IndexError):
+            continue
+
+
 class Van:
     """Per-node transport endpoint.
 

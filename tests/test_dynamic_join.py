@@ -468,12 +468,16 @@ def test_party_leave_lowers_global_tier_target():
 
 
 @pytest.mark.slow
-def test_worker_joins_over_real_tcp():
+@pytest.mark.parametrize("mode", ["plain", "tsengine", "hfa"])
+def test_worker_joins_over_real_tcp(mode):
     """Process-level join (the reference's ADD_NODE is inherently
     multi-process, van.cc:41-112): a full TCP topology trains while an
     out-of-plan worker process registers via --join --advertise, trains
     a couple of rounds, and leaves gracefully; everyone exits 0 and the
-    server's exit stats show the join+leave."""
+    server's exit stats show the join+leave.  Parametrized over the
+    plain loop, the TS overlay (peers/scheduler must learn the joiner's
+    out-of-plan ADDRESS from the membership broadcast — relays and ask
+    replies dial it) and HFA (weight-mean renormalization)."""
     import os
     import re
     import subprocess
@@ -482,6 +486,7 @@ def test_worker_joins_over_real_tcp():
 
     from tests.test_tcp import free_base_port
 
+    flags = {"plain": [], "tsengine": ["--tsengine"], "hfa": ["--hfa"]}[mode]
     cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     topo = Topology(num_parties=1, workers_per_party=2)
     base = free_base_port()
@@ -491,7 +496,7 @@ def test_worker_joins_over_real_tcp():
         return subprocess.Popen(
             [sys.executable, "-m", "geomx_tpu.launch", "--role", role,
              "--parties", "1", "--workers", "2",
-             "--base-port", str(base)] + extra,
+             "--base-port", str(base)] + extra + flags,
             cwd=cwd, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
 
