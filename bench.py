@@ -1515,8 +1515,11 @@ def _compact(record: dict) -> dict:
     if wan.get("reduction"):
         out["wan_reduction"] = wan["reduction"]
     lm = record.get("lm") or {}
-    if lm.get("tokens_per_sec"):
-        out["lm_tokens_per_sec"] = lm["tokens_per_sec"]
+    if lm.get("tokens_per_sec_steady"):
+        out["lm_tokens_per_sec"] = lm["tokens_per_sec_steady"]
+    f50 = (record.get("wan") or {}).get("flagship_50m_multigps_bsc") or {}
+    if f50.get("round_wall_s") is not None:
+        out["flagship_50m_round_wall_s"] = f50["round_wall_s"]
     sc = ((record.get("scaling") or {}).get("modeled_roofline") or {})
     if sc.get("full_stack_vs_dense_bsp_speedup_at_256"):
         out["full_stack_vs_dense_bsp_at_256_band"] = sc[
