@@ -583,3 +583,44 @@ def test_joined_worker_trains_a_model():
         assert np.isfinite(losses).all()
     finally:
         sim.shutdown()
+
+
+def test_party_leave_under_hfa():
+    """Party leave while the global tier is in HFA mode: accumulated
+    milestone DELTAS must complete additively (not through the
+    optimizer) when the leave lowers the target, and the surviving
+    party trains on."""
+    sim = Simulation(Config(
+        topology=Topology(num_parties=2, workers_per_party=1),
+        use_hfa=True, hfa_k2=1))
+    try:
+        ws = sim.all_workers()
+        w_val = 4.0 * np.ones(4, np.float32)
+        for w in ws:
+            w.init(0, w_val.copy())
+        # one full HFA round: both parties push mean weights -> both
+        # replicas equal the cross-party mean (still 4.0)
+        for w in ws:
+            w.push(0, w_val, body={"hfa_n": 1})
+        for w in ws:
+            np.testing.assert_allclose(w.pull_sync(0), w_val)
+            w.wait_all()
+
+        # party 0 pushes the next round; party 1 leaves instead of
+        # pushing — the round must complete additively with party 0's
+        # milestone delta alone
+        ws[0].push(0, 6.0 * np.ones(4, np.float32), body={"hfa_n": 1})
+        res = sim.local_servers[1].leave_global()
+        for gs_reply in res.values():
+            assert gs_reply["num_global_workers"] == 1
+        out = ws[0].pull_sync(0)
+        assert np.isfinite(out).all()
+        ws[0].wait_all()
+
+        # the surviving party keeps syncing rounds cleanly
+        ws[0].push(0, 5.0 * np.ones(4, np.float32), body={"hfa_n": 1})
+        out2 = ws[0].pull_sync(0)
+        assert np.isfinite(out2).all()
+        ws[0].wait_all()
+    finally:
+        sim.shutdown()
