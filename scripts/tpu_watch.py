@@ -1,19 +1,26 @@
 #!/usr/bin/env python
 """Tunnel watcher: keep trying to capture on-chip bench numbers.
 
-The axon TPU tunnel dies for whole rounds at a time (BENCH r1-r3 all
+The axon TPU tunnel dies for whole rounds at a time (BENCH r1-r4 all
 lost their on-chip numbers to it).  This watcher loops for the lifetime
 of a build session, probing the tunnel every ``--interval`` seconds; the
 moment a probe succeeds it runs every TPU bench child via
-``bench.py --capture-lkg``, which persists each result to
-``TPU_LKG.json``.  ``bench.py`` merges that cache (with staleness
-markers) into its record whenever its own live probe fails — so ONE
-live-tunnel window anywhere in a round is enough to land the round's
-on-chip record (VERDICT r3 item 1).
+``bench.py --capture-lkg`` (exactness checks first), which persists each
+result to ``TPU_LKG.json``.  ``bench.py`` merges that cache (with
+staleness markers) into its record whenever its own live probe fails —
+so ONE live-tunnel window anywhere in a round is enough to land the
+round's on-chip record (VERDICT r3 item 1).
+
+Provenance (VERDICT r4 item 1a): every capture pass's RAW stdout/stderr
+is written to ``tpu_captures/capture_<utc>.log``, and when a pass lands
+fresh LKG entries the watcher git-commits ``TPU_LKG.json`` + the raw log
+in one commit immediately — an on-chip claim is only as good as the
+committed artifact behind it.  ``--no-commit`` disables the auto-commit
+(the driver's end-of-round snapshot then picks the files up).
 
 Run it detached at session start:
 
-    nohup python scripts/tpu_watch.py --interval 600 \
+    nohup python scripts/tpu_watch.py --interval 600 --forever \
         >> tpu_watch.log 2>&1 &
 
 Stops by itself once every TPU child has a fresh capture (< --max-age
@@ -33,15 +40,20 @@ sys.path.insert(0, str(ROOT))
 from bench import TPU_CHILDREN as CHILDREN  # noqa: E402 — single source
 from bench import TPU_LKG_PATH as LKG      # noqa: E402
 
+CAPTURE_DIR = ROOT / "tpu_captures"
+
+
+def _entries() -> dict:
+    try:
+        return json.loads(LKG.read_text())
+    except (OSError, json.JSONDecodeError):
+        return {}
+
 
 def fresh_captures(max_age_s: float) -> set:
-    try:
-        cur = json.loads(LKG.read_text())
-    except (OSError, json.JSONDecodeError):
-        return set()
     now = time.time()
     out = set()
-    for name, entry in cur.items():
+    for name, entry in _entries().items():
         t = entry.get("captured_unix")
         if t is None:
             # legacy entry without epoch seconds: decode the UTC string
@@ -57,6 +69,24 @@ def fresh_captures(max_age_s: float) -> set:
     return out
 
 
+def _commit_artifacts(log_path: Path, landed: list) -> None:
+    """Commit the LKG cache + this pass's raw log the moment a capture
+    lands — a window may close (or the session die) before round end."""
+    try:
+        subprocess.run(["git", "add", str(LKG), str(log_path)],
+                       cwd=ROOT, check=True, capture_output=True,
+                       timeout=60)
+        msg = ("Land raw on-chip bench capture: "
+               + ", ".join(sorted(landed)))
+        r = subprocess.run(["git", "commit", "-m", msg], cwd=ROOT,
+                           capture_output=True, timeout=60, text=True)
+        print(f"[tpu_watch] commit rc={r.returncode}: "
+              f"{(r.stdout or r.stderr).strip().splitlines()[:1]}",
+              flush=True)
+    except (subprocess.SubprocessError, OSError) as e:
+        print(f"[tpu_watch] artifact commit failed: {e}", flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=float, default=600.0,
@@ -65,6 +95,8 @@ def main():
                     help="a capture younger than this counts as fresh")
     ap.add_argument("--forever", action="store_true",
                     help="keep refreshing even after a full capture")
+    ap.add_argument("--no-commit", action="store_true",
+                    help="do not git-commit landed captures")
     args = ap.parse_args()
 
     attempt = 0
@@ -78,14 +110,38 @@ def main():
             return
         print(f"[tpu_watch] attempt {attempt}: missing={missing}",
               flush=True)
+        before = {n: e.get("captured_unix")
+                  for n, e in _entries().items()}
+        stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        CAPTURE_DIR.mkdir(exist_ok=True)
+        log_path = CAPTURE_DIR / f"capture_{stamp}.log"
         try:
-            subprocess.run(
-                [sys.executable, str(ROOT / "bench.py"), "--capture-lkg"],
-                timeout=1800, cwd=ROOT, env=dict(os.environ),
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            )
+            with open(log_path, "w") as f:
+                f.write(f"# bench.py --capture-lkg @ {stamp} "
+                        f"attempt {attempt}\n")
+                f.flush()
+                subprocess.run(
+                    [sys.executable, str(ROOT / "bench.py"),
+                     "--capture-lkg"],
+                    timeout=1800, cwd=ROOT, env=dict(os.environ),
+                    stdout=f, stderr=subprocess.STDOUT,
+                )
         except (subprocess.SubprocessError, OSError) as e:
             print(f"[tpu_watch] capture pass failed: {e}", flush=True)
+        landed = [n for n, e in _entries().items()
+                  if e.get("captured_unix") != before.get(n)]
+        if landed:
+            print(f"[tpu_watch] LANDED on-chip captures: {landed} "
+                  f"(raw: {log_path.name})", flush=True)
+            if not args.no_commit:
+                _commit_artifacts(log_path, landed)
+        else:
+            # nothing landed: drop the dead-probe log, keep the tree
+            # clean (tpu_watch.log already records the attempt)
+            try:
+                log_path.unlink()
+            except OSError:
+                pass
         time.sleep(args.interval)
 
 
