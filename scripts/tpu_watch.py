@@ -78,8 +78,12 @@ def _commit_artifacts(log_path: Path, landed: list) -> None:
                        timeout=60)
         msg = ("Land raw on-chip bench capture: "
                + ", ".join(sorted(landed)))
-        r = subprocess.run(["git", "commit", "-m", msg], cwd=ROOT,
-                           capture_output=True, timeout=60, text=True)
+        # pathspec-scoped commit: the builder session may have its own
+        # work staged, which a bare `git commit` would sweep up
+        r = subprocess.run(
+            ["git", "commit", "-m", msg, "--",
+             str(LKG), str(log_path)],
+            cwd=ROOT, capture_output=True, timeout=60, text=True)
         print(f"[tpu_watch] commit rc={r.returncode}: "
               f"{(r.stdout or r.stderr).strip().splitlines()[:1]}",
               flush=True)
