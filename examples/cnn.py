@@ -80,6 +80,13 @@ def main():
                          "in-memory synthetic data (written on first use); "
                          "exercises the IO subsystem: record reader + "
                          "augmentation + threaded prefetch")
+    ap.add_argument("--mnist", metavar="DIR", default=None,
+                    help="train on REAL MNIST idx files from DIR "
+                         "(train-images-idx3-ubyte[.gz] etc. — the "
+                         "reference's exact demo dataset, examples/"
+                         "cnn.py:54-63); falls back to synthetic when "
+                         "unset.  Prints held-out t10k accuracy at the "
+                         "end when the test files are present.")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -104,6 +111,23 @@ def main():
         enable_dgt=args.dgt,
     )
     sim = Simulation(cfg)
+
+    def _mnist_file(stem):
+        from pathlib import Path as _P
+
+        for name in (stem, stem + ".gz", stem.replace("-idx", ".idx"),
+                     stem.replace("-idx", ".idx") + ".gz"):
+            p = _P(args.mnist) / name
+            if p.exists():
+                return str(p)
+        return None
+
+    mnist_train = None
+    if args.mnist:
+        mnist_train = (_mnist_file("train-images-idx3-ubyte"),
+                       _mnist_file("train-labels-idx1-ubyte"))
+        if None in mnist_train:
+            ap.error(f"--mnist {args.mnist}: train idx files not found")
     x, y = synthetic_classification(n=4096, seed=args.seed)
     if args.record:
         from pathlib import Path as _P
@@ -115,11 +139,12 @@ def main():
             print(f"wrote record dataset: {args.record}", flush=True)
     num_all = cfg.topology.num_workers_total
 
-    _, params, grad_fn = create_model_state(
+    model, params, grad_fn = create_model_state(
         args.model, jax.random.PRNGKey(args.seed),
         input_shape=(1, 28, 28, 1))
 
     histories = {}
+    final_params: dict = {}
     lock = threading.Lock()
 
     def worker_main(party, rank, widx):
@@ -142,6 +167,11 @@ def main():
                 RecordDatasetIter(args.record, args.batch, widx, num_all,
                                   seed=args.seed),
                 flip=True, seed=args.seed + widx))
+        elif mnist_train is not None:
+            from geomx_tpu.data import MNISTIter
+
+            it = MNISTIter(mnist_train[0], mnist_train[1], args.batch,
+                           widx, num_all, seed=args.seed)
         else:
             it = ShardedIterator(x, y, args.batch, widx, num_all,
                                  seed=args.seed)
@@ -152,20 +182,25 @@ def main():
                 print(f"step {step:4d}  loss {loss:.4f}  acc {acc:.3f}  "
                       f"({time.time() - t0:.2f}s)", flush=True)
 
+        outp: dict = {}
         if args.esync:
             from geomx_tpu.training import run_worker_esync
 
             hist = run_worker_esync(kv, params, grad_fn, it, args.steps,
-                                    log_fn=log)
+                                    log_fn=log, params_out=outp)
         elif args.hfa:
             hist = run_worker_hfa(kv, params, grad_fn, it, args.steps,
-                                  k1=args.hfa_k1, log_fn=log)
+                                  k1=args.hfa_k1, log_fn=log,
+                                  params_out=outp)
         else:
-            hist = run_worker(kv, params, grad_fn, it, args.steps, log_fn=log)
+            hist = run_worker(kv, params, grad_fn, it, args.steps,
+                              log_fn=log, params_out=outp)
         if prefetch is not None:
             prefetch.close()
         with lock:
             histories[(party, rank)] = hist
+            if widx == 0:
+                final_params["p"] = outp.get("params")
 
     threads = []
     widx = 0
@@ -182,6 +217,22 @@ def main():
     final_acc = np.mean([histories[k][-1][1] for k in histories])
     print(f"final mean acc {final_acc:.3f}; "
           f"WAN bytes/step {wan['wan_send_bytes'] / max(args.steps, 1):.0f}")
+    if mnist_train is not None and final_params.get("p") is not None:
+        # the reference's oracle: held-out test accuracy
+        # (examples/cnn.py:128-131 prints test accuracy per iteration)
+        ti = _mnist_file("t10k-images-idx3-ubyte")
+        tl = _mnist_file("t10k-labels-idx1-ubyte")
+        if ti and tl:
+            from geomx_tpu.data import MNISTIter
+
+            tx = MNISTIter._read_idx(ti).astype(np.float32) / 255.0
+            if tx.ndim == 3:
+                tx = tx[..., None]
+            ty = MNISTIter._read_idx(tl).astype(np.int32)
+            logits = model.apply(final_params["p"], tx[:2048])
+            acc = float(np.mean(
+                np.argmax(np.asarray(logits), -1) == ty[:2048]))
+            print(f"MNIST t10k accuracy (2048 held-out): {acc:.4f}")
     sim.shutdown()
     return 0
 

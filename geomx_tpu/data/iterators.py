@@ -84,6 +84,9 @@ class MNISTIter:
     def _read_idx(path: str) -> np.ndarray:
         with open(path, "rb") as f:
             buf = f.read()
+        if buf[:2] == b"\x1f\x8b":  # distributed gzipped; read in place
+            import gzip
+            buf = gzip.decompress(buf)
         zero, dtype_code, ndim = struct.unpack_from(">HBB", buf, 0)
         if zero != 0:
             raise IOError(f"{path}: not an idx file")

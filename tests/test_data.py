@@ -202,3 +202,26 @@ def test_libsvm_feeds_row_sparse_push():
             assert np.any(got["r"] != 0)
         finally:
             sim.shutdown()
+
+
+def test_idx_reader_transparent_gzip(tmp_path):
+    """MNIST idx files are commonly distributed gzipped; the reader
+    decodes them in place (the real-data drop path of examples/cnn.py
+    --mnist needs no unzip step)."""
+    import gzip
+
+    from geomx_tpu.data import MNISTIter
+
+    x = np.arange(2 * 4 * 4, dtype=np.uint8).reshape(2, 4, 4)
+    y = np.array([3, 7], dtype=np.uint8)
+    MNISTIter.write_idx(str(tmp_path / "imgs"), x)
+    MNISTIter.write_idx(str(tmp_path / "lbls"), y)
+    (tmp_path / "imgs.gz").write_bytes(
+        gzip.compress((tmp_path / "imgs").read_bytes()))
+    (tmp_path / "lbls.gz").write_bytes(
+        gzip.compress((tmp_path / "lbls").read_bytes()))
+    it = MNISTIter(str(tmp_path / "imgs.gz"), str(tmp_path / "lbls.gz"),
+                   batch_size=2)
+    bx, by = next(it)
+    assert bx.shape == (2, 4, 4, 1) and by.shape == (2,)
+    assert set(by) <= {3, 7}
