@@ -1,4 +1,6 @@
 from geomx_tpu.parallel.mesh import make_mesh, named_sharding  # noqa: F401
+from geomx_tpu.parallel.quantized_allreduce import (  # noqa: F401
+    make_party_step_quantized, quantized_psum_mean)
 from geomx_tpu.parallel.moe import (  # noqa: F401
     expert_capacity, moe_ffn_topk, topk_dispatch_combine)
 from geomx_tpu.parallel.ring_attention import ring_attention  # noqa: F401
