@@ -1541,6 +1541,11 @@ def _compact(record: dict) -> dict:
     return out
 
 
+_WRITE_DETAIL = True  # capture-lkg passes disable: a watcher pass with
+#                       a dead tunnel must not overwrite the round's
+#                       full bench record with a probe-failure stub
+
+
 def _emit():
     """Persist the full record to BENCH_DETAIL.json and print the
     compact headline as one JSON line (last line wins)."""
@@ -1549,13 +1554,14 @@ def _emit():
         # the cpu_chain thread and the TPU/main thread, and two threads
         # sharing one PID-keyed temp path would tear the detail file
         record = _build_record()
-        try:
-            tmp = DETAIL_PATH.with_suffix(
-                f".json.{os.getpid()}.{threading.get_ident()}.tmp")
-            tmp.write_text(json.dumps(record, indent=1))
-            tmp.replace(DETAIL_PATH)
-        except OSError:
-            pass  # detail is best-effort; the stdout line must go out
+        if _WRITE_DETAIL:
+            try:
+                tmp = DETAIL_PATH.with_suffix(
+                    f".json.{os.getpid()}.{threading.get_ident()}.tmp")
+                tmp.write_text(json.dumps(record, indent=1))
+                tmp.replace(DETAIL_PATH)
+            except OSError:
+                pass  # detail is best-effort; the stdout line goes out
     sys.stdout.write(json.dumps(_compact(record)) + "\n")
     sys.stdout.flush()
 
@@ -1716,7 +1722,8 @@ def main():
         # grace, and we abandon the pass rather than contend with the
         # headline measurement.  A full pass cannot fit the default
         # deadline — raise it unless the operator set one explicitly.
-        global DEADLINE_S
+        global DEADLINE_S, _WRITE_DETAIL
+        _WRITE_DETAIL = False  # cache-filling pass, not a record pass
         if "BENCH_DEADLINE_S" not in os.environ:
             DEADLINE_S = max(DEADLINE_S, 1500.0)
 

@@ -122,13 +122,25 @@ def main():
                 return str(p)
         return None
 
-    mnist_train = None
+    if args.mnist and args.record:
+        ap.error("--mnist and --record are mutually exclusive")
     if args.mnist:
-        mnist_train = (_mnist_file("train-images-idx3-ubyte"),
-                       _mnist_file("train-labels-idx1-ubyte"))
-        if None in mnist_train:
+        # decode ONCE in main and share the arrays across every worker
+        # thread (ShardedIterator indexes a shared array, like the
+        # synthetic path) — per-worker re-reads would hold
+        # num_workers copies of the decoded train set
+        from geomx_tpu.data import MNISTIter
+
+        ti = _mnist_file("train-images-idx3-ubyte")
+        tl = _mnist_file("train-labels-idx1-ubyte")
+        if ti is None or tl is None:
             ap.error(f"--mnist {args.mnist}: train idx files not found")
-    x, y = synthetic_classification(n=4096, seed=args.seed)
+        x = MNISTIter._read_idx(ti).astype(np.float32) / 255.0
+        if x.ndim == 3:
+            x = x[..., None]
+        y = MNISTIter._read_idx(tl).astype(np.int32)
+    else:
+        x, y = synthetic_classification(n=4096, seed=args.seed)
     if args.record:
         from pathlib import Path as _P
 
@@ -167,11 +179,6 @@ def main():
                 RecordDatasetIter(args.record, args.batch, widx, num_all,
                                   seed=args.seed),
                 flip=True, seed=args.seed + widx))
-        elif mnist_train is not None:
-            from geomx_tpu.data import MNISTIter
-
-            it = MNISTIter(mnist_train[0], mnist_train[1], args.batch,
-                           widx, num_all, seed=args.seed)
         else:
             it = ShardedIterator(x, y, args.batch, widx, num_all,
                                  seed=args.seed)
@@ -217,7 +224,7 @@ def main():
     final_acc = np.mean([histories[k][-1][1] for k in histories])
     print(f"final mean acc {final_acc:.3f}; "
           f"WAN bytes/step {wan['wan_send_bytes'] / max(args.steps, 1):.0f}")
-    if mnist_train is not None and final_params.get("p") is not None:
+    if args.mnist and final_params.get("p") is not None:
         # the reference's oracle: held-out test accuracy
         # (examples/cnn.py:128-131 prints test accuracy per iteration)
         ti = _mnist_file("t10k-images-idx3-ubyte")

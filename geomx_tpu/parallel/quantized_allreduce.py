@@ -17,9 +17,10 @@ Two exact-arithmetic properties make this safe:
   so the error is <= 2 * block_absmax / 254 — the caller can keep a
   residual (error feedback) if the optimizer needs it tighter.
 
-Usage: ``make_quantized_psum_mean(mesh, axis)`` returns a function to
-apply inside ``shard_map`` to per-device gradients, or use
-``make_party_step_quantized`` as a drop-in for ``make_party_step``.
+Usage: call ``quantized_psum_mean(x, axis_name, axis_size)`` inside a
+``shard_map`` over the reduce axis (each device passes its full-length
+local vector), or use ``make_party_step_quantized(grad_fn, mesh)`` as
+a drop-in for ``dp.make_party_step``.
 """
 
 from __future__ import annotations
