@@ -1042,8 +1042,11 @@ def child_scaling():
     CHIPS_PER_PARTY = 8          # one v5e-8 slice per data center
     V5E_ICI_BW = 100e9           # B/s effective allreduce BW per chip
     M_GLOBAL = 4                 # MultiGPS global servers (tier-2 shards)
-    OVERLAP_MEASURED = 1.53      # staged-loop speedup vs serial, SIM-
-    #                              measured (overlap child) — NOT on-chip
+    # staged-loop speedup vs serial: taken from THIS round's overlap
+    # child when the orchestrator ran it first (sim-measured — NOT
+    # on-chip), else the r4/r5 sim-measured ~1.5x
+    OVERLAP_MEASURED = float(os.environ.get("BENCH_OVERLAP_MEASURED",
+                                            "1.51"))
     grad_bytes = n_params * 2    # bf16 grads on ICI
 
     def t_step(chips, compressed, overlap, k2, mfu_v, dcn):
@@ -1161,7 +1164,9 @@ def child_scaling():
                 "note": ("0.43 = r2 builder-reported on-chip MFU "
                          "(unverified), 0.30 = standing assumption, "
                          "0.20 = pessimistic floor; overlap 'measured' "
-                         "= sim-measured 1.53x staged-loop speedup"),
+                         f"= sim-measured {OVERLAP_MEASURED}x staged-"
+                         "loop speedup (this round's overlap child "
+                         "when available)"),
             },
             "hfa_staleness_cost": {
                 "note": ("k2=8 divides WAN rounds by 8 at a CONVERGENCE "
@@ -1774,16 +1779,20 @@ def main():
         # children are the ones clipped
         _do("wan", 180, cpu_env)
         _do("lm", 210, cpu_env)
+        _do("overlap", 150, cpu_env)
         # scaling's roofline is calibrated by the lm child's measured
-        # WAN ledger when available
+        # WAN ledger and the overlap child's measured staged-loop
+        # speedup when available
         scaling_env = dict(cpu_env)
         lm_wan = _results.get("lm", {}).get("wan_bytes_per_step")
         if lm_wan:
             scaling_env["BENCH_LM_WAN_BYTES_PER_STEP"] = str(lm_wan)
+        ov = _results.get("overlap", {}).get("speedup")
+        if ov:
+            scaling_env["BENCH_OVERLAP_MEASURED"] = str(ov)
         _do("scaling", 260, scaling_env)
         _do("parity", 280, cpu_env)
         _do("stress", 180, cpu_env)
-        _do("overlap", 150, cpu_env)
 
     cpu_thread = threading.Thread(target=cpu_chain, daemon=True)
     cpu_thread.start()
