@@ -818,6 +818,7 @@ def child_lm():
             sim.worker(p, 0).set_gradient_compression(
                 {"type": "mpq", "size_bound": 100_000})
         hists = {}
+        measures = {}
         cur_params = {i: params for i in range(len(ws))}
 
         def phase(n_steps):
@@ -825,13 +826,16 @@ def child_lm():
 
             def one(widx):
                 try:
+                    from geomx_tpu.utils.measure import Measure
+
                     kv = ws[widx]
                     it = TokenIterator(data, batch, widx, len(ws))
                     out = {}
+                    m = measures[widx] = Measure()
                     hists[widx] = run_worker(kv, cur_params[widx], grad_fn,
                                              it, n_steps,
                                              barrier_init=False,
-                                             params_out=out)
+                                             params_out=out, measure=m)
                     # phase 2 must CONTINUE from phase 1's params — a
                     # restart from the initial point would push a stale
                     # gradient against the servers' trained state and
@@ -875,6 +879,14 @@ def child_lm():
             "wan_bytes_per_step": round(sent / steps, 1),
             "dense_wan_bytes_would_be": 2 * 2 * n_params * 4,
             "last_loss": round(float(hists[0][-1][0]), 4),
+            # per-phase split of the steady steps (worker 0): on this
+            # CPU host grad compute dominates and tokens/s is NOT a PS
+            # overhead statement (VERDICT r4 weak 5) — the split makes
+            # that checkable instead of asserted
+            "step_phase_means_s": (
+                {name: row["mean_s"]
+                 for name, row in measures[0].report().items()}
+                if 0 in measures else None),
         }))
     finally:
         sim.shutdown()

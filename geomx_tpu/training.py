@@ -428,6 +428,13 @@ def run_worker(
         with m.phase("grad"):
             loss, acc, grads = grad_fn(params, x, y)
             g_leaves, _ = jax.tree_util.tree_flatten(grads)
+            # block HERE so the phase split is honest: jax dispatch is
+            # async, and without this the whole backward pass would be
+            # billed to the push phase's first np.asarray (the plain
+            # loop converts leaf-by-leaf right below anyway, so this
+            # does not change the schedule; the staged OVERLAP loop —
+            # overlap.py — is the path that interleaves, not this one)
+            jax.block_until_ready(g_leaves)
         with m.phase("push"):
             if kv.ts_push is not None:
                 # TS push direction: worker-to-worker merge tree; the
