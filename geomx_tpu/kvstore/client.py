@@ -213,13 +213,7 @@ class WorkerKVStore:
             # relay to the joiner may be imminent)
             apply_member_addrs(self.po.van.fabric,
                                msg.body.get("addrs"), str(self.po.node))
-            seq = msg.body.get("seq")
-            with self._mu:
-                if seq is not None:
-                    if seq <= self._membership_seen:
-                        return True  # stale broadcast: already ahead
-                    self._membership_seen = seq
-                self.num_workers = int(msg.body["num_workers"])
+            self._apply_membership(msg.body)
             return True
         return False
 
