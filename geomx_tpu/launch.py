@@ -495,12 +495,14 @@ def main(argv=None):
         # servers would silently train garbage
         ap.error("--workload lm is mutually exclusive with --esync/--hfa")
     if args.join and (args.esync or args.p3 or args.workload != "cnn"):
-        # TS and HFA joins are supported (membership broadcasts update
-        # the schedulers' member sets; hfa_n renormalizes the weight
-        # mean) — esync's per-round step plan and p3's staged loop
-        # don't have a joiner bootstrap yet
+        # the KVSTORE layer is join-uniform across every mode —
+        # test_join_under_{intra_ts,hfa,p3,esync} prove it — but the
+        # p3/esync DEMO workloads (staged MLP / esync loop) have no
+        # joiner bootstrap in this launcher, so their flags stay gated
+        # here; --hfa and --tsengine joiners run the full flow
         ap.error("--join supports the cnn workload (plain, --hfa or "
-                 "--tsengine); not esync/p3/lm")
+                 "--tsengine); p3/esync joins are library-level "
+                 "(see tests/test_dynamic_join.py), lm has none")
     if args.join and not args.advertise:
         # without an advertised bind address the out-of-plan node has no
         # slot in the TCP plan and dies with a bare KeyError at bind

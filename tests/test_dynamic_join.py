@@ -624,3 +624,76 @@ def test_party_leave_under_hfa():
         ws[0].wait_all()
     finally:
         sim.shutdown()
+
+
+def _join_trains_under(cfg_kwargs, loop="plain"):
+    """Shared driver: 2 static workers train, a third joins, everyone
+    trains again; returns the joiner's history."""
+    import threading
+
+    import jax
+
+    from geomx_tpu.data import ShardedIterator, synthetic_classification
+    from geomx_tpu.models import create_cnn_state
+    from geomx_tpu.training import run_worker, run_worker_esync
+
+    sim = Simulation(Config(
+        topology=Topology(num_parties=1, workers_per_party=2),
+        **cfg_kwargs))
+    try:
+        x, y = synthetic_classification(n=256, shape=(8, 8, 1), seed=0)
+        _, params, grad_fn = create_cnn_state(
+            jax.random.PRNGKey(0), input_shape=(1, 8, 8, 1))
+        ws = sim.all_workers()
+        if loop == "plain":
+            ws[0].set_optimizer({"type": "adam", "lr": 0.01})
+        hist = {}
+
+        def cyc(it):
+            while True:
+                for b in it:
+                    yield b
+
+        def train(kv, widx, nw, n):
+            it = ShardedIterator(x, y, 16, widx, nw, seed=1)
+            if loop == "esync":
+                hist[widx] = run_worker_esync(
+                    kv, params, grad_fn, cyc(it), n, barrier_init=False,
+                    max_local_steps=4)
+            else:
+                hist[widx] = run_worker(kv, params, grad_fn, it, n,
+                                        barrier_init=False)
+
+        ths = [threading.Thread(target=train, args=(w, i, 2, 2))
+               for i, w in enumerate(ws)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=120)
+        assert len(hist) == 2, "static phase hung"
+        w3 = sim.add_worker(0)
+        ths = [threading.Thread(target=train, args=(w, i, 3, 2))
+               for i, w in enumerate(ws + [w3])]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=150)
+        assert len(hist) == 3, "post-join phase hung"
+        assert np.isfinite([h[0] for h in hist[2]]).all()
+        return hist[2]
+    finally:
+        sim.shutdown()
+
+
+def test_join_under_p3():
+    """Join under P3 (sliced piggybacked push_pull): the joiner's
+    sliced keys fold into the same per-key round machinery — membership
+    is uniform across scheduling modes, like the reference's ADD_NODE."""
+    _join_trains_under(dict(enable_p3=True, p3_slice_elems=5_000))
+
+
+def test_join_under_esync():
+    """Join under ESync: the state server's plan is report-keyed (no
+    fixed member set), the HFA weight mean renormalizes via hfa_n —
+    a joiner simply starts reporting and training."""
+    _join_trains_under(dict(use_hfa=True), loop="esync")
