@@ -139,3 +139,85 @@ def test_checkpoint_during_concurrent_training(tmp_path):
         assert sum(len(v) for v in store.values()) == 20_000
     finally:
         sim.shutdown()
+
+
+@pytest.mark.slow
+def test_chaos_soak_drops_joins_leaves_compression():
+    """Everything at once, long horizon: 2-party BSC-compressed training
+    under 15% message drop (resend recovering), with a worker JOINING
+    one party mid-run and another LEAVING — 40 steps end-to-end, every
+    worker finishes finite and the party replicas agree at the end.
+    The reference's equivalents are PS_DROP_MSG + the keepalive
+    launcher; none of its modes survive membership churn on top."""
+    import threading
+
+    import jax
+
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.data import ShardedIterator, synthetic_classification
+    from geomx_tpu.models import create_cnn_state
+    from geomx_tpu.training import run_worker
+    from geomx_tpu.transport.van import FaultPolicy
+
+    sim = Simulation(
+        Config(topology=Topology(num_parties=2, workers_per_party=2),
+               resend_timeout_ms=150, request_retry_s=2.0),
+        fault=FaultPolicy(drop_rate=0.15, seed=11))
+    try:
+        x, y = synthetic_classification(n=512, shape=(8, 8, 1), seed=3)
+        _, params, grad_fn = create_cnn_state(
+            jax.random.PRNGKey(0), input_shape=(1, 8, 8, 1))
+        ws = sim.all_workers()
+        ws[0].set_optimizer({"type": "adam", "lr": 0.01})
+        ws[0].set_gradient_compression({"type": "bsc", "ratio": 0.1})
+        hist = {}
+        errs = []
+
+        def train(kv, widx, nw, steps, leave_after=None):
+            try:
+                it = ShardedIterator(x, y, 16, widx, nw, seed=4)
+                h = run_worker(kv, params, grad_fn, it, steps,
+                               barrier_init=False)
+                if leave_after is not None:
+                    kv.wait_all()
+                    kv.leave_party()
+                hist[widx] = h
+            except Exception as e:  # noqa: BLE001 — assert below
+                errs.append((widx, repr(e)))
+
+        # phase 1: static plan trains 20 steps; party-0 worker 1 will
+        # leave at the end of its run
+        ths = [threading.Thread(target=train, args=(w, i, 4, 20),
+                                kwargs=dict(leave_after=20 if i == 1
+                                            else None))
+               for i, w in enumerate(ws)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=300)
+        assert not errs, errs
+        assert len(hist) == 4, "a worker hung in phase 1"
+
+        # phase 2: a NEW worker joins party 1 and the remaining three
+        # train 20 more steps under the same drop rate
+        w4 = sim.add_worker(1)
+        survivors = [ws[0]] + ws[2:] + [w4]
+        hist.clear()
+        ths = [threading.Thread(target=train, args=(w, i, 4, 20))
+               for i, w in enumerate(survivors)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=300)
+        assert not errs, errs
+        assert len(hist) == 4, "a worker hung post-churn"
+        for h in hist.values():
+            assert np.isfinite([loss for loss, _ in h]).all()
+
+        # FSA invariant survives the churn: both party stores agree
+        s0, s1 = sim.local_servers[0].store, sim.local_servers[1].store
+        for k in s0:
+            np.testing.assert_allclose(s0[k], s1[k], rtol=1e-4,
+                                       atol=1e-5)
+    finally:
+        sim.shutdown()
