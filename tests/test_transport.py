@@ -250,3 +250,65 @@ def test_deterministic_mode_trains_and_reproduces():
     second = run_once()
     assert len(first) > 10
     assert first == second
+
+
+def test_roundtrip_serialization_fuzz():
+    """Property fuzz of the wire format: random field combinations and
+    payload dtypes must survive to_bytes/from_bytes bit-exactly, and
+    TRUNCATED frames must raise cleanly (a WAN peer dying mid-frame
+    must never hang or silently mis-decode the receiver)."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    dtypes = [np.float32, np.float16, np.uint8, np.int64]
+    for trial in range(60):
+        nk = int(rng.integers(0, 5))
+        dt = dtypes[int(rng.integers(0, len(dtypes)))]
+        vals = (rng.standard_normal(int(rng.integers(0, 200)))
+                .astype(dt, copy=False)
+                if dt != np.uint8 else
+                rng.integers(0, 255, int(rng.integers(0, 200))
+                             ).astype(np.uint8))
+        m = Message(
+            sender=NodeId(Role.WORKER, int(rng.integers(0, 4)),
+                          int(rng.integers(0, 3))),
+            recipient=NodeId(Role.SERVER, 0, int(rng.integers(0, 3))),
+            domain=(Domain.GLOBAL if rng.integers(0, 2) else Domain.LOCAL),
+            app_id=int(rng.integers(0, 8)),
+            customer_id=int(rng.integers(0, 8)),
+            timestamp=int(rng.integers(-1, 1000)),
+            request=bool(rng.integers(0, 2)),
+            push=bool(rng.integers(0, 2)),
+            cmd=int(rng.integers(0, 200)),
+            priority=int(rng.integers(-20, 20)),
+            body=({"n": int(rng.integers(0, 9)), "s": "x" * 5}
+                  if rng.integers(0, 2) else None),
+            keys=rng.integers(0, 1 << 40, nk).astype(np.int64),
+            vals=vals,
+            lens=rng.integers(0, 100, nk).astype(np.int64),
+            seq=int(rng.integers(0, 100)),
+            seq_end=int(rng.integers(0, 100)),
+            channel=int(rng.integers(0, 4)),
+            compr=["", "fp16", "bsc", "2bit"][int(rng.integers(0, 4))],
+        )
+        raw = m.to_bytes()
+        m2 = Message.from_bytes(raw)
+        assert m2.sender == m.sender and m2.recipient == m.recipient
+        assert m2.timestamp == m.timestamp and m2.cmd == m.cmd
+        assert m2.priority == m.priority and m2.body == m.body
+        assert m2.compr == m.compr and m2.channel == m.channel
+        np.testing.assert_array_equal(m2.keys, m.keys)
+        np.testing.assert_array_equal(np.asarray(m2.vals),
+                                      np.asarray(m.vals))
+        np.testing.assert_array_equal(m2.lens, m.lens)
+        # truncation at an arbitrary point must raise, not hang/garble
+        if len(raw) > 4:
+            cut = int(rng.integers(1, len(raw)))
+            try:
+                Message.from_bytes(raw[:cut])
+            except Exception:
+                pass  # any clean exception is acceptable
+            else:
+                # decoding a prefix "successfully" is only legal if the
+                # cut landed past everything the format needs
+                assert cut >= len(raw) - 1, cut
