@@ -126,14 +126,14 @@ def run_parity_config(name: str, steps: int = 200,
                     # budget comparable to the plain runs (an unequal
                     # budget would masquerade as convergence damage)
                     hist = run_worker_esync(
-                        kv, params, grad_fn, _cycle(it), rounds=steps,
+                        kv, params, grad_fn, it, rounds=steps,
                         max_local_steps=8, params_out=out)
                 elif spec.get("hfa_k1") is not None:
-                    hist = run_worker_hfa(kv, params, grad_fn, _cycle(it),
+                    hist = run_worker_hfa(kv, params, grad_fn, it,
                                           steps, k1=spec["hfa_k1"],
                                           params_out=out)
                 else:
-                    hist = run_worker(kv, params, grad_fn, _cycle(it),
+                    hist = run_worker(kv, params, grad_fn, it,
                                       steps, params_out=out)
                 logits = model.apply(out["params"], x_ev)
                 acc = float(np.mean(np.argmax(np.asarray(logits), -1)
@@ -166,13 +166,6 @@ def run_parity_config(name: str, steps: int = 200,
         }
     finally:
         sim.shutdown()
-
-
-def _cycle(it):
-    """Cycle a ShardedIterator forever (long horizons outrun one pass)."""
-    while True:
-        for batch in it:
-            yield batch
 
 
 def run_parity_matrix(steps: int = 200,

@@ -649,16 +649,14 @@ def _join_trains_under(cfg_kwargs, loop="plain"):
             ws[0].set_optimizer({"type": "adam", "lr": 0.01})
         hist = {}
 
-        def cyc(it):
-            while True:
-                for b in it:
-                    yield b
-
         def train(kv, widx, nw, n):
+            # ShardedIterator samples with replacement and never ends —
+            # no cycling wrapper needed (esync draws rounds x local
+            # steps batches from it)
             it = ShardedIterator(x, y, 16, widx, nw, seed=1)
             if loop == "esync":
                 hist[widx] = run_worker_esync(
-                    kv, params, grad_fn, cyc(it), n, barrier_init=False,
+                    kv, params, grad_fn, it, n, barrier_init=False,
                     max_local_steps=4)
             else:
                 hist[widx] = run_worker(kv, params, grad_fn, it, n,

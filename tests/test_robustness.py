@@ -169,7 +169,12 @@ def test_chaos_soak_drops_joins_leaves_compression():
             jax.random.PRNGKey(0), input_shape=(1, 8, 8, 1))
         ws = sim.all_workers()
         ws[0].set_optimizer({"type": "adam", "lr": 0.01})
-        ws[0].set_gradient_compression({"type": "bsc", "ratio": 0.1})
+        # compression is configured PER PARTY SERVER (every party's
+        # rank-0 worker must call it) — configuring only party 0 would
+        # leave half the "compressed" topology running dense
+        for p in range(2):
+            sim.worker(p, 0).set_gradient_compression(
+                {"type": "bsc", "ratio": 0.1})
         hist = {}
         errs = []
 

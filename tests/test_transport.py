@@ -297,6 +297,12 @@ def test_roundtrip_serialization_fuzz():
         assert m2.timestamp == m.timestamp and m2.cmd == m.cmd
         assert m2.priority == m.priority and m2.body == m.body
         assert m2.compr == m.compr and m2.channel == m.channel
+        # every randomized field must round-trip, or the fuzz silently
+        # stops covering it
+        assert m2.request == m.request and m2.push == m.push
+        assert m2.domain is m.domain
+        assert m2.app_id == m.app_id and m2.customer_id == m.customer_id
+        assert m2.seq == m.seq and m2.seq_end == m.seq_end
         np.testing.assert_array_equal(m2.keys, m.keys)
         np.testing.assert_array_equal(np.asarray(m2.vals),
                                       np.asarray(m.vals))
