@@ -1358,6 +1358,14 @@ class GlobalServer:
                 completed = [k for k, st in self._keys.items()
                              if st.accum is not None
                              and st.count >= self.num_contributors]
+                # drop per-sender optimizer bookkeeping (DCASGD's
+                # previous-weight backups) — a departed party's
+                # full-model snapshots would otherwise stay pinned in
+                # RAM for the rest of the run
+                for st_opt in self.optimizer.state.values():
+                    prev = st_opt.get("prev")
+                    if isinstance(prev, dict):
+                        prev.pop(node_s, None)
             else:
                 completed = []  # replayed leave: no double decrement
             # HFA-mode rounds accumulate milestone DELTAS (additive);

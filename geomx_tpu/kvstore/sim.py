@@ -21,6 +21,9 @@ from geomx_tpu.transport.van import FaultPolicy, InProcFabric
 
 class Simulation:
     def __init__(self, config: Config, fault: Optional[FaultPolicy] = None):
+        import threading
+
+        self._join_mu = threading.Lock()
         self.config = config
         self.topology = config.topology
         self.fabric = InProcFabric(fault=fault, config=config)
@@ -86,16 +89,23 @@ class Simulation:
         fabric, register with the party server, and return the client.
         The server folds it into each key's count at the next fresh
         round; the caller still has to init/pull its replica and start
-        pushing (see WorkerKVStore.join_party)."""
-        rank = sum(1 for w in self.workers.values()
-                   if w.party == party)
-        n = NodeId.parse(f"worker:{rank}@p{party}")
-        po = Postoffice(n, self.topology, self.fabric, self.config)
-        po.start()
-        self.offices[str(n)] = po
-        kv = WorkerKVStore(po, self.config)
+        pushing (see WorkerKVStore.join_party).
+
+        The out-of-plan NODE ID is chosen here, before the server sees
+        the join (in a real deployment the operator picks it, e.g.
+        ``--role worker:2@p0``); concurrent add_worker calls serialize
+        the pick so two joiners can't collide on one id — the server's
+        rank assignment itself is already lock-serialized."""
+        with self._join_mu:
+            rank = sum(1 for w in self.workers.values()
+                       if w.party == party)
+            n = NodeId.parse(f"worker:{rank}@p{party}")
+            po = Postoffice(n, self.topology, self.fabric, self.config)
+            po.start()
+            self.offices[str(n)] = po
+            kv = WorkerKVStore(po, self.config)
+            self.workers[str(n)] = kv
         kv.join_party()
-        self.workers[str(n)] = kv
         return kv
 
     def all_workers(self) -> List[WorkerKVStore]:

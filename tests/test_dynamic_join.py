@@ -695,3 +695,50 @@ def test_join_under_esync():
     fixed member set), the HFA weight mean renormalizes via hfa_n —
     a joiner simply starts reporting and training."""
     _join_trains_under(dict(use_hfa=True), loop="esync")
+
+
+def test_concurrent_joins_get_unique_ranks():
+    """Two workers joining the same party simultaneously must receive
+    DISTINCT ranks and both be counted — rank assignment and the target
+    bump live under the server lock, but the test pins the end-to-end
+    guarantee (the reference's scheduler serializes ADD_NODE the same
+    way, van.cc:41-112)."""
+    import threading
+
+    sim = Simulation(Config(
+        topology=Topology(num_parties=1, workers_per_party=2)))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(4, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        g = np.ones(4, np.float32)
+        _round(ws, 0, [g, g])
+
+        joined = {}
+
+        def join_one(slot):
+            joined[slot] = sim.add_worker(0)
+
+        ths = [threading.Thread(target=join_one, args=(i,))
+               for i in range(2)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=60)
+        assert len(joined) == 2, "a join hung"
+        srv = sim.local_servers[0]
+        assert srv._workers_target == 4
+        ranks = sorted(srv._members.values())
+        assert ranks == [0, 1, 2, 3], ranks  # unique, gapless
+
+        # all four train a round together
+        all_ws = ws + list(joined.values())
+        for w in joined.values():
+            w.init(0, np.zeros(4, np.float32))
+            assert np.isfinite(w.pull_sync(0)).all()
+        outs = _round(all_ws, 0, [g] * 4)
+        for o in outs:
+            np.testing.assert_allclose(o, outs[0])
+    finally:
+        sim.shutdown()
