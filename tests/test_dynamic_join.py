@@ -742,3 +742,40 @@ def test_concurrent_joins_get_unique_ranks():
             np.testing.assert_allclose(o, outs[0])
     finally:
         sim.shutdown()
+
+
+def test_party_leave_prunes_dcasgd_backups():
+    """MixedSync + DCASGD keeps a previous-weight snapshot per SENDER
+    (party server); a party's graceful leave must drop its snapshots or
+    full-model copies stay pinned in global-server RAM for the run."""
+    sim = Simulation(Config(
+        topology=Topology(num_parties=2, workers_per_party=1),
+        sync_global_mode=False))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(8, np.float32))
+        ws[0].set_optimizer({"type": "dcasgd", "lr": 0.1})
+        g = np.ones(8, np.float32)
+        for w in ws:
+            w.push(0, g)
+            w.pull_sync(0)
+            w.wait_all()
+        gs = sim.global_servers[0]
+        senders = set()
+        for st in gs.optimizer.state.values():
+            senders |= set(st.get("prev", {}))
+        assert len(senders) == 2, senders  # both party servers tracked
+
+        res = sim.local_servers[1].leave_global()
+        for reply in res.values():
+            assert reply["num_global_workers"] == 1
+        leaver = str(sim.local_servers[1].po.node)
+        for st in gs.optimizer.state.values():
+            assert leaver not in st.get("prev", {})
+        # survivor keeps training
+        ws[0].push(0, g)
+        assert np.isfinite(ws[0].pull_sync(0)).all()
+        ws[0].wait_all()
+    finally:
+        sim.shutdown()
