@@ -117,10 +117,13 @@ def main():
         before = {n: e.get("captured_unix")
                   for n, e in _entries().items()}
         stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
-        CAPTURE_DIR.mkdir(exist_ok=True)
-        log_path = CAPTURE_DIR / f"capture_{stamp}.log"
+        # in-flight log lives OUTSIDE the repo: a concurrent commit (the
+        # builder's, or the driver's end-of-round sweep) must never
+        # catch a dead-probe log mid-pass; only landed captures move in
+        import tempfile
+        tmp_log = Path(tempfile.gettempdir()) / f"tpu_capture_{stamp}.log"
         try:
-            with open(log_path, "w") as f:
+            with open(tmp_log, "w") as f:
                 f.write(f"# bench.py --capture-lkg @ {stamp} "
                         f"attempt {attempt}\n")
                 f.flush()
@@ -135,17 +138,20 @@ def main():
         landed = [n for n, e in _entries().items()
                   if e.get("captured_unix") != before.get(n)]
         if landed:
+            CAPTURE_DIR.mkdir(exist_ok=True)
+            log_path = CAPTURE_DIR / f"capture_{stamp}.log"
+            try:
+                log_path.write_bytes(tmp_log.read_bytes())
+            except OSError as e:
+                print(f"[tpu_watch] raw-log move failed: {e}", flush=True)
             print(f"[tpu_watch] LANDED on-chip captures: {landed} "
                   f"(raw: {log_path.name})", flush=True)
             if not args.no_commit:
                 _commit_artifacts(log_path, landed)
-        else:
-            # nothing landed: drop the dead-probe log, keep the tree
-            # clean (tpu_watch.log already records the attempt)
-            try:
-                log_path.unlink()
-            except OSError:
-                pass
+        try:
+            tmp_log.unlink()
+        except OSError:
+            pass
         time.sleep(args.interval)
 
 
