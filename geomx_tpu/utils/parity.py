@@ -56,6 +56,12 @@ PARITY_CONFIGS: Dict[str, dict] = {
         "config": {"enable_dgt": 1, "dgt_block_size": 256, "dgt_k": 0.3,
                    "dgt_udp_channels": 2},
         "fault": {"channel_drop_rate": 0.3, "seed": 3}, "eps": 0.15},
+    # scheduling overlays are numerically EXACT (they reorder delivery,
+    # not arithmetic): tight ε pins that the relay/piggyback paths stay
+    # loss-free over a long horizon, not just in unit tests
+    "p3": {"config": {"enable_p3": True, "p3_slice_elems": 20_000},
+           "eps": 0.05},
+    "ts_inter": {"config": {"enable_inter_ts": True}, "eps": 0.10},
 }
 
 
