@@ -301,6 +301,13 @@ def _sampled_topk_indices(delta: np.ndarray, ratio: float,
     if nlib is not None:
         idx = np.empty(cap, dtype=np.int64)
         cnt = nlib.geo_select_threshold(delta, n, thr, cap, idx)
+        if cnt == 0:
+            # mirror the numpy fallback's argmax floor: a payload must
+            # never be empty (an all-below-threshold scan — e.g. a NaN
+            # quantile or float-compare edge — would otherwise ship 0
+            # entries from native hosts while numpy hosts ship 1, and
+            # the two builds' wire payloads must be identical)
+            return np.array([int(np.argmax(np.abs(delta)))], dtype=np.int64)
         return idx[:cnt]
     mag = np.abs(delta)
     idx = np.flatnonzero(mag >= thr)

@@ -38,6 +38,13 @@ class Role(enum.Enum):
     SCHEDULER = "scheduler"              # per-party local scheduler
     GLOBAL_SERVER = "global_server"      # tier-2, runs the optimizer
     GLOBAL_SCHEDULER = "global_scheduler"
+    STANDBY_GLOBAL = "standby_global"    # hot standby for a global server:
+    #                                      receives streamed state snapshots
+    #                                      and is promoted by the global
+    #                                      scheduler when its primary's
+    #                                      heartbeats stop (the reference
+    #                                      leaves global recovery as a TODO,
+    #                                      van.cc:224)
     MASTER_WORKER = "master_worker"      # central-party control-plane
     #                                      driver: configures optimizer /
     #                                      sync modes / compression, then
@@ -122,6 +129,10 @@ class Topology:
     num_parties: int = 1
     workers_per_party: int = 1
     num_global_servers: int = 1
+    num_standby_globals: int = 0  # hot standbys; standby rank k backs
+    #                               global server rank k (promotion swaps
+    #                               the node id, the key range is the
+    #                               primary's own shard)
     central_party: int = 0  # which party hosts the global tier
     central_worker: bool = False  # add a dedicated master worker to the
     #                               central party (ref:
@@ -135,6 +146,10 @@ class Topology:
             raise ValueError("need >=1 party and >=1 worker per party")
         if self.num_global_servers < 1:
             raise ValueError("need >=1 global server")
+        if not 0 <= self.num_standby_globals <= self.num_global_servers:
+            raise ValueError(
+                "num_standby_globals must be in [0, num_global_servers]: "
+                "standby rank k is the hot backup of global server rank k")
 
     # ---- enumeration helpers -------------------------------------------------
     def workers(self, party: int):
@@ -158,6 +173,17 @@ class Topology:
     def global_scheduler(self) -> NodeId:
         return NodeId(Role.GLOBAL_SCHEDULER, 0)
 
+    def standby_globals(self):
+        return [NodeId(Role.STANDBY_GLOBAL, r)
+                for r in range(self.num_standby_globals)]
+
+    def standby_for(self, rank: int) -> Optional[NodeId]:
+        """The hot standby backing global server ``rank`` (None if that
+        shard has no standby configured)."""
+        if rank < self.num_standby_globals:
+            return NodeId(Role.STANDBY_GLOBAL, rank)
+        return None
+
     def master_worker(self) -> Optional[NodeId]:
         """The central party's control-plane driver, when enabled
         (ref: master worker lives in the central party and drives
@@ -177,6 +203,9 @@ class Topology:
         mw = self.master_worker()
         if mw is not None:
             nodes.append(mw)
+        # standbys LAST: the static TCP port plan indexes this order, and
+        # adding a standby must not renumber any existing node's port
+        nodes.extend(self.standby_globals())
         return nodes
 
     @property
@@ -314,6 +343,13 @@ class Config:
     checkpoint_dir: str = ""      # where global servers save/resume state
     auto_ckpt_updates: int = 0    # 0 = off; else checkpoint every N
     #                               optimizer updates (key-rounds)
+    replicate_every: int = 1      # global-tier hot-standby replication:
+    #                               stream a state snapshot to the standby
+    #                               every N optimizer updates (key-rounds).
+    #                               Only active when the topology has
+    #                               standbys; N bounds the state lost on
+    #                               failover to the rounds since the last
+    #                               shipped snapshot
 
     # --- misc runtime
     deterministic: bool = False  # NaiveEngine-analog debug mode (ref:
@@ -370,6 +406,13 @@ class Config:
                 "enable_inter_ts cannot combine with bsc/mpq pull "
                 "compression (per-subscriber sparsified deltas don't fit "
                 "a shared relay payload); use fp16 or none")
+        if self.replicate_every < 1:
+            raise ValueError("replicate_every must be >= 1")
+        if self.topology.num_standby_globals and self.request_retry_s <= 0:
+            # failover's client-side replay rides the request-retry
+            # inflight table; a standby without it would promote cleanly
+            # but wedge every round that was in flight at the kill
+            self.request_retry_s = 5.0
 
     @staticmethod
     def from_env() -> "Config":
@@ -381,6 +424,7 @@ class Config:
             num_global_servers=_env_int(
                 "GEOMX_NUM_GLOBAL_SERVERS", _env_int("DMLC_NUM_GLOBAL_SERVER", 1)
             ),
+            num_standby_globals=_env_int("GEOMX_NUM_STANDBY_GLOBALS", 0),
             central_worker=_env_bool(
                 "GEOMX_ENABLE_CENTRAL_WORKER",
                 _env_bool("DMLC_ENABLE_CENTRAL_WORKER"),
@@ -434,6 +478,7 @@ class Config:
             request_retry_s=_env_float("GEOMX_REQUEST_RETRY_S", 0.0),
             checkpoint_dir=os.environ.get("GEOMX_CHECKPOINT_DIR", ""),
             auto_ckpt_updates=_env_int("GEOMX_AUTO_CKPT_UPDATES", 0),
+            replicate_every=_env_int("GEOMX_REPLICATE_EVERY", 1),
             deterministic=_env_bool(
                 "GEOMX_DETERMINISTIC",
                 os.environ.get("MXNET_ENGINE_TYPE") == "NaiveEngine",

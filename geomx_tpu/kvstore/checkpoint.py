@@ -1,4 +1,4 @@
-"""Server-state checkpoint/restore.
+"""Server-state checkpoint/restore and wire-format snapshots.
 
 The reference keeps server model state only in RAM and supports
 client-side optimizer-state saves that are explicitly unsupported for
@@ -8,10 +8,16 @@ checkpointing as an improvement to build.  Format: a single .npz holding
 the weight slabs keyed by ps-key plus pickled optimizer state, written
 atomically (tmp + rename) so a crash mid-save never corrupts the last
 good checkpoint.
+
+``dumps_server_state`` / ``loads_server_state`` expose the same slab
+format as bytes — the hot-standby replication stream ships exactly what
+a checkpoint would hold, over the wire instead of disk, so the standby's
+restore path and the crash-restart restore path stay one code path.
 """
 
 from __future__ import annotations
 
+import io
 import pickle
 from typing import Dict
 
@@ -20,8 +26,8 @@ import numpy as np
 from geomx_tpu.utils.io import atomic_write
 
 
-def save_server_state(path: str, store: Dict[int, np.ndarray],
-                      optimizer_state: dict, meta: dict) -> None:
+def dumps_server_state(store: Dict[int, np.ndarray],
+                       optimizer_state: dict, meta: dict) -> bytes:
     payload: Dict[str, np.ndarray] = {
         f"k{k}": v for k, v in store.items()
     }
@@ -29,15 +35,29 @@ def save_server_state(path: str, store: Dict[int, np.ndarray],
         pickle.dumps(optimizer_state, protocol=4), dtype=np.uint8)
     payload["__meta__"] = np.frombuffer(
         pickle.dumps(meta, protocol=4), dtype=np.uint8)
-    with atomic_write(path) as f:
-        np.savez(f, **payload)
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    return buf.getvalue()
 
 
-def load_server_state(path: str):
+def loads_server_state(data: bytes):
     """Returns (store, optimizer_state, meta)."""
-    with np.load(path, allow_pickle=False) as z:
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
         store = {int(name[1:]): z[name] for name in z.files
                  if name.startswith("k")}
         opt = pickle.loads(z["__opt__"].tobytes())
         meta = pickle.loads(z["__meta__"].tobytes())
     return store, opt, meta
+
+
+def save_server_state(path: str, store: Dict[int, np.ndarray],
+                      optimizer_state: dict, meta: dict) -> None:
+    blob = dumps_server_state(store, optimizer_state, meta)
+    with atomic_write(path) as f:
+        f.write(blob)
+
+
+def load_server_state(path: str):
+    """Returns (store, optimizer_state, meta)."""
+    with open(path, "rb") as f:
+        return loads_server_state(f.read())

@@ -82,6 +82,15 @@ class WorkerKVStore:
         self._mu = threading.Lock()
         # dynamic membership: track the server's join/leave broadcasts
         postoffice.add_control_hook(self._membership_hook)
+        # global-tier failover: workers never talk to the global tier
+        # directly (the party server does), but they track the
+        # NEW_PRIMARY broadcasts for observability — a training loop can
+        # read .failover_events / .global_primaries to know its WAN root
+        # moved (and by which term)
+        self.failover_events = 0
+        self.global_primaries: Dict[int, str] = {}
+        self._primary_terms: Dict[int, int] = {}
+        postoffice.add_control_hook(self._failover_hook)
 
     # ---- helpers ------------------------------------------------------------
     def _encode(self, tid: int, flat: np.ndarray, priority: int = 0) -> KVPairs:
@@ -216,6 +225,22 @@ class WorkerKVStore:
             self._apply_membership(msg.body)
             return True
         return False
+
+    def _failover_hook(self, msg) -> bool:
+        """Track Control.NEW_PRIMARY broadcasts (global-tier failover).
+        Term-guarded like the server-side hook: rebroadcasts and stale
+        duplicates must not double-count or roll the map back."""
+        if msg.control is not Control.NEW_PRIMARY or msg.request:
+            return False
+        b = msg.body if isinstance(msg.body, dict) else {}
+        rank, term = int(b.get("rank", -1)), int(b.get("term", 0))
+        with self._mu:
+            if term <= self._primary_terms.get(rank, 0):
+                return True
+            self._primary_terms[rank] = term
+            self.global_primaries[rank] = str(b.get("new"))
+            self.failover_events += 1
+        return True
 
     def _addnode_rpc(self, body: dict, timeout: float,
                      attempts: int = 3) -> dict:
@@ -729,6 +754,25 @@ class MasterWorker:
             key_ranges=split_range(topo.num_global_servers),
             domain=Domain.GLOBAL,
         )
+        # global-tier failover: retarget the control endpoint like the
+        # local servers retarget their data up-link
+        self.failover_events = 0
+        self._primary_terms: Dict[int, int] = {}
+        self._mw_mu = threading.Lock()
+        postoffice.add_control_hook(self._failover_hook)
+
+    def _failover_hook(self, msg) -> bool:
+        if msg.control is not Control.NEW_PRIMARY or msg.request:
+            return False
+        b = msg.body if isinstance(msg.body, dict) else {}
+        rank, term = int(b.get("rank", -1)), int(b.get("term", 0))
+        with self._mw_mu:
+            if term <= self._primary_terms.get(rank, 0):
+                return True
+            self._primary_terms[rank] = term
+            self.failover_events += 1
+        self.worker.retarget(NodeId.parse(b["old"]), NodeId.parse(b["new"]))
+        return True
 
     def set_optimizer(self, opt_config: dict):
         """Ship the optimizer to every global server (the master worker's

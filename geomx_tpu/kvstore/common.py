@@ -73,6 +73,28 @@ class RecentRequests:
             v = self._seen.get(k)
             return None if v is self._PENDING else v
 
+    def export_done(self) -> list:
+        """Snapshot the DONE entries as [(key, body), ...] — the part of
+        the window that travels with a hot-standby replication snapshot.
+        A client replaying an un-ACKed request after failover may replay
+        one the dead primary already applied AND replicated; the standby
+        seeded with this window re-acks it instead of re-applying (the
+        exactly-once half of failover replay).  PENDING entries are
+        deliberately excluded: their effect is not in the snapshot."""
+        with self._mu:
+            return [(k, v) for k, v in self._seen.items()
+                    if v is not self._PENDING]
+
+    def seed_done(self, entries: list) -> None:
+        """Install an exported done-window (standby side, replacing any
+        previous seed — each snapshot carries the full window)."""
+        with self._mu:
+            for k, v in entries:
+                self._seen[tuple(k)] = v
+                self._seen.move_to_end(tuple(k))
+            while len(self._seen) > self._cap:
+                self._seen.popitem(last=False)
+
 
 class Cmd(enum.IntEnum):
     """Data-message commands (ref: RequestType kvstore_dist_server.h:54-56)."""
@@ -87,6 +109,10 @@ class Cmd(enum.IntEnum):
     ROW_SPARSE_PUSH = 4  # embedding-style sparse-row gradient push
                          # (ref: row-sparse paths kvstore_dist.h:628-702)
     ROW_SPARSE_PULL = 5  # pull a subset of rows (ref: PullRowSparse)
+    REPLICATE = 6        # primary global server -> hot standby: one
+    #                      serialized state snapshot (the checkpoint slab
+    #                      format over the wire instead of disk); body
+    #                      carries {term, seq} for fencing/ordering
 
 
 class Ctrl(enum.IntEnum):

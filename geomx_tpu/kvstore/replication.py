@@ -1,0 +1,307 @@
+"""Global-tier hot-standby replication and automatic failover.
+
+The reference leaves global-tier recovery as an explicit TODO
+(ref: van.cc:224); this subsystem closes it with the classic
+parameter-server fault-tolerance shape (PAPERS.md: "TensorFlow: A system
+for large-scale machine learning" — PS state replication + automatic
+recovery):
+
+- ``Replicator`` (runs inside a primary :class:`GlobalServer`): after
+  every ``Config.replicate_every`` optimizer updates, snapshot the
+  server state (weights + optimizer + sync/compression meta + the
+  replay-dedup done-window) and stream it to the shard's hot standby as
+  one ``Cmd.REPLICATE`` push — the ``kvstore/checkpoint.py`` slab format
+  over the wire instead of disk.  Ships are async (a serialize must not
+  stall the merge path) and self-coalescing (a ship in flight defers the
+  next snapshot instead of queueing).
+- ``GlobalFailoverMonitor`` (runs on the global scheduler): watches the
+  postoffice heartbeat/dead-node table; when a primary global server
+  misses heartbeats past the timeout it bumps the shard's **term**,
+  promotes the standby (``Control.PROMOTE``), and broadcasts
+  ``Control.NEW_PRIMARY`` so every local server retargets its WAN
+  endpoint and replays un-ACKed requests (``KVWorker.retarget``).
+  Replays are exactly-once: the standby was seeded with the primary's
+  replay-dedup window, so a request the dead primary applied *and*
+  replicated is re-acked, not re-applied; the van boot nonce keeps a
+  replayed client distinguishable from a replaced one.
+- **Term fencing**: each promotion increments the shard's term.  A
+  zombie ex-primary that comes back keeps its stale term; its
+  replication pushes are rejected by the promoted standby
+  (``fenced_rejects`` counter) and the rejection — or a late
+  ``NEW_PRIMARY`` rebroadcast — flips it into a fenced state where it
+  refuses data pushes instead of split-braining the store.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Optional
+
+import numpy as np
+
+from geomx_tpu.core.config import NodeId, Role
+from geomx_tpu.kvstore.common import APP_PS, Cmd
+from geomx_tpu.ps import KVPairs, KVWorker, Postoffice
+from geomx_tpu.ps.postoffice import split_range
+from geomx_tpu.transport.message import Control, Domain, Message
+from geomx_tpu.utils.metrics import system_counter, system_gauge
+
+# customer id of the replication endpoint on a primary global server
+# (0 = the KVServer; local servers use 1 for their up-link worker)
+REPL_CUSTOMER_ID = 7
+
+
+class Replicator:
+    """Primary-side state streamer toward the shard's hot standby."""
+
+    def __init__(self, gserver, standby: NodeId):
+        self.gs = gserver
+        self.standby = standby
+        self.every = max(1, int(gserver.config.replicate_every))
+        self.kw = KVWorker(
+            APP_PS, REPL_CUSTOMER_ID, gserver.po,
+            targets=[standby], key_ranges=split_range(1),
+            domain=Domain.GLOBAL,
+        )
+        self.seq = 0          # last shipped snapshot number
+        self.acked_seq = 0    # last standby-confirmed snapshot
+        self.stopped = False  # fenced by a newer primary, or stop()ed
+        self._since = 0
+        self._busy = False
+        self._pending = False
+        self._lag = system_gauge(f"{gserver.po.node}.replication_lag_s")
+        # baseline ship shortly after startup: a primary that dies before
+        # its first completed round must still leave the standby with the
+        # key set (and a restarted zombie announces itself to the fence)
+        threading.Thread(target=self._baseline, daemon=True,
+                         name=f"repl-baseline-{gserver.po.node}").start()
+
+    def _baseline(self):
+        time.sleep(0.5)  # let the van/fabric finish starting
+        with self.gs._mu:
+            if self.seq == 0 and not self._busy:
+                self.mark_locked(force=True)
+
+    # ---- primary-side hooks -------------------------------------------------
+    def mark_locked(self, n_updates: int = 0, force: bool = False):
+        """Record updates; snapshot+ship when the cadence is due.  The
+        caller holds the GlobalServer's ``_mu`` — the snapshot copies
+        happen here (consistent state), serialization and the wire ship
+        on a daemon thread (never under the lock)."""
+        if self.stopped:
+            return
+        self._since += n_updates
+        if not force and self._since < self.every:
+            return
+        self._since = 0
+        if self._busy:
+            # a ship is in flight with an older snapshot — coalesce: ship
+            # once more when it completes rather than queueing every round
+            self._pending = True
+            return
+        self._busy = True
+        self._spawn_ship_locked()
+
+    def _spawn_ship_locked(self):
+        import copy
+
+        gs = self.gs
+        store_snap = {k: v.copy() for k, v in gs.store.items()}
+        opt_snap = copy.deepcopy(gs.optimizer)
+        meta = {
+            "sync_mode": gs.sync_mode,
+            "compression": dict(gs.compression),
+            "recent_done": gs._recent.export_done(),
+            "optimizer_configured": gs._optimizer_configured,
+        }
+        self.seq += 1
+        seq, term = self.seq, gs.term
+        t_snap = time.monotonic()
+
+        def ship():
+            from geomx_tpu.kvstore import checkpoint as ckpt
+
+            blob = np.frombuffer(
+                ckpt.dumps_server_state(store_snap, {"optimizer": opt_snap},
+                                        meta), dtype=np.uint8)
+
+            def done():
+                errs = []
+                with self.kw._mu:
+                    if self.kw.errors:
+                        errs, self.kw.errors[:] = list(self.kw.errors), []
+                if any("fenced" in e for e in errs):
+                    # a newer primary holds the shard: stop streaming and
+                    # flip the owning server into the fenced state so its
+                    # data path refuses pushes too (split-brain guard)
+                    self.stopped = True
+                    self.gs._fence("replication rejected by newer primary")
+                else:
+                    self.acked_seq = max(self.acked_seq, seq)
+                    self._lag.set(time.monotonic() - t_snap)
+                with self.gs._mu:
+                    self._busy = False
+                    if self._pending and not self.stopped:
+                        self._pending = False
+                        self._busy = True
+                        self._spawn_ship_locked()
+
+            try:
+                self.kw.zpush(
+                    KVPairs(np.array([0], dtype=np.int64), blob,
+                            np.array([len(blob)], dtype=np.int64)),
+                    cmd=Cmd.REPLICATE,
+                    body={"term": term, "seq": seq},
+                    on_complete=done, donated=True)
+            except Exception:  # never take the server down over replication
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "%s: replication ship failed", gs.po.node)
+                with self.gs._mu:
+                    self._busy = False
+
+        threading.Thread(target=ship, daemon=True,
+                         name=f"repl-ship-{gs.po.node}").start()
+
+    def stop(self):
+        self.stopped = True
+        self.kw.stop()
+
+
+class GlobalFailoverMonitor:
+    """Failure detector + promotion coordinator on the global scheduler.
+
+    Promotion sequence per shard rank ``k`` (requires heartbeats on —
+    ``Config.heartbeat_interval_s > 0``):
+
+    1. primary ``global_server:k`` misses heartbeats past
+       ``heartbeat_timeout_s`` → the dead-node table names it;
+    2. term[k] += 1; ``Control.PROMOTE {term}`` to ``standby_global:k``
+       (retried until acknowledged);
+    3. ``Control.NEW_PRIMARY {rank, old, new, term}`` broadcast to every
+       local server / worker / master — local servers retarget their WAN
+       worker and immediately replay un-ACKed requests;
+    4. the broadcast repeats while the old primary stays dead, so a
+       zombie that restarts later still learns it was deposed and fences
+       itself.
+    """
+
+    def __init__(self, postoffice: Postoffice,
+                 check_interval_s: Optional[float] = None):
+        assert postoffice.node.role is Role.GLOBAL_SCHEDULER
+        self.po = postoffice
+        topo = postoffice.topology
+        self.topology = topo
+        self._terms = {r: 0 for r in range(topo.num_standby_globals)}
+        self._promoted: set = set()
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._replies: dict = {}  # token -> body
+        self.failover_events = 0
+        self._counter = system_counter(f"{postoffice.node}.failover_events")
+        self._stop = threading.Event()
+        self._interval = (check_interval_s if check_interval_s is not None
+                          else max(postoffice.config.heartbeat_interval_s,
+                                   0.1))
+        postoffice.add_control_hook(self._on_control)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"failover-monitor-{postoffice.node}")
+        self._thread.start()
+
+    # ---- detection ----------------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                dead = set(self.po.dead_nodes())
+            except Exception:
+                continue
+            for rank in range(self.topology.num_standby_globals):
+                primary = NodeId(Role.GLOBAL_SERVER, rank)
+                if rank in self._promoted:
+                    if str(primary) in dead:
+                        # keep fencing: a zombie restarting at any later
+                        # point must hear who owns the shard now
+                        self._broadcast_new_primary(rank, repeats=1)
+                    continue
+                if str(primary) in dead:
+                    self.promote(rank)
+
+    # ---- promotion ----------------------------------------------------------
+    def promote(self, rank: int, reason: str = "heartbeat timeout") -> bool:
+        """Promote ``standby_global:rank``.  Also the operator-forced
+        entry point (runbook: docs/deployment.md) — callable directly
+        with the primary still alive, e.g. for planned maintenance."""
+        standby = self.topology.standby_for(rank)
+        if standby is None or rank in self._promoted:
+            return False
+        term = self._terms[rank] + 1
+        if not self._rpc_promote(standby, term, rank):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s: standby %s did not acknowledge promotion (term %d)",
+                self.po.node, standby, term)
+            return False
+        self._terms[rank] = term
+        self._promoted.add(rank)
+        self.failover_events += 1
+        self._counter.inc()
+        print(f"{self.po.node}: promoted {standby} to primary of shard "
+              f"{rank} (term={term}, {reason})", flush=True)
+        self._broadcast_new_primary(rank, repeats=3)
+        return True
+
+    def _rpc_promote(self, standby: NodeId, term: int, rank: int,
+                     attempts: int = 5, per_try_s: float = 2.0) -> bool:
+        token = f"{self.po.node}#{uuid.uuid4().hex[:8]}"
+        for _ in range(attempts):
+            try:
+                self.po.van.send(Message(
+                    recipient=standby, control=Control.PROMOTE,
+                    domain=Domain.GLOBAL, request=True,
+                    body={"term": term, "rank": rank, "token": token}))
+            except (KeyError, OSError):
+                pass  # standby not dialable yet — retry
+            with self._cv:
+                if self._cv.wait_for(lambda: token in self._replies,
+                                     timeout=per_try_s):
+                    return bool(self._replies.pop(token).get("ok"))
+        return False
+
+    def _on_control(self, msg: Message) -> bool:
+        if msg.control is Control.PROMOTE and not msg.request:
+            body = msg.body if isinstance(msg.body, dict) else {}
+            with self._cv:
+                self._replies[body.get("token")] = body
+                self._cv.notify_all()
+            return True
+        return False
+
+    def _broadcast_new_primary(self, rank: int, repeats: int = 1):
+        topo = self.topology
+        standby = topo.standby_for(rank)
+        primary = NodeId(Role.GLOBAL_SERVER, rank)
+        body = {"rank": rank, "old": str(primary), "new": str(standby),
+                "term": self._terms[rank]}
+        targets = list(topo.servers()) + list(topo.all_workers())
+        mw = topo.master_worker()
+        if mw is not None:
+            targets.append(mw)
+        targets.append(primary)  # the zombie fence
+        for i in range(repeats):
+            if i:
+                time.sleep(0.3)
+            for n in targets:
+                try:
+                    self.po.van.send(Message(
+                        recipient=n, control=Control.NEW_PRIMARY,
+                        domain=Domain.GLOBAL, request=False, body=body))
+                except (KeyError, OSError):
+                    pass  # down peers hear a later rebroadcast
+
+    def stop(self):
+        self._stop.set()

@@ -127,7 +127,7 @@ class _KeyState:
 
     __slots__ = ("accum", "count", "parked_pulls", "in_flight", "version",
                  "round", "row_sparse", "epoch", "priority", "expected",
-                 "completing", "contributors", "hfa_inv")
+                 "completing", "contributors", "hfa_inv", "pushers")
 
     def __init__(self):
         self.accum: Optional[np.ndarray] = None
@@ -176,6 +176,17 @@ class _KeyState:
         #                          would otherwise shrink the weights by
         #                          c/n — catastrophic for weights, unlike
         #                          a scaled gradient)
+        self.pushers: set = set()  # senders that EVER pushed this key
+        #                          (historical, unlike contributors which
+        #                          resets per round).  Distinguishes a
+        #                          bootstrapping joiner (never pushed —
+        #                          serve-stale is the only deadlock-free
+        #                          answer) from an established member
+        #                          whose contribution rode a TS-merged
+        #                          push (num_merge>1): the latter is owed
+        #                          the OPEN round's weights, so serving
+        #                          it stale mid-merge would silently
+        #                          diverge party replicas (advisor r5)
         self.completing = False  # round completion DECIDED but the
         #                          accumulator not yet taken.  Set under
         #                          _mu at the decision point; both
@@ -231,6 +242,15 @@ class LocalServer:
         self.server = KVServer(APP_PS, 0, postoffice, self._handle)
         self.server.cmd_handler = self._on_cmd
         postoffice.add_control_hook(self._on_add_node)
+        # global-tier failover: the scheduler's NEW_PRIMARY broadcast
+        # retargets the up-link and replays un-ACKed WAN requests
+        self.failover_events = 0
+        self._primary_terms: Dict[int, int] = {}
+        postoffice.add_control_hook(self._on_new_primary)
+        # warm the axpy-vs-numpy calibration OFF the locked merge path
+        from geomx_tpu.native.bindings import calibrate_async
+
+        calibrate_async(self.config.server_merge_threads)
         # the "global worker" half (ref: kvstore_dist_server.h uses the
         # server's own KVWorker toward tier 2)
         self.up = KVWorker(
@@ -504,6 +524,33 @@ class LocalServer:
             "token": body.get("token")}))
         return True
 
+    def _on_new_primary(self, msg: Message) -> bool:
+        """Global-tier failover (Control.NEW_PRIMARY from the global
+        scheduler): shard ``rank``'s primary died and its hot standby
+        was promoted under ``term``.  Retarget the up-link worker and
+        REPLAY its un-ACKed requests against the new primary
+        (KVWorker.retarget) — the standby's replicated replay-dedup
+        window keeps the replay exactly-once.  Term-guarded per shard:
+        rebroadcasts and out-of-order duplicates are no-ops."""
+        if msg.control is not Control.NEW_PRIMARY or msg.request:
+            return False
+        b = msg.body if isinstance(msg.body, dict) else {}
+        rank, term = int(b.get("rank", -1)), int(b.get("term", 0))
+        with self._mu:
+            if term <= self._primary_terms.get(rank, 0):
+                return True  # stale or repeated broadcast
+            self._primary_terms[rank] = term
+        replayed = self.up.retarget(NodeId.parse(b["old"]),
+                                    NodeId.parse(b["new"]))
+        self.failover_events += 1
+        from geomx_tpu.utils.metrics import system_counter
+
+        system_counter(f"{self.po.node}.failover_events").inc()
+        print(f"{self.po.node}: global shard {rank} failed over to "
+              f"{b['new']} (term={term}, replayed={replayed} requests)",
+              flush=True)
+        return True
+
     def _broadcast_membership(self):
         """Tell every party worker the new aggregation size — their
         1/num_workers gradient pre-scale must track membership or the
@@ -566,6 +613,7 @@ class LocalServer:
             for k, v in kvs.slices():
                 st = self._keys.setdefault(k, _KeyState())
                 st.contributors.add(sender_s)
+                st.pushers.add(sender_s)
                 if hfa_n:
                     st.hfa_inv += num_merge / hfa_n
                 if st.accum is None:
@@ -666,6 +714,7 @@ class LocalServer:
         with self._mu:
             st = self._keys.setdefault(key, _KeyState())
             st.contributors.add(str(msg.sender))
+            st.pushers.add(str(msg.sender))
             if st.accum is None:
                 st.accum = np.zeros_like(self.store[key], dtype=np.float32)
                 st.expected = self._workers_target
@@ -1099,9 +1148,26 @@ class LocalServer:
             # before first push) must not park behind a round that can
             # only complete with its own push (advisor r4 deadlock),
             # and a worker lagging a round behind wants exactly the
-            # store's weights, not the open round's future ones
-            if (k not in self.store or st.in_flight > 0
-                    or (st.count > 0 and sender_s in st.contributors)):
+            # store's weights, not the open round's future ones.
+            # EXCEPT during a TS-MERGED round (count > distinct senders:
+            # some push carried num_merge>1): an established member's
+            # contribution may be inside the open accumulator even
+            # though it never pushed directly, so serving it stale would
+            # silently diverge party replicas — park it; the round
+            # completes without its direct push by construction (its
+            # contribution already rode the merge tree).  Serve-stale
+            # stays for senders with no push history on this key (a
+            # bootstrapping joiner — parking those is the r4 deadlock)
+            # and for plain rounds (count == distinct senders), where
+            # the open round still NEEDS this sender's own push
+            # (advisor r5).
+            blocked = (k not in self.store or st.in_flight > 0
+                       or (st.count > 0 and sender_s in st.contributors))
+            if (not blocked and st.count > len(st.contributors)
+                    and sender_s in self._members
+                    and sender_s in st.pushers):
+                blocked = True
+            if blocked:
                 st.parked_pulls.append(req)
                 return False
         if req.cmd == Cmd.ROW_SPARSE_PULL:
@@ -1289,9 +1355,18 @@ class _GlobalKeyState:
 class GlobalServer:
     """Tier-2: owns a shard of the key space, runs the optimizer
     (ref: global-server paths of DataHandleSyncDefault :1302-1319 and the
-    async handlers :1519-1698)."""
+    async handlers :1519-1698).
 
-    def __init__(self, postoffice: Postoffice, config: Optional[Config] = None):
+    ``standby=True`` runs the same server as a HOT STANDBY: it applies
+    ``Cmd.REPLICATE`` state snapshots from its primary and parks any
+    regular traffic until the global scheduler promotes it
+    (``Control.PROMOTE``).  Promotion carries a **term**; a zombie
+    ex-primary keeps its stale term and is fenced — its replication is
+    rejected and its data path refuses pushes (see
+    kvstore/replication.py for the full protocol)."""
+
+    def __init__(self, postoffice: Postoffice, config: Optional[Config] = None,
+                 standby: bool = False):
         self.po = postoffice
         self.config = config or postoffice.config
         topo = postoffice.topology
@@ -1299,6 +1374,16 @@ class GlobalServer:
         self.store: Dict[int, np.ndarray] = {}
         self._keys: Dict[int, _GlobalKeyState] = {}
         self._mu = threading.RLock()
+        # ---- failover state (tentpole PR 1) ----
+        self.is_standby = bool(standby)
+        self.term = 0              # fencing epoch; bumped by promotion
+        self.promotions = 0        # times this node was promoted
+        self.fenced_rejects = 0    # stale-term replication pushes refused
+        self._fenced = False       # this node was deposed: refuse data
+        self._fence_reason = ""
+        self._repl_seq = 0         # last applied replication snapshot
+        self._parked_standby: List[tuple] = []  # (msg, kvs) pre-promotion
+        self._repl = None          # Replicator on a primary with a standby
         self.optimizer: ServerOptimizer = Sgd()
         self._optimizer_configured = False  # flips on SET_OPTIMIZER; a
         #                                     central-worker deployment
@@ -1334,8 +1419,21 @@ class GlobalServer:
         # parties that announced a graceful leave (idempotency set)
         self._left_parties: set = set()
         postoffice.add_control_hook(self._on_add_node)
+        postoffice.add_control_hook(self._on_promote)
+        postoffice.add_control_hook(self._on_new_primary)
         self.server = KVServer(APP_PS, 0, postoffice, self._handle)
         self.server.cmd_handler = self._on_cmd
+        # the axpy-vs-numpy calibration must never run inside the locked
+        # merge path — warm the cached verdict at startup instead
+        from geomx_tpu.native.bindings import calibrate_async
+
+        calibrate_async(self.config.server_merge_threads)
+        if not self.is_standby:
+            sb = topo.standby_for(postoffice.node.rank)
+            if sb is not None and str(sb) != str(postoffice.node):
+                from geomx_tpu.kvstore.replication import Replicator
+
+                self._repl = Replicator(self, sb)
 
     def _on_add_node(self, msg: Message) -> bool:
         """Graceful PARTY leave at the global tier (VERDICT r4 item 6).
@@ -1389,6 +1487,25 @@ class GlobalServer:
 
     def _handle_inner(self, msg: Message, kvs: Optional[KVPairs],
                       server: KVServer):
+        if msg.cmd == Cmd.REPLICATE:
+            self._on_replicate(msg, kvs)
+            return
+        if self._fenced and msg.request:
+            # deposed ex-primary: accepting pushes here would fork the
+            # store from the promoted standby's (split brain) — refuse
+            # loudly; retargeted clients never come back anyway
+            err = {"error": f"fenced: {self._fence_reason} "
+                            f"(term {self.term})", "term": self.term}
+            server.response(msg, body=err)
+            return
+        if self.is_standby and msg.request:
+            # replayed traffic can race ahead of the PROMOTE command —
+            # park it (bounded; the replay layer re-sends on overflow)
+            # and re-dispatch at promotion
+            with self._mu:
+                if len(self._parked_standby) < 4096:
+                    self._parked_standby.append((msg, kvs))
+            return
         if msg.cmd == Cmd.INIT:
             state = self._recent.check(msg)
             if state == "pending":
@@ -1439,6 +1556,8 @@ class GlobalServer:
                     # force a baseline checkpoint: a crash before the
                     # first periodic one must still restore the key set
                     self._auto_ckpt_locked(force=True)
+                    if self._repl is not None:
+                        self._repl.mark_locked(force=True)
             for req in stale_acks:
                 self._recent.mark_done(req)
                 self.server.response(req)
@@ -1572,6 +1691,8 @@ class GlobalServer:
             self._serve_parked_pulls_locked(k)
         if completed:
             self._auto_ckpt_locked(len(completed))
+            if self._repl is not None:
+                self._repl.mark_locked(len(completed))
         if self.ts_inter is not None and completed and dissem_ok:
             dissem = self._build_dissem_locked(sorted(
                 k for k in completed if k in self.store))
@@ -1642,6 +1763,8 @@ class GlobalServer:
                     self.store[k] = self.optimizer.update_scaled(
                         k, self.store[k], grad, 1.0)
             self._auto_ckpt_locked(len(kvs.keys))
+            if self._repl is not None:
+                self._repl.mark_locked(len(kvs.keys))
             if self.ts_inter is not None and msg.cmd == Cmd.DEFAULT:
                 self._ts_async_dirty.update(int(k) for k in kvs.keys)
                 self._ts_async_pushes += 1
@@ -1825,6 +1948,139 @@ class GlobalServer:
         threading.Thread(target=write, daemon=True,
                          name=f"auto-ckpt-{self.po.node}").start()
 
+    def _install_state_locked(self, store: dict, opt: dict, meta: dict):
+        """Adopt a full state snapshot (checkpoint restore OR a
+        replication snapshot from the primary).  Caller holds ``_mu``."""
+        self.store = {k: np.array(v) for k, v in store.items()}
+        for k in self.store:
+            self._keys.setdefault(k, _GlobalKeyState())
+        self.optimizer = opt["optimizer"]
+        # a restored optimizer IS a configured optimizer: central-
+        # worker deployments gate training on this flag, and a
+        # restarted shard reporting False would wedge them
+        self._optimizer_configured = bool(
+            meta.get("optimizer_configured", True))
+        # resume under the snapshotted config, not whatever this
+        # fresh process happened to default to
+        self.sync_mode = meta.get("sync_mode", self.sync_mode)
+        # trust_init=False: subscribers hold whatever they last
+        # pulled, not these restored weights — their first pull after
+        # the restore must resync dense (version-echo mismatch)
+        self._apply_compression_locked(
+            meta.get("compression", self.compression),
+            trust_init=False)
+        # the primary's replay-dedup done-window rides the snapshot: a
+        # client replaying an un-ACKed request the primary already
+        # applied AND replicated must be re-acked, never re-applied
+        # (the exactly-once half of failover replay)
+        rd = meta.get("recent_done")
+        if rd:
+            self._recent.seed_done(rd)
+
+    # ---- hot-standby replication + promotion (kvstore/replication.py) ------
+    def _on_replicate(self, msg: Message, kvs: Optional[KVPairs]):
+        """Apply one streamed state snapshot from the shard's primary —
+        the checkpoint slab format over the wire.  Term-fenced: once a
+        newer primary holds the shard, a zombie's stale stream is
+        rejected (counted) so it can never roll the store back."""
+        state = self._recent.check(msg)
+        if state == "pending":
+            return
+        if state == "done":
+            self.server.response(msg, body=self._recent.done_body(msg))
+            return
+        body = msg.body if isinstance(msg.body, dict) else {}
+        term, seq = int(body.get("term", 0)), int(body.get("seq", 0))
+        err = None
+        with self._mu:
+            if term < self.term:
+                self.fenced_rejects += 1
+                from geomx_tpu.utils.metrics import system_counter
+
+                system_counter(
+                    f"{self.po.node}.replication_fenced_rejects").inc()
+                err = {"error": f"fenced: stale replication term {term} < "
+                                f"{self.term}", "term": self.term}
+            elif seq > self._repl_seq and kvs is not None:
+                from geomx_tpu.kvstore import checkpoint as ckpt
+                from geomx_tpu.utils.metrics import system_gauge
+
+                store, opt, meta = ckpt.loads_server_state(
+                    np.ascontiguousarray(kvs.vals).tobytes())
+                self._install_state_locked(store, opt, meta)
+                self._repl_seq = seq
+                system_gauge(f"{self.po.node}.replication_seq").set(seq)
+            # else: an out-of-order older snapshot — ack without applying
+        self._recent.mark_done(msg, err)
+        self.server.response(msg, body=err)
+
+    def _on_promote(self, msg: Message) -> bool:
+        """Control.PROMOTE from the global scheduler: become the shard's
+        primary under the given term.  Idempotent per term (the
+        scheduler retries until acknowledged)."""
+        if msg.control is not Control.PROMOTE or not msg.request:
+            return False
+        body = msg.body if isinstance(msg.body, dict) else {}
+        term = int(body.get("term", 0))
+        parked: List[tuple] = []
+        with self._mu:
+            if term > self.term:
+                self.term = term
+                self.is_standby = False
+                self._fenced = False  # a promote supersedes any fence
+                self.promotions += 1
+                parked, self._parked_standby = self._parked_standby, []
+                for k in list(self.store):
+                    self._serve_parked_pulls_locked(k)
+                from geomx_tpu.utils.metrics import system_counter
+
+                system_counter(f"{self.po.node}.promotions").inc()
+                print(f"{self.po.node}: promoted to primary "
+                      f"(term={term}, keys={len(self.store)}, "
+                      f"repl_seq={self._repl_seq})", flush=True)
+        self.po.van.send(msg.reply_to(control=Control.PROMOTE, body={
+            "ok": not self.is_standby, "term": self.term,
+            "keys": len(self.store), "token": body.get("token")}))
+        # re-dispatch traffic that raced ahead of the promotion
+        for m, kv in parked:
+            self._handle_inner(m, kv, self.server)
+        return True
+
+    def _on_new_primary(self, msg: Message) -> bool:
+        """Control.NEW_PRIMARY broadcast: fence myself if I am the
+        deposed ex-primary; adopt the promotion if I am the named new
+        primary and the direct PROMOTE was lost."""
+        if msg.control is not Control.NEW_PRIMARY or msg.request:
+            return False
+        b = msg.body if isinstance(msg.body, dict) else {}
+        term = int(b.get("term", 0))
+        if b.get("old") == str(self.po.node) and term > self.term:
+            self._fence(f"deposed by {b.get('new')}", term)
+        elif b.get("new") == str(self.po.node) and term > self.term:
+            fake = Message(sender=msg.sender, recipient=self.po.node,
+                           control=Control.PROMOTE, domain=Domain.GLOBAL,
+                           request=True, body={"term": term})
+            self._on_promote(fake)
+        return True
+
+    def _fence(self, reason: str, term: Optional[int] = None):
+        """Flip into the deposed state: stop replicating, refuse data
+        requests (split-brain guard for a zombie ex-primary)."""
+        with self._mu:
+            if term is not None:
+                self.term = max(self.term, term)
+            if self._fenced:
+                return
+            self._fenced = True
+            self._fence_reason = reason
+            if self._repl is not None:
+                self._repl.stopped = True
+        from geomx_tpu.utils.metrics import system_counter
+
+        system_counter(f"{self.po.node}.fenced").inc()
+        print(f"{self.po.node}: fenced — {reason} (term={self.term})",
+              flush=True)
+
     def load_checkpoint(self, path: str):
         """Restore weights + optimizer + config from a checkpoint file and
         drain any pulls that parked while the state was missing.  Used by
@@ -1834,23 +2090,7 @@ class GlobalServer:
 
         store, opt, meta = ckpt.load_server_state(path)
         with self._mu:
-            self.store = {k: np.array(v) for k, v in store.items()}
-            for k in self.store:
-                self._keys.setdefault(k, _GlobalKeyState())
-            self.optimizer = opt["optimizer"]
-            # a restored optimizer IS a configured optimizer: central-
-            # worker deployments gate training on this flag, and a
-            # restarted shard reporting False would wedge them
-            self._optimizer_configured = True
-            # resume under the checkpointed config, not whatever this
-            # fresh process happened to default to
-            self.sync_mode = meta.get("sync_mode", self.sync_mode)
-            # trust_init=False: subscribers hold whatever they last
-            # pulled, not these restored weights — their first pull after
-            # the restore must resync dense (version-echo mismatch)
-            self._apply_compression_locked(
-                meta.get("compression", self.compression),
-                trust_init=False)
+            self._install_state_locked(store, opt, meta)
             for k in list(self.store):
                 self._serve_parked_pulls_locked(k)
 
@@ -1920,6 +2160,14 @@ class GlobalServer:
                 # rounds of one key) — observability for finding that
                 "pull_resyncs": (self.pull_comp.resyncs
                                  if self.pull_comp is not None else 0),
+                # failover observability: term fencing + replication
+                "term": self.term,
+                "is_standby": self.is_standby,
+                "promotions": self.promotions,
+                "fenced_rejects": self.fenced_rejects,
+                "replication_seq": self._repl_seq,
+                "replication_acked_seq": (self._repl.acked_seq
+                                          if self._repl is not None else 0),
             })
             return
         elif msg.cmd == Ctrl.PROFILER:
@@ -1951,6 +2199,8 @@ class GlobalServer:
         self.server.reply_cmd(msg)
 
     def stop(self):
+        if self._repl is not None:
+            self._repl.stop()
         if self.ts_inter is not None:
             self.ts_inter.stop()
         self.server.stop()

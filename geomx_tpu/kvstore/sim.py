@@ -65,10 +65,24 @@ class Simulation:
             LocalServer(self.offices[str(self.topology.server(p))], config)
             for p in range(self.topology.num_parties)
         ]
+        # standbys FIRST: a primary with a standby configured ships a
+        # baseline replication snapshot at startup, and the standby must
+        # exist to receive it
+        self.standby_globals: List[GlobalServer] = [
+            GlobalServer(self.offices[str(sb)], config, standby=True)
+            for sb in self.topology.standby_globals()
+        ]
         self.global_servers: List[GlobalServer] = [
             GlobalServer(self.offices[str(gs)], config)
             for gs in self.topology.global_servers()
         ]
+        self.failover_monitor = None
+        if (self.topology.num_standby_globals
+                and config.heartbeat_interval_s > 0):
+            from geomx_tpu.kvstore.replication import GlobalFailoverMonitor
+
+            self.failover_monitor = GlobalFailoverMonitor(
+                self.offices[str(self.topology.global_scheduler())])
         self.workers: Dict[str, WorkerKVStore] = {}
         for p in range(self.topology.num_parties):
             for w in self.topology.workers(p):
@@ -111,6 +125,16 @@ class Simulation:
     def all_workers(self) -> List[WorkerKVStore]:
         return [self.workers[str(w)] for w in self.topology.all_workers()]
 
+    def kill_global_server(self, rank: int = 0) -> GlobalServer:
+        """Thread-level kill of a primary global server (SIGKILL-free):
+        stop its postoffice — the van's receive loop and heartbeat
+        thread die, so it processes nothing further and the global
+        scheduler's dead-node table names it after the heartbeat
+        timeout.  The failover smoke test's kill switch."""
+        gs = self.global_servers[rank]
+        gs.po.stop()
+        return gs
+
     def wan_bytes(self) -> dict:
         """Total WAN traffic (tier-2 links) across the deployment."""
         send = sum(ls.po.van.wan_send_bytes for ls in self.local_servers)
@@ -120,13 +144,15 @@ class Simulation:
         return {"wan_send_bytes": send, "wan_recv_bytes": recv}
 
     def shutdown(self):
+        if self.failover_monitor is not None:
+            self.failover_monitor.stop()
         if self.master is not None:
             self.master.stop()
         for w in self.workers.values():
             w.stop()
         for s in self.local_servers:
             s.stop()
-        for s in self.global_servers:
+        for s in self.global_servers + self.standby_globals:
             s.stop()
         for po in self.offices.values():
             po.stop()

@@ -86,6 +86,13 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
                 role_obj.load_checkpoint(path)
                 print(f"{node}: resumed from {path} "
                       f"({len(role_obj.store)} keys)", flush=True)
+    elif node.role is Role.STANDBY_GLOBAL:
+        from geomx_tpu.kvstore.server import GlobalServer
+
+        # hot standby (--role standby_global:K): a full GlobalServer that
+        # applies the primary's replication stream and serves nothing
+        # until the global scheduler promotes it (kvstore/replication.py)
+        role_obj = GlobalServer(po, config, standby=True)
     elif node.role is Role.SCHEDULER and config.enable_intra_ts:
         from geomx_tpu.sched.ts_push import TsPushScheduler
         from geomx_tpu.sched.tsengine import TsScheduler
@@ -103,6 +110,15 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
 
             TsPushScheduler(
                 po, num_workers=config.topology.num_global_workers)
+    if (node.role is Role.GLOBAL_SCHEDULER
+            and config.topology.num_standby_globals
+            and config.heartbeat_interval_s > 0):
+        # automatic global-tier failover: the heartbeat-driven failure
+        # detector + promotion coordinator lives on this scheduler
+        from geomx_tpu.kvstore.replication import GlobalFailoverMonitor
+
+        monitor = GlobalFailoverMonitor(po)
+        role_obj = role_obj or monitor
     elif node.role is Role.WORKER:
         from geomx_tpu.kvstore.client import WorkerKVStore
 
@@ -166,6 +182,8 @@ def shutdown_cluster(po: Postoffice):
         targets.append((topo.scheduler(p), Domain.LOCAL))
     for gs in topo.global_servers():
         targets.append((gs, Domain.GLOBAL))
+    for sb in topo.standby_globals():
+        targets.append((sb, Domain.GLOBAL))
     targets.append((topo.global_scheduler(), Domain.GLOBAL))
     for attempt in range(2):
         if attempt:
@@ -450,6 +468,14 @@ def main(argv=None):
                     default=int(os.environ.get("GEOMX_WORKERS_PER_PARTY", "1")))
     ap.add_argument("--global-servers", type=int,
                     default=int(os.environ.get("GEOMX_NUM_GLOBAL_SERVERS", "1")))
+    ap.add_argument("--standby-globals", type=int,
+                    default=int(os.environ.get("GEOMX_NUM_STANDBY_GLOBALS",
+                                               "0")),
+                    help="hot standbys for the global tier: standby rank "
+                         "K backs global server rank K; run each as "
+                         "--role standby_global:K (every process must "
+                         "pass the same count — the port plan includes "
+                         "the standbys)")
     ap.add_argument("--base-port", type=int,
                     default=int(os.environ.get("GEOMX_BASE_PORT", "9200")))
     ap.add_argument("--advertise", default=os.environ.get("GEOMX_ADVERTISE"),
@@ -522,6 +548,7 @@ def main(argv=None):
     cfg.topology = Topology(num_parties=args.parties,
                             workers_per_party=args.workers,
                             num_global_servers=args.global_servers,
+                            num_standby_globals=args.standby_globals,
                             central_worker=central)
     cfg.compression = args.compression
     # ESync exchanges weights like HFA — servers must run in HFA mode
@@ -623,6 +650,21 @@ def main(argv=None):
                      f"left={role_obj.left_workers}")
     if po.van.pq_overtakes:
         feats.append(f"pq_overtakes={po.van.pq_overtakes}")
+    # global-tier failover observables (replication stream, promotions,
+    # term fencing, client-side retarget+replay)
+    for attr, tag in (("failover_events", "failover_events"),
+                      ("promotions", "promotions"),
+                      ("fenced_rejects", "fenced_rejects")):
+        v = getattr(role_obj, attr, 0)
+        if v:
+            feats.append(f"{tag}={v}")
+    repl = getattr(role_obj, "_repl", None)
+    if repl is not None and repl.acked_seq:
+        feats.append(f"replicated_seq={repl.acked_seq}")
+    if getattr(role_obj, "_repl_seq", 0):
+        feats.append(f"applied_repl_seq={role_obj._repl_seq}")
+    if getattr(role_obj, "term", 0):
+        feats.append(f"term={role_obj.term}")
     if feats:
         print(f"{node}: " + " ".join(feats), flush=True)
     po.stop()

@@ -107,6 +107,14 @@ def _usable_cores() -> int:
 
 
 _axpy_wins: dict = {}  # thread count -> calibration verdict
+_calib_lock = threading.Lock()
+
+
+def _force_accum() -> str:
+    """The GEOMX_FORCE_ACCUM override: "native" / "numpy" / "" (auto).
+    Read per call so tests and operators can flip it at runtime; the
+    documented surface is docs/env-vars.md."""
+    return os.environ.get("GEOMX_FORCE_ACCUM", "").strip().lower()
 
 
 def _axpy_beats_numpy(l, threads: int) -> bool:
@@ -121,33 +129,60 @@ def _axpy_beats_numpy(l, threads: int) -> bool:
     won = _axpy_wins.get(threads)
     if won is None:
         import time
-        n = 1 << 22  # 16 MB slabs: past every cache, quick to run
-        a = np.ones(n, np.float32)
-        b = np.ones(n, np.float32)
-        t_nat = t_np = float("inf")
-        for _ in range(2):
-            t0 = time.perf_counter()
-            l.geo_axpy_acc(a, b, n, threads)
-            t_nat = min(t_nat, time.perf_counter() - t0)
-            t0 = time.perf_counter()
-            a += b
-            t_np = min(t_np, time.perf_counter() - t0)
-        won = _axpy_wins[threads] = t_nat < t_np
+        with _calib_lock:
+            won = _axpy_wins.get(threads)
+            if won is not None:
+                return won
+            n = 1 << 22  # 16 MB slabs: past every cache, quick to run
+            a = np.ones(n, np.float32)
+            b = np.ones(n, np.float32)
+            t_nat = t_np = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                l.geo_axpy_acc(a, b, n, threads)
+                t_nat = min(t_nat, time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                a += b
+                t_np = min(t_np, time.perf_counter() - t0)
+            won = _axpy_wins[threads] = t_nat < t_np
     return won
+
+
+def _clamped_threads(threads: int) -> int:
+    cores = _usable_cores()
+    return cores if threads <= 0 else min(threads, cores)
+
+
+def calibrate(threads: int = 0) -> str:
+    """Run (or fetch) the axpy-vs-numpy calibration for this thread
+    count NOW, returning the winning backend name.  Servers call this
+    at startup — the locked merge path must never pay the ~2x16 MB
+    timing run (advisor r5); ``accumulate`` only consults the cached
+    verdict."""
+    forced = _force_accum()
+    if forced in ("native", "numpy"):
+        return forced
+    l = _load()
+    if l is None or not hasattr(l, "geo_axpy_acc"):
+        return "numpy"
+    t = _clamped_threads(threads)
+    if t <= 1:
+        return "numpy"
+    return "native" if _axpy_beats_numpy(l, t) else "numpy"
+
+
+def calibrate_async(threads: int = 0) -> None:
+    """Warm the calibration cache on a daemon thread (eager server
+    startup).  Idempotent and cheap once the verdict is cached."""
+    threading.Thread(target=calibrate, args=(threads,),
+                     daemon=True, name="axpy-calibrate").start()
 
 
 def axpy_backend(threads: int = 0) -> str:
     """Which implementation ``accumulate`` would use for a large slab on
     this host right now: "native" or "numpy" (observability for the
     bench; runs the calibration if it hasn't happened yet)."""
-    l = _load()
-    if l is None or not hasattr(l, "geo_axpy_acc"):
-        return "numpy"
-    cores = _usable_cores()
-    threads = cores if threads <= 0 else min(threads, cores)
-    if threads <= 1 or not _axpy_beats_numpy(l, threads):
-        return "numpy"
-    return "native"
+    return calibrate(threads)
 
 
 def accumulate(acc: np.ndarray, v: np.ndarray, threads: int = 0) -> None:
@@ -157,16 +192,33 @@ def accumulate(acc: np.ndarray, v: np.ndarray, threads: int = 0) -> None:
     core (affinity-aware), always clamped to the affinity mask.  Falls
     back to numpy without the library, on small slabs (thread spawn
     dominates), on single-core hosts, and on hosts where the one-shot
-    calibration shows numpy's add is faster."""
+    calibration shows numpy's add is faster.  ``GEOMX_FORCE_ACCUM``
+    (native|numpy) overrides the choice outright.
+
+    NEVER calibrates here: this runs under the server's state lock
+    (advisor r5) — an uncalibrated thread count falls back to numpy for
+    this call and schedules the calibration in the background (servers
+    normally pre-warm it via ``calibrate_async`` at startup)."""
+    forced = _force_accum()
     l = _load()
-    if (l is not None and hasattr(l, "geo_axpy_acc")
-            and acc.dtype == np.float32 and v.dtype == np.float32
-            and len(acc) == len(v)
-            and acc.flags.c_contiguous and v.flags.c_contiguous
-            and len(acc) >= (1 << 20)):
-        cores = _usable_cores()
-        threads = cores if threads <= 0 else min(threads, cores)
-        if threads > 1 and _axpy_beats_numpy(l, threads):
-            l.geo_axpy_acc(acc, v, len(acc), threads)
+    native_ok = (l is not None and hasattr(l, "geo_axpy_acc")
+                 and acc.dtype == np.float32 and v.dtype == np.float32
+                 and len(acc) == len(v)
+                 and acc.flags.c_contiguous and v.flags.c_contiguous)
+    if forced == "numpy" or not native_ok:
+        acc += v
+        return
+    t = _clamped_threads(threads)
+    if forced == "native":
+        l.geo_axpy_acc(acc, v, len(acc), max(t, 1))
+        return
+    if len(acc) >= (1 << 20) and t > 1:
+        won = _axpy_wins.get(t)
+        if won is None:
+            # not calibrated yet — do NOT time it under the caller's
+            # lock; numpy this round, background-calibrate for the next
+            calibrate_async(t)
+        elif won:
+            l.geo_axpy_acc(acc, v, len(acc), t)
             return
     acc += v

@@ -222,6 +222,40 @@ class KVWorker(_App):
                 del self._inflight[msg.timestamp]
         return True
 
+    def retarget(self, old: NodeId, new: NodeId) -> int:
+        """Global-tier failover: replace server ``old`` with ``new`` and
+        REPLAY every un-ACKed request that was addressed to it.
+
+        Future sends route to ``new`` (the targets slot swaps in place —
+        key ranges are positional, and the standby owns exactly its
+        primary's shard).  In-flight requests are re-addressed and
+        re-sent NOW rather than waiting out the retry backoff; mutating
+        the tracked Message in place also re-points the van resender's
+        pending-ACK entry, so transport-level retransmits follow the new
+        primary too.  Exactly-once across the replay is the standby's
+        job: it was seeded with the primary's replay-dedup window, so a
+        request the dead primary applied *and* replicated is re-acked
+        without re-applying.  Returns the number of replayed requests.
+        """
+        old_s, new_s = str(old), str(new)
+        resend: List[Message] = []
+        with self._mu:
+            for i, t in enumerate(self.targets):
+                if str(t) == old_s:
+                    self.targets[i] = new
+            for ent in self._inflight.values():
+                m = ent["msgs"].pop(old_s, None)
+                if m is not None:
+                    m.recipient = new
+                    ent["msgs"][new_s] = m
+                    resend.append(m)
+        for m in resend:
+            try:
+                self.postoffice.van.send(m)
+            except (KeyError, OSError):
+                pass  # the retry loop re-sends once the standby is up
+        return len(resend)
+
     def _retry_loop(self):
         import time
 

@@ -160,7 +160,7 @@ class Postoffice:
         workers ping the party scheduler; global servers ping the global
         scheduler."""
         targets = []
-        if self.node.role is Role.GLOBAL_SERVER:
+        if self.node.role in (Role.GLOBAL_SERVER, Role.STANDBY_GLOBAL):
             targets.append((self.topology.global_scheduler(), Domain.GLOBAL))
         else:
             targets.append(
@@ -209,7 +209,8 @@ class Postoffice:
         if self.node.role.is_scheduler:
             return self.dead_nodes()
         sched = (self.topology.global_scheduler()
-                 if self.node.role is Role.GLOBAL_SERVER
+                 if self.node.role in (Role.GLOBAL_SERVER,
+                                       Role.STANDBY_GLOBAL)
                  else self.topology.scheduler(self.node.party))
         domain = (Domain.GLOBAL if sched.role is Role.GLOBAL_SCHEDULER
                   else Domain.LOCAL)

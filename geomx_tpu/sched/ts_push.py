@@ -109,7 +109,26 @@ class TsPushScheduler:
                 if not self._pending[k]:
                     del self._pending[k]
             pend = self._pending.setdefault(bucket, [])
-            if nm >= self.num_workers:
+            if not isinstance(it, str):
+                # one sender, two outstanding DEFAULT-token asks: a second
+                # concurrent merge_push() without an explicit per-key
+                # token.  Pairing it would silently cross-merge two
+                # different rounds' gradients into one accumulator (the
+                # shared __worker_round__ bucket assumes lockstep BSP —
+                # one ask per worker at a time); refuse loudly instead
+                # and let the caller's merge_push raise (advisor r5).
+                dup = next((e for e in pend
+                            if str(e[0].sender) == str(msg.sender)), None)
+                if dup is not None:
+                    replies.append((msg, {
+                        "action": "error", "iter": it,
+                        "error": f"{msg.sender} has a concurrent "
+                                 "default-token merge_push outstanding; "
+                                 "concurrent per-key merges must pass an "
+                                 "explicit string round token"}))
+            if replies:
+                pass  # rejected above — leave the pending entry untouched
+            elif nm >= self.num_workers:
                 # this node holds everything → send to server
                 replies.append((msg, {"action": "server", "iter": it}))
                 self._pending.pop(bucket, None)
@@ -287,6 +306,12 @@ class TsPushWorker:
             except TimeoutError:
                 return grads, num_merge  # scheduler gone: push direct
             action = reply["action"]
+            if action == "error":
+                # scheduler refused the ask (e.g. a concurrent
+                # default-token merge from this node) — a programming
+                # error, not a degradation: surface it, never
+                # cross-merge rounds silently
+                raise RuntimeError(f"ASK_PUSH rejected: {reply['error']}")
             if action == "server":
                 return grads, num_merge
             if action == "send":

@@ -40,6 +40,13 @@ class Control(enum.Enum):
     ADDR_UPDATE = 11   # a replacement node announces its new address
     #                    (ref: ADD_NODE re-registration van.cc:176-193;
     #                    here plan-based — the node broadcasts directly)
+    # global-tier failover (beyond the reference — its global recovery is
+    # a TODO, van.cc:224): the global scheduler's failure detector drives
+    # a hot-standby promotion
+    PROMOTE = 12       # scheduler -> standby: become primary (body: term)
+    NEW_PRIMARY = 13   # scheduler -> everyone: the shard's new primary
+    #                    identity + fencing term; clients retarget and
+    #                    replay, a zombie ex-primary demotes itself
 
 
 class Domain(enum.Enum):

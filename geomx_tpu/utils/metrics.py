@@ -1,17 +1,91 @@
-"""Evaluation metrics with streaming (update/get/reset) semantics.
+"""Evaluation metrics with streaming (update/get/reset) semantics, plus
+a process-wide system-metrics registry (counters/gauges).
 
 Mirrors the reference metric surface (ref: python/mxnet/metric.py —
 EvalMetric base with update/get/reset, Accuracy, TopKAccuracy, F1, MAE,
 MSE/RMSE, CrossEntropy, CompositeEvalMetric, and ``create`` by name).
 Host-side numpy: metrics consume per-batch (labels, predictions) after
 device readback, matching how the examples report accuracy per step.
+
+System metrics are the runtime-health side: named counters (failover
+events, fenced replication rejects) and gauges (replication lag) that
+subsystems register by dotted name — ``<node>.<metric>`` — and tests or
+operators read back with :func:`system_snapshot`.  Registration is
+get-or-create, so readers and writers need no setup ordering.
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+import threading
+from typing import Dict, List, Sequence, Tuple, Union
 
 import numpy as np
+
+
+class Counter:
+    """Monotonic system counter (thread-safe)."""
+
+    def __init__(self):
+        self._v = 0
+        self._mu = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._mu:
+            self._v += n
+
+    @property
+    def value(self) -> int:
+        with self._mu:
+            return self._v
+
+
+class Gauge:
+    """Last-value system gauge (thread-safe)."""
+
+    def __init__(self):
+        self._v = float("nan")
+        self._mu = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._mu:
+            self._v = float(v)
+
+    @property
+    def value(self) -> float:
+        with self._mu:
+            return self._v
+
+
+_SYS_MU = threading.Lock()
+_SYSTEM: Dict[str, Union[Counter, Gauge]] = {}
+
+
+def _system(name: str, cls):
+    with _SYS_MU:
+        m = _SYSTEM.get(name)
+        if m is None:
+            m = _SYSTEM[name] = cls()
+        elif not isinstance(m, cls):
+            raise TypeError(f"system metric {name!r} is {type(m).__name__}, "
+                            f"not {cls.__name__}")
+        return m
+
+
+def system_counter(name: str) -> Counter:
+    """Get-or-create a named counter (e.g. ``global_server:0.failover``)."""
+    return _system(name, Counter)
+
+
+def system_gauge(name: str) -> Gauge:
+    """Get-or-create a named gauge (e.g. ``...replication_lag_s``)."""
+    return _system(name, Gauge)
+
+
+def system_snapshot(prefix: str = "") -> Dict[str, float]:
+    """Current values of every registered system metric under ``prefix``."""
+    with _SYS_MU:
+        return {k: m.value for k, m in _SYSTEM.items()
+                if k.startswith(prefix)}
 
 
 class EvalMetric:

@@ -240,3 +240,51 @@ def test_pull_compressor_resync_never_shares_peer_payload():
     for s, rep in replicas.items():
         err = float(np.max(np.abs(rep - w)))
         assert err < 1.0, (s, err)  # broken cache: b stuck at ~2.75
+
+
+def test_sampled_topk_native_numpy_parity():
+    """advisor r5: hosts with and without the native library must
+    produce IDENTICAL payloads.  (a) When the above-threshold count
+    fits the cap, both paths select the same index set.  (b) The
+    zero-entry edge (native scan finds nothing): the native path must
+    mirror the numpy fallback's argmax floor — never 0 entries."""
+    from geomx_tpu.compression import codecs
+
+    # (a) real-parity: 5 clear spikes over tiny noise; the sampled
+    # threshold lands between, so both backends select exactly the
+    # spikes plus the same noise tail (count << cap → no tie-breaking
+    # divergence between scan order and top-k order)
+    rng = np.random.default_rng(3)
+    delta = (rng.uniform(0, 1e-4, 10_000)).astype(np.float32)
+    spikes = np.array([7, 170, 4242, 8888, 9999])
+    delta[spikes] = 1.0
+    if codecs._native() is not None:
+        a = codecs._sampled_topk_indices(
+            delta.copy(), 0.01, np.random.default_rng(42))
+        orig_native, codecs._native = codecs._native, (lambda: None)
+        try:
+            b = codecs._sampled_topk_indices(
+                delta.copy(), 0.01, np.random.default_rng(42))
+        finally:
+            codecs._native = orig_native
+        np.testing.assert_array_equal(np.sort(a), np.sort(b))
+        assert set(spikes).issubset(set(a.tolist()))
+
+    # (b) the floor: a native scan that returns 0 entries (threshold
+    # above every |delta| — NaN quantile / float-compare edges) must
+    # fall back to the single argmax entry, exactly like numpy's
+    # empty-selection branch
+    class _ZeroLib:
+        @staticmethod
+        def geo_select_threshold(delta, n, thr, cap, idx):
+            return 0
+
+    d2 = np.zeros(128, np.float32)
+    d2[7] = 1e-3
+    orig_native, codecs._native = codecs._native, (lambda: _ZeroLib())
+    try:
+        floored = codecs._sampled_topk_indices(
+            d2, 0.01, np.random.default_rng(0))
+    finally:
+        codecs._native = orig_native
+    np.testing.assert_array_equal(floored, np.array([7], np.int64))

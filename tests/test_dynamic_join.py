@@ -363,7 +363,19 @@ def test_join_under_intra_ts():
         assert len(hist) == 2, "static TS round hung"
 
         w3 = sim.add_worker(0)
-        # scheduler member sets tracked the join
+        # scheduler member sets tracked the join.  The membership
+        # broadcast is asynchronous — join_party() returning only means
+        # the SERVER folded the joiner in, not that every scheduler's
+        # hook has run yet — so poll with a short deadline instead of
+        # asserting immediately (advisor r5: flaky under load)
+        import time as _time
+
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline:
+            if all(str(w3.po.node) in sched.members
+                   for sched in sim.ts_schedulers):
+                break
+            _time.sleep(0.02)
         for sched in sim.ts_schedulers:
             assert str(w3.po.node) in sched.members
         ths = [threading.Thread(target=train, args=(w, i, 3, 2))

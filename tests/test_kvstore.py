@@ -397,3 +397,72 @@ def test_master_worker_drives_configuration():
         assert stats.get("optimizer_configured")
     finally:
         sim.shutdown()
+
+
+def test_merged_round_parks_member_pulls_until_complete():
+    """advisor r5: during a PARTIAL TS-merged round (some push carried
+    num_merge>1, so count > distinct senders) an established member's
+    pull must PARK until the round completes — its own contribution is
+    already inside the open accumulator, and serving it the previous
+    round's weights would silently diverge party replicas.  A
+    bootstrapping joiner (no push history) is still served stale — the
+    deadlock-free answer (advisor r4) — since the round genuinely
+    waits on its first push."""
+    import threading
+    import time
+
+    sim = make_sim(parties=1, workers=3)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(8, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        # round 1: plain pushes — establishes every worker's push history
+        for w in ws:
+            w.push(0, np.ones(8, np.float32))
+        np.testing.assert_allclose(ws[0].pull_sync(0), -3.0)
+        for w in ws:
+            w.wait_all()
+        # round 2, degraded merge shape: w0 pushes a partial pre-merge
+        # carrying its own + w1's contributions (num_merge=2)
+        ws[0].push(0, 2 * np.ones(8, np.float32), num_merge=2)
+        # pushes are async: the merged contribution must be IN the open
+        # accumulator before w1's pull arrives, or the server rightly
+        # serves the pull from the (count==0) completed round
+        srv = sim.local_servers[0]
+
+        def merged_landed():
+            with srv._mu:
+                return any(st.count >= 2 for st in srv._keys.values())
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not merged_landed():
+            time.sleep(0.01)
+        assert merged_landed()
+        got = {}
+        done = threading.Event()
+
+        def on_pull(t, v):
+            got["w1"] = np.array(v)
+            done.set()
+
+        ws[1].pull(0, on_pull)
+        time.sleep(0.4)
+        assert not done.is_set(), (
+            "member pull served STALE mid-merged-round (replica "
+            f"divergence): got {got.get('w1')}")
+        # a fresh joiner's bootstrap pull mid-merge is served stale (the
+        # last completed round) — parking it would deadlock its own join
+        wj = sim.add_worker(0)
+        wj.init(0, np.zeros(8, np.float32))
+        np.testing.assert_allclose(wj.pull_sync(0), -3.0)
+        # w2 + the joiner complete the round (target rose to 4 on join)
+        ws[2].push(0, np.ones(8, np.float32))
+        wj.push(0, np.ones(8, np.float32))
+        assert done.wait(timeout=30), "parked pull never served"
+        # accum = 2 (merged) + 1 + 1 = 4 → weights -3 - 4 = -7
+        np.testing.assert_allclose(got["w1"], -7.0)
+        for w in ws + [wj]:
+            w.wait_all()
+    finally:
+        sim.shutdown()

@@ -106,3 +106,31 @@ def test_codecs_use_native_and_stay_correct():
     for _ in range(30):
         total += b.decompress(0, b.compress(0, np.zeros(1000, np.float32)), 1000)
     np.testing.assert_allclose(total, x, atol=1e-5)
+
+
+def test_force_accum_override_and_eager_calibration(monkeypatch):
+    """advisor r5: GEOMX_FORCE_ACCUM pins the accumulate backend
+    outright, and the calibration runs via calibrate()/calibrate_async()
+    at server startup — accumulate() itself must only consult the
+    cached verdict (the merge path runs under the server lock)."""
+    acc = np.arange(8, dtype=np.float32)
+    v = np.ones(8, np.float32)
+
+    monkeypatch.setenv("GEOMX_FORCE_ACCUM", "numpy")
+    assert bindings.axpy_backend(4) == "numpy"
+    bindings.accumulate(acc, v)
+    np.testing.assert_allclose(acc, np.arange(8) + 1)
+
+    if bindings.available() and hasattr(bindings.lib(), "geo_axpy_acc"):
+        monkeypatch.setenv("GEOMX_FORCE_ACCUM", "native")
+        assert bindings.axpy_backend(4) == "native"
+        bindings.accumulate(acc, v, threads=2)
+        np.testing.assert_allclose(acc, np.arange(8) + 2)
+
+    monkeypatch.delenv("GEOMX_FORCE_ACCUM")
+    # eager path: calibrate() returns a definite verdict and caches it,
+    # so a subsequent locked-path accumulate never times anything
+    backend = bindings.calibrate(2)
+    assert backend in ("native", "numpy")
+    if backend != "numpy":
+        assert bindings._axpy_wins.get(2) is True

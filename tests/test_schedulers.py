@@ -425,6 +425,45 @@ def test_tsengine_push_direction_merge_tree():
         sim.shutdown()
 
 
+def test_concurrent_default_token_merge_rejected():
+    """advisor r5: two concurrent default-token merge_push calls from
+    ONE sender would silently cross-merge different rounds' gradients
+    in the shared __worker_round__ bucket — the scheduler now refuses
+    the second ask and the worker raises instead.  Per-key STRING
+    tokens (the inter-party server path) stay concurrent-safe."""
+    from geomx_tpu.sched.ts_push import TsPushScheduler, TsPushWorker
+
+    sim = make_sim(parties=1, workers=2)
+    try:
+        topo = sim.topology
+        TsPushScheduler(sim.offices[str(topo.scheduler(0))], num_workers=2)
+        kv0, kv1 = sim.worker(0, 0), sim.worker(0, 1)
+        tsp0 = TsPushWorker(kv0.po, topo.scheduler(0), kv0.worker)
+        tsp1 = TsPushWorker(kv1.po, topo.scheduler(0), kv1.worker)
+        res = {}
+
+        def first():
+            res["first"] = tsp0.merge_push({0: np.ones(8, np.float32)})
+
+        t = threading.Thread(target=first)
+        t.start()
+        time.sleep(0.3)  # the first ask is parked awaiting a pair
+        with pytest.raises(RuntimeError, match="concurrent"):
+            tsp0.merge_push({0: np.ones(8, np.float32)})
+        # the parked first ask is untouched by the rejection: worker 1
+        # joins and the round completes normally
+        res["second"] = tsp1.merge_push({0: np.ones(8, np.float32)})
+        t.join(timeout=30)
+        assert not t.is_alive()
+        elected = [m for m in res.values() if m is not None]
+        assert len(elected) == 1
+        merged, num_merge = elected[0]
+        assert num_merge == 2
+        np.testing.assert_allclose(merged[0], 2.0)
+    finally:
+        sim.shutdown()
+
+
 def test_p3_priority_queue_on_van():
     """enable_p3 switches worker vans to priority send queues."""
     sim = make_sim(parties=1, workers=1, enable_p3=True)
