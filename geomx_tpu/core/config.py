@@ -370,6 +370,16 @@ class Config:
     #                                merge, kvstore_dist_server.h:1277-1296)
     heartbeat_interval_s: float = 0.0   # 0 = off
     heartbeat_timeout_s: float = 10.0
+    # --- crash-tolerant membership (heartbeat-driven ACTUATION; requires
+    # heartbeat_interval_s > 0).  When on, each party scheduler turns an
+    # expired worker heartbeat into a synthesized forced leave (rounds and
+    # barriers fold to the survivor set; the corpse's later pushes are
+    # fenced until it rejoins), and the global scheduler folds a party
+    # whose local server died out of global rounds, then warm-boots the
+    # replacement and folds the party back in (kvstore/eviction.py)
+    enable_eviction: bool = True
+    eviction_check_interval_s: float = 0.0  # detector sweep period;
+    #                                         0 = follow heartbeat_interval_s
     verbose: int = 0
 
     def __post_init__(self):
@@ -489,6 +499,10 @@ class Config:
             ),
             heartbeat_timeout_s=_env_float(
                 "GEOMX_HEARTBEAT_TIMEOUT", _env_float("PS_HEARTBEAT_TIMEOUT", 10.0)
+            ),
+            enable_eviction=_env_bool("GEOMX_ENABLE_EVICTION", True),
+            eviction_check_interval_s=_env_float(
+                "GEOMX_EVICTION_CHECK_INTERVAL", 0.0
             ),
             verbose=_env_int("GEOMX_VERBOSE", _env_int("PS_VERBOSE", 0)),
         )

@@ -91,6 +91,36 @@ class WorkerKVStore:
         self.global_primaries: Dict[int, str] = {}
         self._primary_terms: Dict[int, int] = {}
         postoffice.add_control_hook(self._failover_hook)
+        # local-server recovery: the global scheduler's REJOIN broadcast
+        # says our party server warm-booted after a crash — replay every
+        # un-ACKed request at it immediately instead of waiting out the
+        # retry backoff (the PR 1 retarget+replay machinery, old == new)
+        self.server_recoveries = 0
+        self._last_dead_nodes = 0  # num_dead_nodes graceful degradation
+        postoffice.add_control_hook(self._server_back_hook)
+
+    def _server_back_hook(self, msg) -> bool:
+        if msg.control is not Control.REJOIN or msg.request:
+            return False
+        b = msg.body if isinstance(msg.body, dict) else {}
+        if b.get("event") != "server_back":
+            return False
+        srv = self.po.topology.server(self.party)
+        if b.get("server") not in (None, str(srv)):
+            return True  # another party's server (shouldn't reach us)
+        with self._mu:
+            # a replacement server restarts its membership seq at 0; a
+            # stale high watermark would make us discard its broadcasts
+            # forever (same reset as an explicit re-join)
+            self._membership_seen = -1
+        replayed = self.worker.retarget(srv, srv)
+        self.server_recoveries += 1
+        from geomx_tpu.utils.metrics import system_counter
+
+        system_counter(f"{self.po.node}.server_recoveries").inc()
+        print(f"{self.po.node}: party server recovered — replayed "
+              f"{replayed} un-ACKed requests", flush=True)
+        return True
 
     # ---- helpers ------------------------------------------------------------
     def _encode(self, tid: int, flat: np.ndarray, priority: int = 0) -> KVPairs:
@@ -650,10 +680,25 @@ class WorkerKVStore:
         self.worker.send_cmd(self.po.topology.server(self.party),
                              Ctrl.SET_HFA, body={"enabled": enabled, "k2": k2})
 
-    def num_dead_nodes(self) -> int:
+    def num_dead_nodes(self, timeout: float = 5.0) -> int:
         """Dead nodes known to my party scheduler (heartbeat timeouts,
-        ref: kv.get_num_dead_node kvstore_dist.h:225-234)."""
-        return len(self.po.query_dead_nodes())
+        ref: kv.get_num_dead_node kvstore_dist.h:225-234).
+
+        Degrades gracefully when the scheduler is slow or mid-failover:
+        on a query timeout this logs and returns the last known count
+        instead of propagating — callers poll it for observability, and
+        a transient scheduler stall must not kill the training loop."""
+        import logging
+
+        try:
+            n = len(self.po.query_dead_nodes(timeout=timeout))
+        except TimeoutError:
+            logging.getLogger(__name__).warning(
+                "%s: dead-node query timed out; returning last known "
+                "count (%d)", self.po.node, self._last_dead_nodes)
+            return self._last_dead_nodes
+        self._last_dead_nodes = n
+        return n
 
     def set_server_profiler(self, action: str, include_global: bool = True,
                             **kw) -> List[dict]:

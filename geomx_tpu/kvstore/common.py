@@ -135,3 +135,7 @@ class Ctrl(enum.IntEnum):
     #                            reply {"steps": int, "plan": {...}}
     #                            (state server; ref README.md:45 ESync
     #                            "to be integrated" — integrated here)
+    LIST_KEYS = 21             # body: None → reply {"keys": [...]}; a
+    #                            replacement local server's warm boot asks
+    #                            each global shard for its hosted key set
+    #                            before pulling the model state

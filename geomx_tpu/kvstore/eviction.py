@@ -1,0 +1,344 @@
+"""Crash-tolerant membership: the failure detector ACTUATES.
+
+PR 1 gave the heartbeat table its first consumer at the global tier
+(``GlobalFailoverMonitor`` → hot-standby promotion).  The two lower HiPS
+tiers still dead-waited on crashes: a worker that died without a graceful
+leave left every mid-flight aggregation round and every FSA barrier
+stalled forever, and a dead local server took its whole party offline.
+The reference leaves worker/server recovery as a TODO (ref: van.cc:224);
+production PS designs treat membership churn as the common case
+(PAPERS.md: "TensorFlow: A system for large-scale machine learning").
+
+- :class:`WorkerEvictionMonitor` (one per party scheduler): a worker
+  whose heartbeats expire past ``Config.heartbeat_timeout_s`` is turned
+  into a synthesized FORCED LEAVE — ``Control.EVICT`` to the party
+  server, which reuses the graceful-leave fold (lower per-round targets,
+  complete rounds the fold made decidable, rebroadcast membership) — and
+  is dropped from the scheduler's barrier accounting
+  (``Postoffice.exclude_node``) so barriers already waiting release to
+  the survivor set.  The eviction carries the worker's last observed
+  ``boot`` incarnation; the party server FENCES later pushes from the
+  evicted identity (zombie resume or silent restart) until it rejoins
+  through the dynamic-join door with a fresh rank, which also readmits
+  it to barriers.
+- :class:`LocalServerRecoveryMonitor` (global scheduler): a dead local
+  server folds its party OUT of mid-flight global rounds
+  (``EVICT {party_fold}`` to every global server — the graceful
+  party-leave fold, but reversible) so the WAN root keeps making
+  progress on the surviving parties.  When heartbeats resume (a
+  replacement process, or a revived zombie whose replica is now stale)
+  the monitor drives recovery: ``Control.REJOIN`` makes the local server
+  warm-boot by pulling the full model state from the global servers,
+  the party folds back into subsequent rounds (``EVICT {party_unfold}``),
+  and the party's workers are told to replay their un-ACKed requests at
+  the revived server (``KVWorker.retarget`` with old == new — the PR 1
+  replay machinery).
+
+Both monitors are sweep loops over ``Postoffice.heartbeat_info`` and run
+only when heartbeats are on (``Config.heartbeat_interval_s > 0``) and
+``Config.enable_eviction`` is true.  False positives are safe by
+construction: an evicted-but-alive worker has its pushes fenced (no
+count corruption) and rejoins for a fresh rank; a folded-but-alive party
+warm-boots (idempotent — the pull just refreshes its replica) and folds
+back in.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Dict, Optional
+
+from geomx_tpu.core.config import NodeId, Role
+from geomx_tpu.ps import Postoffice
+from geomx_tpu.transport.message import Control, Domain, Message
+from geomx_tpu.utils.metrics import system_counter
+
+_LOG = logging.getLogger(__name__)
+
+
+class _HeartbeatActuator:
+    """Shared skeleton of the two monitors: a sweep thread over the
+    scheduler's heartbeat table plus a token-matched retried-RPC helper
+    (mirrors ``GlobalFailoverMonitor._rpc_promote``)."""
+
+    def __init__(self, postoffice: Postoffice,
+                 check_interval_s: Optional[float] = None):
+        self.po = postoffice
+        self.topology = postoffice.topology
+        cfg = postoffice.config
+        self._timeout = cfg.heartbeat_timeout_s
+        self._interval = (
+            check_interval_s if check_interval_s is not None
+            else (cfg.eviction_check_interval_s
+                  or max(cfg.heartbeat_interval_s, 0.05)))
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._replies: Dict[str, dict] = {}
+        self._stop = threading.Event()
+        postoffice.add_control_hook(self._on_control)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"{type(self).__name__}-{postoffice.node}")
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            if not self.po.config.enable_eviction:
+                continue
+            try:
+                self._check()
+            except Exception:  # a sweep error must not kill the detector
+                _LOG.exception("%s: membership sweep failed", self.po.node)
+
+    def _check(self):  # pragma: no cover - subclass hook
+        raise NotImplementedError
+
+    def _on_control(self, msg: Message) -> bool:
+        if (msg.control in (Control.EVICT, Control.REJOIN)
+                and not msg.request):
+            body = msg.body if isinstance(msg.body, dict) else {}
+            token = body.get("token")
+            if token is not None:
+                with self._cv:
+                    self._replies[token] = body
+                    # unclaimed tokens (a reply that outlived its RPC's
+                    # patience) must not accumulate forever
+                    while len(self._replies) > 512:
+                        self._replies.pop(next(iter(self._replies)))
+                    self._cv.notify_all()
+                return True
+        return self._on_extra(msg)
+
+    def _on_extra(self, msg: Message) -> bool:
+        return False
+
+    def _rpc(self, target: NodeId, control: Control, body: dict,
+             domain: Domain, attempts: int = 5,
+             per_try_s: float = 2.0) -> Optional[dict]:
+        """Send ``control`` to ``target`` until a token-matched reply
+        arrives; None after ``attempts`` tries (peer down)."""
+        token = f"{self.po.node}#{uuid.uuid4().hex[:8]}"
+        body = dict(body)
+        body["token"] = token
+        for _ in range(attempts):
+            if self._stop.is_set():
+                return None
+            try:
+                self.po.van.send(Message(
+                    recipient=target, control=control, domain=domain,
+                    request=True, body=dict(body)))
+            except (KeyError, OSError):
+                pass  # peer not dialable yet — retry
+            with self._cv:
+                if self._cv.wait_for(lambda: token in self._replies,
+                                     timeout=per_try_s):
+                    return self._replies.pop(token)
+        return None
+
+    @staticmethod
+    def _age(info: dict, node_s: str, baseline: float, now: float) -> float:
+        last = info.get(node_s, (None, 0))[0]
+        return now - (last if last is not None else baseline)
+
+    def stop(self):
+        self._stop.set()
+
+
+class WorkerEvictionMonitor(_HeartbeatActuator):
+    """Party-scheduler detector/actuator for dead workers.
+
+    Tracks the party's live member set from the server's membership
+    broadcasts (so out-of-plan dynamic joiners are covered too), sweeps
+    the heartbeat table, and turns an expired member into a forced
+    leave + barrier exclusion.  A member that rejoins (named again by a
+    membership broadcast) is readmitted.
+    """
+
+    def __init__(self, postoffice: Postoffice,
+                 check_interval_s: Optional[float] = None):
+        assert postoffice.node.role is Role.SCHEDULER
+        self.party = postoffice.node.party
+        now0 = time.monotonic()
+        self._members = {str(w) for w in
+                         postoffice.topology.workers(self.party)}
+        # first-expected stamp per member: a joiner announced by a
+        # broadcast gets its grace period from the announcement, not from
+        # this scheduler's start epoch (which may be far in the past)
+        self._baseline: Dict[str, float] = {n: now0 for n in self._members}
+        self._evicted: Dict[str, int] = {}  # node -> boot at eviction
+        self._evicting: set = set()
+        self.evictions = 0
+        self._counter = system_counter(
+            f"{postoffice.node}.worker_evictions")
+        super().__init__(postoffice, check_interval_s)
+
+    def _on_extra(self, msg: Message) -> bool:
+        if (msg.control is Control.ADD_NODE and not msg.request
+                and isinstance(msg.body, dict)
+                and msg.body.get("event") == "membership"):
+            members = set(msg.body.get("members") or ())
+            now = time.monotonic()
+            readmit = []
+            with self._mu:
+                for n in members - self._members:
+                    self._baseline[n] = now
+                self._members = members
+                for n in list(self._evicted):
+                    if n in members:  # rejoined through the join door
+                        del self._evicted[n]
+                        readmit.append(n)
+            for n in readmit:
+                self.po.readmit_node(n)
+        return False  # never consumed: the TS schedulers track it too
+
+    def _check(self):
+        info, epoch = self.po.heartbeat_info()
+        now = time.monotonic()
+        with self._mu:
+            candidates = [n for n in sorted(self._members)
+                          if n not in self._evicted
+                          and n not in self._evicting]
+            baselines = dict(self._baseline)
+        for n in candidates:
+            if NodeId.parse(n).role is not Role.WORKER:
+                continue  # the local server is the global monitor's job
+            if self._age(info, n, baselines.get(n, epoch),
+                         now) <= self._timeout:
+                continue
+            boot = info.get(n, (None, 0))[1]
+            self._evict(n, boot)
+
+    def _evict(self, node_s: str, boot: int):
+        with self._mu:
+            self._evicting.add(node_s)
+        try:
+            # barrier liveness FIRST: survivors blocked on the corpse
+            # release now, not after the server RPC's retries
+            self.po.exclude_node(node_s)
+            reply = self._rpc(
+                self.topology.server(self.party), Control.EVICT,
+                {"node": node_s, "boot": boot}, Domain.LOCAL)
+            if reply is None:
+                return  # server unreachable — the next sweep retries
+            with self._mu:
+                self._evicted[node_s] = boot
+                self.evictions += 1
+            self._counter.inc()
+            print(f"{self.po.node}: evicted {node_s} (heartbeat expired, "
+                  f"boot={boot}) — rounds and barriers fold to the "
+                  "survivor set", flush=True)
+        finally:
+            with self._mu:
+                self._evicting.discard(node_s)
+
+
+class LocalServerRecoveryMonitor(_HeartbeatActuator):
+    """Global-scheduler detector/actuator for dead local servers.
+
+    Fold-out keeps the WAN root making progress while a party is dark;
+    fold-back-in runs only after the replacement warm-booted, so global
+    rounds never wait on a party that cannot push yet.
+    """
+
+    def __init__(self, postoffice: Postoffice,
+                 check_interval_s: Optional[float] = None):
+        assert postoffice.node.role is Role.GLOBAL_SCHEDULER
+        self._folded: Dict[int, int] = {}  # party -> boot at fold
+        self._busy: set = set()
+        self.party_folds = 0
+        self.party_unfolds = 0
+        self._fold_counter = system_counter(
+            f"{postoffice.node}.party_folds")
+        self._unfold_counter = system_counter(
+            f"{postoffice.node}.party_unfolds")
+        super().__init__(postoffice, check_interval_s)
+
+    def _check(self):
+        info, epoch = self.po.heartbeat_info()
+        now = time.monotonic()
+        for p in range(self.topology.num_parties):
+            node_s = str(self.topology.server(p))
+            age = self._age(info, node_s, epoch, now)
+            with self._mu:
+                if p in self._busy:
+                    continue
+                folded = p in self._folded
+            if not folded and age > self._timeout:
+                boot = info.get(node_s, (None, 0))[1]
+                self._spawn(p, self._fold, p, boot)
+            elif folded and age <= self._timeout:
+                # heartbeats resumed: a replacement process (new boot) or
+                # a revived zombie (same boot, stale replica) — both
+                # warm-boot before the party folds back in
+                self._spawn(p, self._recover, p)
+
+    def _spawn(self, party: int, fn, *args):
+        """One action in flight per party; actions block on RPC retries,
+        so they must not stall the detection sweep for other parties."""
+        with self._mu:
+            if party in self._busy:
+                return
+            self._busy.add(party)
+
+        def run():
+            try:
+                fn(*args)
+            except Exception:
+                _LOG.exception("%s: recovery action for party %d failed",
+                               self.po.node, party)
+            finally:
+                with self._mu:
+                    self._busy.discard(party)
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"party-recovery-{self.po.node}-p{party}"
+                         ).start()
+
+    def _fold(self, party: int, boot: int):
+        node_s = str(self.topology.server(party))
+        for gs in self.topology.global_servers():
+            self._rpc(gs, Control.EVICT,
+                      {"action": "party_fold", "node": node_s},
+                      Domain.GLOBAL)
+        with self._mu:
+            self._folded[party] = boot
+        self.party_folds += 1
+        self._fold_counter.inc()
+        print(f"{self.po.node}: folded party {party} out of global "
+              f"rounds ({node_s} heartbeat expired) — the WAN root "
+              "continues on the survivor parties", flush=True)
+
+    def _recover(self, party: int):
+        node = self.topology.server(party)
+        # 1. warm boot: the local server pulls the full model state from
+        #    the global tier (Control.REJOIN; the server replies once the
+        #    pull landed).  Generous retries — the pull itself takes time
+        reply = self._rpc(node, Control.REJOIN, {}, Domain.GLOBAL,
+                          attempts=8, per_try_s=5.0)
+        if reply is None or not reply.get("ok"):
+            return  # not ready yet — the next sweep retries
+        # 2. the party counts toward global rounds again
+        for gs in self.topology.global_servers():
+            self._rpc(gs, Control.EVICT,
+                      {"action": "party_unfold", "node": str(node)},
+                      Domain.GLOBAL)
+        # 3. the party's workers replay their un-ACKed requests at the
+        #    revived server NOW instead of waiting out the retry backoff
+        for w in self.topology.workers(party):
+            try:
+                self.po.van.send(Message(
+                    recipient=w, control=Control.REJOIN,
+                    domain=Domain.GLOBAL, request=False,
+                    body={"event": "server_back", "server": str(node)}))
+            except (KeyError, OSError):
+                pass  # a dead worker is the party monitor's business
+        with self._mu:
+            self._folded.pop(party, None)
+        self.party_unfolds += 1
+        self._unfold_counter.inc()
+        print(f"{self.po.node}: party {party} recovered — {node} "
+              f"warm-booted {reply.get('keys', 0)} keys and folded back "
+              "into global rounds", flush=True)

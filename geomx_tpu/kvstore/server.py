@@ -232,6 +232,20 @@ class LocalServer:
             for w in topo.workers(postoffice.node.party)}
         self.joined_workers = 0  # observability
         self.left_workers = 0
+        # heartbeat-driven eviction (kvstore/eviction.py): members the
+        # party scheduler declared dead and folded out, mapped to the
+        # boot incarnation observed at eviction.  Pushes from an evicted
+        # identity are FENCED (error, not accumulated — a zombie's late
+        # push would otherwise complete rounds early against the lowered
+        # target) until it rejoins through the dynamic-join door, which
+        # assigns a fresh rank and lifts the fence.
+        self._evicted: Dict[str, int] = {}
+        self.evicted_workers = 0
+        self.eviction_fenced_pushes = 0
+        # local-server recovery: REJOIN warm boots served (observability)
+        self.warm_boots = 0
+        self._rejoin_waiters: List[Message] = []
+        self._warm_boot_busy = False
         self.store: Dict[int, np.ndarray] = {}
         self._keys: Dict[int, _KeyState] = {}
         self._mu = threading.RLock()
@@ -242,6 +256,10 @@ class LocalServer:
         self.server = KVServer(APP_PS, 0, postoffice, self._handle)
         self.server.cmd_handler = self._on_cmd
         postoffice.add_control_hook(self._on_add_node)
+        # crash-tolerant membership: forced leaves from the party
+        # scheduler's eviction monitor + warm-boot rejoin after a crash
+        postoffice.add_control_hook(self._on_evict)
+        postoffice.add_control_hook(self._on_rejoin)
         # global-tier failover: the scheduler's NEW_PRIMARY broadcast
         # retargets the up-link and replays un-ACKed WAN requests
         self.failover_events = 0
@@ -431,34 +449,12 @@ class LocalServer:
             # push leaks into the next round (one stale gradient, the
             # same staleness class the async tier tolerates).
             with self._mu:
-                if node_s not in self._members:
-                    # replayed leave (or never-joined): idempotent no-op
-                    total = self._workers_target
-                    seq = self._membership_seq
-                    completed = []
-                else:
-                    del self._members[node_s]
-                    self._member_addrs.pop(node_s, None)
-                    self._workers_target = max(1, self._workers_target - 1)
-                    self._membership_seq += 1
+                if self._fold_member_out_locked(node_s):
                     self.left_workers += 1
-                    total = self._workers_target
-                    seq = self._membership_seq
-                    completed = []
-                    for k, st in self._keys.items():
-                        if st.accum is not None and st.expected:
-                            st.expected = max(1, st.expected - 1)
-                            if (st.count >= st.expected
-                                    and not st.completing):
-                                st.completing = True
-                                completed.append(k)
-                if completed:
-                    # complete UNDER the lock (RLock re-entry); keys a
-                    # concurrent push already slated (st.completing) were
-                    # skipped above — without the flag both paths would
-                    # run _round_complete for one key and the second
-                    # would crash on the already-taken accumulator
-                    self._round_complete(completed)
+                # replayed leave (or never-joined): idempotent no-op —
+                # the reply still carries the current (total, seq) pair
+                total = self._workers_target
+                seq = self._membership_seq
             self._broadcast_membership()
             # the reply carries the SAME (total, seq) pair as broadcasts
             # — the client applies it through the same stale-guard, so a
@@ -469,6 +465,11 @@ class LocalServer:
                 "token": body.get("token")}))
             return True
         with self._mu:
+            # a rejoin through the join door lifts the eviction fence —
+            # the node re-enters the count under a FRESH rank (its old
+            # membership entry was deleted at eviction), so there is no
+            # double count to fear
+            self._evicted.pop(node_s, None)
             if node_s in self._members:
                 # replayed join (client retry after a lost reply): same
                 # rank, no double count
@@ -523,6 +524,179 @@ class LocalServer:
             "rank": rank, "num_workers": total, "seq": seq,
             "token": body.get("token")}))
         return True
+
+    def _fold_member_out_locked(self, node_s: str) -> bool:
+        """Remove ``node_s`` from the aggregation group and fold
+        mid-flight rounds down to the survivor set: lower each open
+        round's target, complete rounds the fold made decidable (they
+        would otherwise stall forever waiting for the gone member).
+        The shared core of graceful leave and heartbeat eviction.
+        Caller holds ``_mu``; returns False for a non-member (replayed
+        leave / double eviction)."""
+        if node_s not in self._members:
+            return False
+        del self._members[node_s]
+        self._member_addrs.pop(node_s, None)
+        self._workers_target = max(1, self._workers_target - 1)
+        self._membership_seq += 1
+        completed = []
+        for k, st in self._keys.items():
+            if st.accum is not None and st.expected:
+                st.expected = max(1, st.expected - 1)
+                if st.count >= st.expected and not st.completing:
+                    st.completing = True
+                    completed.append(k)
+        if completed:
+            # complete UNDER the lock (RLock re-entry); keys a
+            # concurrent push already slated (st.completing) were
+            # skipped above — without the flag both paths would
+            # run _round_complete for one key and the second
+            # would crash on the already-taken accumulator
+            self._round_complete(completed)
+        return True
+
+    def _on_evict(self, msg: Message) -> bool:
+        """Control.EVICT from the party scheduler's eviction monitor: a
+        worker's heartbeats expired, so synthesize the leave it never
+        sent (same fold as a graceful leave), then FENCE the evicted
+        identity — the scheduler recorded the corpse's last ``boot``
+        incarnation, and any later push from it (zombie resume, or a
+        silent restart that skipped the join door) is rejected with a
+        rejoin hint instead of corrupting the lowered round counts.
+        ``join_party`` lifts the fence with a fresh rank.  Idempotent."""
+        if msg.control is not Control.EVICT or not msg.request:
+            return False
+        body = msg.body if isinstance(msg.body, dict) else {}
+        if "node" not in body or body.get("action"):
+            return False  # party_fold/unfold belong to the global tier
+        node_s = str(body["node"])
+        boot = int(body.get("boot", 0))
+        with self._mu:
+            folded = self._fold_member_out_locked(node_s)
+            if folded:
+                self.evicted_workers += 1
+            self._evicted.setdefault(node_s, boot)
+            total = self._workers_target
+        if folded:
+            from geomx_tpu.utils.metrics import system_counter
+
+            system_counter(f"{self.po.node}.evicted_workers").inc()
+            print(f"{self.po.node}: evicted {node_s} (forced leave, "
+                  f"boot={boot}) — pushes fenced until it rejoins",
+                  flush=True)
+            self._broadcast_membership()
+        self.po.van.send(msg.reply_to(control=Control.EVICT, body={
+            "evicted": folded, "num_workers": total,
+            "token": body.get("token")}))
+        return True
+
+    def _fence_evicted_push(self, msg: Message, sender_s: str) -> bool:
+        """Reject a push from an evicted identity (caller already passed
+        the replay-dedup check, so pre-eviction pushes re-ack normally).
+        Returns True when the push was fenced and answered."""
+        with self._mu:
+            if sender_s not in self._evicted or sender_s in self._members:
+                return False
+            boot = self._evicted[sender_s]
+            self.eviction_fenced_pushes += 1
+        from geomx_tpu.utils.metrics import system_counter
+
+        system_counter(f"{self.po.node}.eviction_fenced_pushes").inc()
+        err = {"error": f"evicted: {sender_s} was declared dead "
+                        f"(boot={boot}) and folded out of the "
+                        "aggregation group; rejoin via join_party for a "
+                        "fresh rank"}
+        self._recent.mark_done(msg, err)
+        self.server.response(msg, body=err)
+        return True
+
+    def _on_rejoin(self, msg: Message) -> bool:
+        """Control.REJOIN request from the global scheduler's recovery
+        monitor: this (replacement or revived) local server must adopt
+        the global tier's current model state before its party folds
+        back into global rounds.  The pull blocks on WAN round-trips, so
+        it runs off the hook thread; the reply is sent on completion —
+        the monitor retries until it hears one, and retries while a boot
+        is in flight just queue behind it (idempotent)."""
+        if msg.control is not Control.REJOIN or not msg.request:
+            return False
+        with self._mu:
+            self._rejoin_waiters.append(msg)
+            if self._warm_boot_busy:
+                return True
+            self._warm_boot_busy = True
+        threading.Thread(target=self._warm_boot_thread, daemon=True,
+                         name=f"warm-boot-{self.po.node}").start()
+        return True
+
+    def _warm_boot_thread(self):
+        try:
+            n = self.warm_boot()
+            ok = True
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "%s: warm boot failed", self.po.node)
+            n, ok = 0, False
+        with self._mu:
+            waiters, self._rejoin_waiters = self._rejoin_waiters, []
+            self._warm_boot_busy = False
+        for m in waiters:
+            try:
+                self.po.van.send(m.reply_to(control=Control.REJOIN, body={
+                    "ok": ok, "keys": n,
+                    "token": (m.body or {}).get("token")}))
+            except (KeyError, OSError):
+                pass  # the monitor re-asks
+
+    def warm_boot(self) -> int:
+        """Adopt the global tier's full model state: ask each shard for
+        its hosted key set (Ctrl.LIST_KEYS), pull those keys DENSE (a
+        fresh replica has no view for a compressed delta to apply to),
+        and install them — aborting any stale in-flight aggregation
+        state (a revived zombie's open rounds refer to a world that
+        moved on).  Returns the number of keys adopted."""
+        keys = set()
+        for gs in list(self.up.targets):
+            reply = self.up.send_cmd(gs, Ctrl.LIST_KEYS,
+                                     domain=Domain.GLOBAL) or {}
+            keys.update(int(k) for k in reply.get("keys", ()))
+        got: Dict[int, np.ndarray] = {}
+        if keys:
+            def adopt(kvs):
+                for k, v in kvs.slices():
+                    got[int(k)] = np.array(v, dtype=np.float32, copy=True)
+
+            self.up.zpull(sorted(keys), cb=adopt, wait=True,
+                          body={"dense": True})
+        with self._mu:
+            for k, v in got.items():
+                self.store[k] = v
+                self._milestone[k] = np.array(v, copy=True)
+                st = self._keys.setdefault(k, _KeyState())
+                st.accum = None
+                st.count = 0
+                st.in_flight = 0
+                st.completing = False
+                st.contributors = set()
+                st.hfa_inv = 0.0
+                st.epoch += 1  # invalidate pre-crash pull-downs
+                # the global tier's tracked subscriber view (BSC) no
+                # longer matches this replica; -1 never equals a tracked
+                # version, so the next compressed pull resyncs dense
+                self._pull_ver[k] = -1
+                self._drain_parked_locked(st)
+            self.warm_boots += 1
+        from geomx_tpu.utils.metrics import system_counter
+
+        system_counter(f"{self.po.node}.warm_boots").inc()
+        # re-sync the party's 1/num_workers pre-scale and membership (a
+        # replacement process restarted the count at the static plan)
+        self._broadcast_membership()
+        print(f"{self.po.node}: warm boot adopted {len(got)} keys from "
+              "the global tier", flush=True)
+        return len(got)
 
     def _on_new_primary(self, msg: Message) -> bool:
         """Global-tier failover (Control.NEW_PRIMARY from the global
@@ -596,13 +770,15 @@ class LocalServer:
             else:
                 self.server.response(msg, body=self._recent.done_body(msg))
             return
+        sender_s = str(msg.sender)
+        if self._fence_evicted_push(msg, sender_s):
+            return  # evicted identity: rejected, told to rejoin
         completed: List[int] = []
         # a TS-merged push carries several workers' contributions at once
         # (ref: num_merge counting van.cc:1197-1252)
         num_merge = 1
         if isinstance(msg.body, dict):
             num_merge = int(msg.body.get("num_merge", 1))
-        sender_s = str(msg.sender)
         hfa_n = None
         if self.hfa_enabled:
             # each HFA push announces the denominator it pre-scaled its
@@ -685,6 +861,8 @@ class LocalServer:
         if state == "done":
             self.server.response(msg, body=self._recent.done_body(msg))
             return
+        if self._fence_evicted_push(msg, str(msg.sender)):
+            return  # evicted identity: rejected, told to rejoin
         if self.hfa_enabled:
             # reject with an error body the client surfaces on wait_all()
             # — a bare ACK would let training silently diverge
@@ -1246,6 +1424,10 @@ class LocalServer:
                 "hfa_gated_key_rounds": self.hfa_gated_key_rounds,
                 "ts_deliveries": self.ts_deliveries,
                 "stale_pull_skips": self.stale_pull_skips,
+                # crash-tolerant membership observability
+                "evicted_workers": self.evicted_workers,
+                "eviction_fenced_pushes": self.eviction_fenced_pushes,
+                "warm_boots": self.warm_boots,
                 "mpq_bsc_picks": getattr(self.push_codec, "bsc_picks", 0),
                 "mpq_fp16_picks": getattr(self.push_codec, "fp16_picks", 0),
                 "pq_overtakes": van.pq_overtakes,
@@ -1418,7 +1600,14 @@ class GlobalServer:
                 postoffice, topo.global_scheduler(), domain=Domain.GLOBAL)
         # parties that announced a graceful leave (idempotency set)
         self._left_parties: set = set()
+        # parties folded out REVERSIBLY because their local server died
+        # (kvstore/eviction.py LocalServerRecoveryMonitor): same fold as
+        # a leave, but a warm-booted replacement folds back in
+        self._folded_parties: set = set()
+        self.party_folds = 0
+        self.party_unfolds = 0
         postoffice.add_control_hook(self._on_add_node)
+        postoffice.add_control_hook(self._on_evict)
         postoffice.add_control_hook(self._on_promote)
         postoffice.add_control_hook(self._on_new_primary)
         self.server = KVServer(APP_PS, 0, postoffice, self._handle)
@@ -1452,18 +1641,12 @@ class GlobalServer:
         with self._mu:
             if node_s not in self._left_parties:
                 self._left_parties.add(node_s)
-                self.num_contributors = max(1, self.num_contributors - 1)
-                completed = [k for k, st in self._keys.items()
-                             if st.accum is not None
-                             and st.count >= self.num_contributors]
-                # drop per-sender optimizer bookkeeping (DCASGD's
-                # previous-weight backups) — a departed party's
-                # full-model snapshots would otherwise stay pinned in
-                # RAM for the rest of the run
-                for st_opt in self.optimizer.state.values():
-                    prev = st_opt.get("prev")
-                    if isinstance(prev, dict):
-                        prev.pop(node_s, None)
+                # a crashed party that leaves gracefully later (odd but
+                # possible) must not double-decrement
+                already_folded = node_s in self._folded_parties
+                self._folded_parties.discard(node_s)
+                completed = ([] if already_folded
+                             else self._fold_party_out_locked(node_s))
             else:
                 completed = []  # replayed leave: no double decrement
             # HFA-mode rounds accumulate milestone DELTAS (additive);
@@ -1473,6 +1656,72 @@ class GlobalServer:
             total = self.num_contributors
         self._flush_completions(to_ack, dissem)
         self.po.van.send(msg.reply_to(control=Control.ADD_NODE, body={
+            "num_global_workers": total, "token": body.get("token")}))
+        return True
+
+    def _fold_party_out_locked(self, node_s: str) -> List[int]:
+        """Lower the aggregation target by one party; returns the keys
+        whose mid-flight rounds the fold made decidable (they would
+        otherwise stall forever waiting for the gone party).  Shared by
+        the graceful party leave and the reversible crash fold.  Caller
+        holds ``_mu`` and runs the returned keys through
+        ``_complete_keys_locked``."""
+        self.num_contributors = max(1, self.num_contributors - 1)
+        completed = [k for k, st in self._keys.items()
+                     if st.accum is not None
+                     and st.count >= self.num_contributors]
+        # drop per-sender optimizer bookkeeping (DCASGD's
+        # previous-weight backups) — a departed party's full-model
+        # snapshots would otherwise stay pinned in RAM
+        for st_opt in self.optimizer.state.values():
+            prev = st_opt.get("prev")
+            if isinstance(prev, dict):
+                prev.pop(node_s, None)
+        return completed
+
+    def _on_evict(self, msg: Message) -> bool:
+        """Reversible party fold (Control.EVICT from the global
+        scheduler's LocalServerRecoveryMonitor): a party whose local
+        server died stops counting toward global rounds — the graceful
+        party-leave fold, but reversible — and counts again once its
+        replacement warm-booted (``party_unfold``).  Idempotent per
+        party in both directions."""
+        if msg.control is not Control.EVICT or not msg.request:
+            return False
+        body = msg.body if isinstance(msg.body, dict) else {}
+        action = body.get("action")
+        if action not in ("party_fold", "party_unfold"):
+            return False
+        node_s = str(body.get("node", msg.sender))
+        to_ack: List[tuple] = []
+        dissem = None
+        changed = False
+        with self._mu:
+            if action == "party_fold":
+                if (node_s not in self._folded_parties
+                        and node_s not in self._left_parties):
+                    self._folded_parties.add(node_s)
+                    self.party_folds += 1
+                    changed = True
+                    completed = self._fold_party_out_locked(node_s)
+                    to_ack, dissem = self._complete_keys_locked(
+                        completed, hfa_delta=self.config.use_hfa,
+                        dissem_ok=True)
+            else:  # party_unfold
+                if node_s in self._folded_parties:
+                    self._folded_parties.discard(node_s)
+                    self.num_contributors += 1
+                    self.party_unfolds += 1
+                    changed = True
+            total = self.num_contributors
+        if changed:
+            from geomx_tpu.utils.metrics import system_counter
+
+            system_counter(f"{self.po.node}.{action}s").inc()
+            print(f"{self.po.node}: {action} {node_s} "
+                  f"(num_global_workers={total})", flush=True)
+        self._flush_completions(to_ack, dissem)
+        self.po.van.send(msg.reply_to(control=Control.EVICT, body={
             "num_global_workers": total, "token": body.get("token")}))
         return True
 
@@ -1815,10 +2064,14 @@ class GlobalServer:
     def _respond_pull(self, req: Message):
         # HFA K2 pulls must come back dense: the subscriber's replica just
         # adopted its party mean, so sparse deltas against the tracked
-        # view would desync it
+        # view would desync it.  A warm-boot pull (body {"dense": True})
+        # is dense for the same reason — the fresh replica has no view
+        # for a delta (or an fp16 downgrade) to be safe against
         hfa_pull = req.cmd == Cmd.HFA_DELTA
-        if not hfa_pull and (self.pull_comp is not None
-                             or self.compression.get("type") == "fp16"):
+        dense = hfa_pull or (isinstance(req.body, dict)
+                             and bool(req.body.get("dense")))
+        if not dense and (self.pull_comp is not None
+                          or self.compression.get("type") == "fp16"):
             self._respond_pull_compressed(req)
             return
         ks, vs, ls = [], [], []
@@ -2168,7 +2421,18 @@ class GlobalServer:
                 "replication_seq": self._repl_seq,
                 "replication_acked_seq": (self._repl.acked_seq
                                           if self._repl is not None else 0),
+                # crash-tolerant membership: reversible party folds
+                "party_folds": self.party_folds,
+                "party_unfolds": self.party_unfolds,
+                "num_global_workers": self.num_contributors,
             })
+            return
+        elif msg.cmd == Ctrl.LIST_KEYS:
+            # a replacement local server's warm boot asks for the hosted
+            # key set before pulling the model state (kvstore/eviction.py)
+            with self._mu:
+                ks = sorted(int(k) for k in self.store)
+            self.server.reply_cmd(msg, body={"keys": ks})
             return
         elif msg.cmd == Ctrl.PROFILER:
             _handle_profiler_cmd(self.po, msg, self.server)

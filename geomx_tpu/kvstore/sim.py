@@ -93,6 +93,20 @@ class Simulation:
             from geomx_tpu.kvstore.client import MasterWorker
 
             self.master = MasterWorker(self.offices[str(mw)], config)
+        # crash-tolerant membership (kvstore/eviction.py): when
+        # heartbeats are on, each party scheduler evicts dead workers
+        # and the global scheduler folds/recovers dead local servers
+        self.eviction_monitors = []
+        self.recovery_monitor = None
+        if config.heartbeat_interval_s > 0 and config.enable_eviction:
+            from geomx_tpu.kvstore.eviction import (
+                LocalServerRecoveryMonitor, WorkerEvictionMonitor)
+
+            for p in range(self.topology.num_parties):
+                self.eviction_monitors.append(WorkerEvictionMonitor(
+                    self.offices[str(self.topology.scheduler(p))]))
+            self.recovery_monitor = LocalServerRecoveryMonitor(
+                self.offices[str(self.topology.global_scheduler())])
 
     def worker(self, party: int, rank: int) -> WorkerKVStore:
         return self.workers[str(NodeId.parse(f"worker:{rank}@p{party}"))]
@@ -135,6 +149,45 @@ class Simulation:
         gs.po.stop()
         return gs
 
+    def kill_worker(self, party: int, rank: int) -> WorkerKVStore:
+        """Thread-level SIGKILL of a worker: its van neither receives
+        nor transmits (``Van.kill``), its heartbeat and client retry
+        loop die, and NO leave message is sent — recovery is the party
+        scheduler's eviction monitor's job.  ``kv.po.start()`` later
+        revives the same incarnation as a ZOMBIE (same boot nonce) whose
+        pushes the server fences until it rejoins."""
+        kv = self.worker(party, rank)
+        kv.worker._retry_stop.set()
+        kv.po.van.kill()
+        kv.po.stop()
+        return kv
+
+    def kill_local_server(self, party: int) -> LocalServer:
+        """Thread-level SIGKILL of a party's local server: no leave, no
+        checkpoint, the WAN up-link stops replaying.  The global
+        scheduler's recovery monitor folds the party out of global
+        rounds; ``restart_local_server`` brings up the replacement."""
+        ls = self.local_servers[party]
+        ls.up._retry_stop.set()
+        ls.po.van.kill()
+        ls.po.stop()
+        return ls
+
+    def restart_local_server(self, party: int) -> LocalServer:
+        """Stand up a REPLACEMENT local-server process for the party:
+        fresh postoffice (new boot incarnation), empty store — exactly
+        what a relaunched ``--role server:0@pK`` has.  The recovery
+        monitor detects the resumed heartbeats, drives the warm-boot
+        pull from the global tier, folds the party back in, and tells
+        the workers to replay their un-ACKed requests."""
+        n = self.topology.server(party)
+        po = Postoffice(n, self.topology, self.fabric, self.config)
+        ls = LocalServer(po, self.config)
+        po.start()
+        self.offices[str(n)] = po
+        self.local_servers[party] = ls
+        return ls
+
     def wan_bytes(self) -> dict:
         """Total WAN traffic (tier-2 links) across the deployment."""
         send = sum(ls.po.van.wan_send_bytes for ls in self.local_servers)
@@ -146,6 +199,10 @@ class Simulation:
     def shutdown(self):
         if self.failover_monitor is not None:
             self.failover_monitor.stop()
+        for m in self.eviction_monitors:
+            m.stop()
+        if self.recovery_monitor is not None:
+            self.recovery_monitor.stop()
         if self.master is not None:
             self.master.stop()
         for w in self.workers.values():

@@ -110,6 +110,21 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
 
             TsPushScheduler(
                 po, num_workers=config.topology.num_global_workers)
+    if (node.role is Role.SCHEDULER and config.heartbeat_interval_s > 0
+            and config.enable_eviction):
+        # crash-tolerant membership: this party scheduler turns expired
+        # worker heartbeats into forced leaves + barrier releases
+        from geomx_tpu.kvstore.eviction import WorkerEvictionMonitor
+
+        role_obj = role_obj or WorkerEvictionMonitor(po)
+    if (node.role is Role.GLOBAL_SCHEDULER
+            and config.heartbeat_interval_s > 0
+            and config.enable_eviction):
+        # dead local servers fold their party out of global rounds; a
+        # warm-booted replacement folds back in (kvstore/eviction.py)
+        from geomx_tpu.kvstore.eviction import LocalServerRecoveryMonitor
+
+        role_obj = role_obj or LocalServerRecoveryMonitor(po)
     if (node.role is Role.GLOBAL_SCHEDULER
             and config.topology.num_standby_globals
             and config.heartbeat_interval_s > 0):
@@ -654,7 +669,18 @@ def main(argv=None):
     # term fencing, client-side retarget+replay)
     for attr, tag in (("failover_events", "failover_events"),
                       ("promotions", "promotions"),
-                      ("fenced_rejects", "fenced_rejects")):
+                      ("fenced_rejects", "fenced_rejects"),
+                      # crash-tolerant membership observables: evictions
+                      # actuated (schedulers), fenced zombies + warm
+                      # boots (local servers), party folds (global tier),
+                      # replay-on-recovery (workers)
+                      ("evictions", "worker_evictions"),
+                      ("evicted_workers", "evicted_workers"),
+                      ("eviction_fenced_pushes", "eviction_fenced"),
+                      ("warm_boots", "warm_boots"),
+                      ("party_folds", "party_folds"),
+                      ("party_unfolds", "party_unfolds"),
+                      ("server_recoveries", "server_recoveries")):
         v = getattr(role_obj, attr, 0)
         if v:
             feats.append(f"{tag}={v}")

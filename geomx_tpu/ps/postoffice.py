@@ -83,12 +83,20 @@ class Postoffice:
         # scheduler-side barrier counting: (group_token) -> list of waiters
         self._barrier_waiting: Dict[str, List[Message]] = {}
         # heartbeat bookkeeping (scheduler side: last-seen per node,
-        # ref: Van::ProcessHeartbeat van.cc:242-257, UpdateHeartbeat)
+        # ref: Van::ProcessHeartbeat van.cc:242-257, UpdateHeartbeat).
+        # ``_hb_boots`` records each sender's Van incarnation nonce so the
+        # eviction actuator can fence the exact incarnation it declared
+        # dead (kvstore/eviction.py)
         self._heartbeats: Dict[str, float] = {}
+        self._hb_boots: Dict[str, int] = {}
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
         self._hb_epoch = 0.0
-        self._dead_replies: Dict[int, List[str]] = {}
+        self._dead_replies: Dict[int, dict] = {}
+        # scheduler-side barrier exclusion: members declared dead by the
+        # eviction monitor stop counting toward barrier quorums, so FSA
+        # degrades to the survivor set instead of timing out
+        self._excluded: set = set()
         self._started = False
 
     # ---- lifecycle ----------------------------------------------------------
@@ -203,39 +211,74 @@ class Postoffice:
             return [n for n in expected
                     if now - self._heartbeats.get(n, self._hb_epoch) > timeout_s]
 
+    def heartbeat_info(self):
+        """Scheduler-side copy of the heartbeat table:
+        ``({node: (last_seen_monotonic, boot)}, epoch)`` where ``epoch``
+        is this scheduler's start time — the age baseline for nodes never
+        heard from.  The eviction monitors (kvstore/eviction.py) sweep
+        this instead of :meth:`dead_nodes` because they also watch
+        out-of-plan dynamic joiners and need the ``boot`` incarnation to
+        fence exactly the corpse they declared dead."""
+        with self._lock:
+            return ({n: (t, self._hb_boots.get(n, 0))
+                     for n, t in self._heartbeats.items()},
+                    self._hb_epoch)
+
     def query_dead_nodes(self, timeout: float = 10.0) -> List[str]:
         """Ask my scheduler for its dead-node list
         (ref: kv.get_num_dead_node kvstore_dist.h:225-234)."""
         if self.node.role.is_scheduler:
             return self.dead_nodes()
+        sched, domain = self._my_scheduler()
+        return self._query_dead_body(sched, domain, timeout).get("dead", [])
+
+    def _my_scheduler(self):
         sched = (self.topology.global_scheduler()
                  if self.node.role in (Role.GLOBAL_SERVER,
                                        Role.STANDBY_GLOBAL)
                  else self.topology.scheduler(self.node.party))
         domain = (Domain.GLOBAL if sched.role is Role.GLOBAL_SCHEDULER
                   else Domain.LOCAL)
+        return sched, domain
+
+    def _query_dead_body(self, sched: NodeId, domain: Domain,
+                         timeout: float, barrier_info: Optional[dict] = None,
+                         ) -> dict:
+        """DEAD_NODES round-trip to ``sched``; optionally asks for the
+        entered-member list of one barrier token (the timeout-diagnosis
+        path of :meth:`barrier`)."""
         with self._barrier_cv:
             self._barrier_seq += 1
             seq = self._barrier_seq
         self.van.send(Message(
             recipient=sched, control=Control.DEAD_NODES, domain=domain,
-            request=True, timestamp=seq))
+            request=True, timestamp=seq,
+            body={"barrier": barrier_info} if barrier_info else None))
         with self._barrier_cv:
             ok = self._barrier_cv.wait_for(
                 lambda: seq in self._dead_replies, timeout=timeout)
             if not ok:
                 raise TimeoutError(f"{self.node}: dead-node query timed out")
-            return self._dead_replies.pop(seq)
+            reply = self._dead_replies.pop(seq)
+        return reply if isinstance(reply, dict) else {"dead": reply}
 
     def _dispatch(self, msg: Message):
         if msg.control is Control.DEAD_NODES:
             if msg.request:
+                body = {"dead": self.dead_nodes()}
+                req_b = msg.body if isinstance(msg.body, dict) else {}
+                binfo = req_b.get("barrier")
+                if binfo:
+                    # barrier diagnosis: who already entered this token
+                    token = f"{binfo['group']}@{binfo['party']}"
+                    with self._lock:
+                        waiting = list(self._barrier_waiting.get(token, ()))
+                    body["entered"] = sorted({str(m.sender) for m in waiting})
                 self.van.send(msg.reply_to(
-                    control=Control.DEAD_NODES,
-                    body={"dead": self.dead_nodes()}))
+                    control=Control.DEAD_NODES, body=body))
             else:
                 with self._barrier_cv:
-                    self._dead_replies[msg.timestamp] = msg.body["dead"]
+                    self._dead_replies[msg.timestamp] = msg.body
                     self._barrier_cv.notify_all()
             return
         if msg.control is Control.HEARTBEAT:
@@ -243,6 +286,7 @@ class Postoffice:
 
             with self._lock:
                 self._heartbeats[str(msg.sender)] = _time.monotonic()
+                self._hb_boots[str(msg.sender)] = msg.boot
             return
         if msg.control is Control.BARRIER:
             self._handle_barrier(msg)
@@ -311,20 +355,73 @@ class Postoffice:
                 lambda: self._barrier_done.pop(seq, False), timeout=timeout
             )
         if not ok:
-            raise TimeoutError(f"{self.node}: barrier on {group} timed out")
+            # diagnosable stall: ask the scheduler who is dead and who
+            # never entered this token, so the exception alone names the
+            # culprit.  Best-effort — a dead scheduler degrades to the
+            # bare message
+            detail = ""
+            try:
+                body = self._query_dead_body(
+                    sched, domain,
+                    timeout=min(5.0, timeout or 5.0),
+                    barrier_info={"group": group.value, "party": party})
+                entered = set(body.get("entered", ()))
+                missing = sorted(str(m) for m in members
+                                 if str(m) not in entered
+                                 and m != self.node)
+                detail = (f" (scheduler dead-node list: "
+                          f"{body.get('dead', [])}; members that never "
+                          f"entered: {missing})")
+            except Exception:
+                pass
+            raise TimeoutError(
+                f"{self.node}: barrier on {group} timed out{detail}")
+
+    def exclude_node(self, node_s: str):
+        """Scheduler-side (eviction actuator): drop a dead member from
+        barrier accounting and release every barrier that is now
+        satisfied without it — waiting survivors must not ride out the
+        full timeout for a corpse that can never enter."""
+        assert self.node.role.is_scheduler
+        to_release: List[Message] = []
+        with self._lock:
+            self._excluded.add(node_s)
+            for token in list(self._barrier_waiting):
+                waiting = self._barrier_waiting[token]
+                if len(waiting) >= len(self._alive_members_locked(token)):
+                    to_release.extend(self._barrier_waiting.pop(token))
+        for req in to_release:
+            self.van.send(req.reply_to(body={"seq": req.body["seq"]}))
+
+    def readmit_node(self, node_s: str):
+        """Inverse of :meth:`exclude_node` — an evicted member rejoined
+        (membership broadcast names it again), so it counts toward
+        barrier quorums once more."""
+        with self._lock:
+            self._excluded.discard(node_s)
+
+    def _alive_members_locked(self, token: str) -> List[NodeId]:
+        """Barrier quorum for ``token`` minus evicted members (caller
+        holds ``_lock``)."""
+        gval, pval = token.rsplit("@", 1)
+        group = Group(int(gval))
+        party = None if pval == "None" else int(pval)
+        members = self.topology.members(group, party=party)
+        return [m for m in members if str(m) not in self._excluded]
 
     def _handle_barrier(self, msg: Message):
         if msg.request:
-            # scheduler side: count entries for this (group, party)
+            # scheduler side: count entries for this (group, party);
+            # evicted members don't count toward the quorum
             assert self.node.role.is_scheduler, f"{self.node} got barrier request"
             group = Group(msg.body["group"])
             party = msg.body["party"]
             token = f"{group.value}@{party}"
-            members = self.topology.members(group, party=party)
             with self._lock:
+                alive = self._alive_members_locked(token)
                 waiting = self._barrier_waiting.setdefault(token, [])
                 waiting.append(msg)
-                if len(waiting) < len(members):
+                if len(waiting) < len(alive):
                     return
                 released = self._barrier_waiting.pop(token)
             for req in released:

@@ -47,6 +47,18 @@ class Control(enum.Enum):
     NEW_PRIMARY = 13   # scheduler -> everyone: the shard's new primary
     #                    identity + fencing term; clients retarget and
     #                    replay, a zombie ex-primary demotes itself
+    # crash-tolerant membership (the tiers below the global root): the
+    # heartbeat failure detector ACTUATES instead of just observing
+    EVICT = 14         # scheduler -> server: synthesized forced leave of a
+    #                    heartbeat-expired member (worker eviction at the
+    #                    party tier; reversible party fold/unfold at the
+    #                    global tier — body: {node, boot} or
+    #                    {action: "party_fold"|"party_unfold", node})
+    REJOIN = 15        # request (global scheduler -> local server): warm-
+    #                    boot by pulling model state from the global tier;
+    #                    broadcast (scheduler -> party workers, body:
+    #                    {event: "server_back"}): the party server
+    #                    recovered — replay un-ACKed requests at it now
 
 
 class Domain(enum.Enum):

@@ -101,6 +101,22 @@ class TcpFabric:
         #                       sendto failures), distinct from `dropped`
         #                       which also counts reliable-channel
         #                       drop injection
+        # system-metrics mirrors of the two loss ledgers, named by the
+        # first registered node (one process = one fabric): transport
+        # loss shows up in utils.metrics.system_snapshot next to the
+        # failover / replication / eviction counters
+        self._sys_dropped = None
+        self._sys_udp_dropped = None
+
+    def _count_drop(self, udp: bool = False):
+        """Ledger a lost message (caller holds ``_registry_mu``)."""
+        self.dropped += 1
+        if self._sys_dropped is not None:
+            self._sys_dropped.inc()
+        if udp:
+            self.udp_dropped += 1
+            if self._sys_udp_dropped is not None:
+                self._sys_udp_dropped.inc()
 
     # ---- local side ---------------------------------------------------------
     def register(self, node: NodeId) -> _Mailbox:
@@ -155,6 +171,11 @@ class TcpFabric:
             srv.close()
             raise
         self._boxes[s] = box
+        if self._sys_dropped is None:
+            from geomx_tpu.utils.metrics import system_counter
+
+            self._sys_dropped = system_counter(f"{s}.tcp_dropped")
+            self._sys_udp_dropped = system_counter(f"{s}.tcp_udp_dropped")
         self._listeners.append(srv)
         threading.Thread(target=self._accept_loop, args=(srv, box),
                          name=f"tcp-accept-{s}", daemon=True).start()
@@ -241,7 +262,6 @@ class TcpFabric:
     def deliver(self, msg: Message) -> bool:
         if self.fault.should_drop(msg):
             with self._registry_mu:
-                self.dropped += 1
                 # separate ledger: DGT acceptance metrics must not
                 # conflate lossy-channel loss with reliable-channel drop
                 # injection — and only count it as UDP loss if the
@@ -250,10 +270,10 @@ class TcpFabric:
                 # nbytes underestimates the serialized frame (headers /
                 # keys / lens); leave margin so a message the real path
                 # would have sent over TCP isn't ledgered as UDP loss
-                if (msg.channel >= 1
-                        and str(msg.recipient) not in self._boxes
-                        and msg.nbytes <= self.UDP_MAX - 4096):
-                    self.udp_dropped += 1
+                self._count_drop(udp=(
+                    msg.channel >= 1
+                    and str(msg.recipient) not in self._boxes
+                    and msg.nbytes <= self.UDP_MAX - 4096))
             return False
         dest = str(msg.recipient)
         box = self._boxes.get(dest)
@@ -271,8 +291,7 @@ class TcpFabric:
                 self._udp_sock(msg.channel).sendto(data, (host, port))
             except OSError:
                 with self._registry_mu:
-                    self.dropped += 1
-                    self.udp_dropped += 1
+                    self._count_drop(udp=True)
                 return False
             with self._registry_mu:
                 self.udp_datagrams_sent += 1

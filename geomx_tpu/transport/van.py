@@ -320,6 +320,11 @@ class Van:
             fp is not None and (getattr(fp, "lan_bandwidth_bps", 0)
                                 or getattr(fp, "wan_bandwidth_bps", 0))))
         self._running = False
+        # simulated process death (tests): stop() leaves app threads able
+        # to SEND — the graceful half — but a SIGKILLed process neither
+        # receives nor transmits.  kill() sets this; start() (a zombie
+        # reviving at its old identity) clears it.
+        self.killed = False
         # byte accounting (ref: van.h:180-181); wan_* counts GLOBAL-domain only
         self.send_bytes = 0
         self.recv_bytes = 0
@@ -349,6 +354,7 @@ class Van:
     def start(self, receiver: Callable[[Message], None]):
         self._receiver = receiver
         self._running = True
+        self.killed = False
         if getattr(self.fabric, "serial", False):
             # deterministic mode: the fabric's single dispatcher calls
             # _handle_inbound in global FIFO order — no recv thread
@@ -371,6 +377,10 @@ class Van:
             self._resend_thread.start()
 
     def stop(self):
+        if not self._running:
+            return  # already stopped (kill() + po.stop() double-call);
+            #         a second self-stopper would sit in the mailbox and
+            #         instantly kill a revived zombie's receive loop
         self._running = False
         if getattr(self.fabric, "serial", False):
             # unregister so a "killed" node stops processing — without
@@ -386,8 +396,17 @@ class Van:
         if self._recv_thread:
             self._recv_thread.join(timeout=5)
 
+    def kill(self):
+        """Thread-level SIGKILL for tests: stop receiving AND silently
+        drop every later send (a dead process transmits nothing — app
+        threads that outlive the 'process' must not keep pushing)."""
+        self.killed = True
+        self.stop()
+
     # ---- send path ----------------------------------------------------------
     def send(self, msg: Message, priority: Optional[int] = None):
+        if self.killed:
+            return  # simulated dead process: the wire never sees this
         msg.sender = self.node
         msg.boot = self.boot
         if priority is not None:

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Chaos / crash-tolerance acceptance: the slow soaks that SIGKILL (or
+# thread-kill) workers, local servers, and global servers mid-training —
+# heartbeat-driven eviction, barrier release to the survivor set, zombie
+# push fencing, party fold/unfold, warm-boot recovery, and the PR 1
+# failover protocol.  Gated out of tier-1 (`-m 'not slow'`); this is the
+# entry point that runs them, mirroring the other scripts/run_*.sh.
+#
+# Env: PYTEST_ARGS (extra pytest flags, e.g. "-k eviction")
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export JAX_PLATFORM_NAME=cpu
+
+exec python -m pytest tests -q -m "chaos or failover" \
+  -p no:cacheprovider ${PYTEST_ARGS:-}

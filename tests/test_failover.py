@@ -25,6 +25,8 @@ from geomx_tpu.ps import KVPairs, KVWorker
 from geomx_tpu.ps.postoffice import split_range
 from geomx_tpu.transport.message import Domain
 
+pytestmark = pytest.mark.failover
+
 
 def _failover_config(parties=2):
     return Config(
@@ -44,6 +46,17 @@ def _wait_for(pred, timeout=15.0, every=0.02):
             return True
         time.sleep(every)
     return pred()
+
+
+def _wait_replicated(sb, expect, timeout=15.0):
+    """The post-round snapshot must be ON the standby.  Waiting on
+    ``_repl_seq >= 1`` alone was flaky: the Replicator's startup
+    BASELINE snapshot (pre-round store, default optimizer) also bumps
+    the seq, so a promotion racing ahead of the post-round ship would
+    promote stale state — check the replicated content instead."""
+    return _wait_for(
+        lambda: sb._repl_seq >= 1 and 0 in sb.store
+        and np.allclose(sb.store[0], expect), timeout)
 
 
 def test_failover_smoke_inproc():
@@ -69,7 +82,7 @@ def test_failover_smoke_inproc():
             w.wait_all()
         sb = sim.standby_globals[0]
         # the post-round snapshot must be ON the standby before the kill
-        assert _wait_for(lambda: sb._repl_seq >= 1), "replication stalled"
+        assert _wait_replicated(sb, -1.0), "replication stalled"
         assert 0 in sb.store
 
         sim.kill_global_server(0)
@@ -107,7 +120,7 @@ def test_standby_replication_carries_dedup_window():
         np.testing.assert_allclose(w.pull_sync(0), -np.ones(8, np.float32))
         w.wait_all()
         sb = sim.standby_globals[0]
-        assert _wait_for(lambda: sb._repl_seq >= 1)
+        assert _wait_replicated(sb, -1.0)
         sim.kill_global_server(0)
         assert _wait_for(lambda: not sb.is_standby), "promotion stalled"
         # the local server's round-1 WAN push was acked by the dead
@@ -134,7 +147,7 @@ def test_stale_term_replication_is_fenced():
         w.pull_sync(0)
         w.wait_all()
         sb = sim.standby_globals[0]
-        assert _wait_for(lambda: sb._repl_seq >= 1)
+        assert _wait_replicated(sb, -1.0)
         sim.kill_global_server(0)
         assert _wait_for(lambda: not sb.is_standby)
         before = np.array(sb.store[0])
@@ -177,7 +190,7 @@ def test_zombie_ex_primary_is_fenced_and_rejects_pushes():
         w.pull_sync(0)
         w.wait_all()
         sb = sim.standby_globals[0]
-        assert _wait_for(lambda: sb._repl_seq >= 1)
+        assert _wait_replicated(sb, -1.0)
         gs0 = sim.kill_global_server(0)
         w.push(0, np.ones(8, np.float32))
         np.testing.assert_allclose(w.pull_sync(0),
@@ -215,7 +228,7 @@ def test_operator_forced_promotion():
         w.pull_sync(0)
         w.wait_all()
         sb = sim.standby_globals[0]
-        assert _wait_for(lambda: sb._repl_seq >= 1)
+        assert _wait_replicated(sb, -1.0)
         assert sim.failover_monitor.promote(0, reason="maintenance")
         gs0 = sim.global_servers[0]
         assert _wait_for(lambda: gs0._fenced), "live primary not deposed"

@@ -142,13 +142,16 @@ def test_checkpoint_during_concurrent_training(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.chaos
 def test_chaos_soak_drops_joins_leaves_compression():
     """Everything at once, long horizon: 2-party BSC-compressed training
     under 15% message drop (resend recovering), with a worker JOINING
-    one party mid-run and another LEAVING — 40 steps end-to-end, every
-    worker finishes finite and the party replicas agree at the end.
-    The reference's equivalents are PS_DROP_MSG + the keepalive
-    launcher; none of its modes survive membership churn on top."""
+    one party mid-run, another LEAVING, and a third KILLED ungracefully
+    (no leave — the heartbeat eviction must fold it out and fence its
+    zombie) — 52 steps end-to-end, every surviving worker finishes
+    finite and the party replicas agree at the end.  The reference's
+    equivalents are PS_DROP_MSG + the keepalive launcher; none of its
+    modes survive membership churn on top."""
     import threading
 
     import jax
@@ -161,7 +164,8 @@ def test_chaos_soak_drops_joins_leaves_compression():
 
     sim = Simulation(
         Config(topology=Topology(num_parties=2, workers_per_party=2),
-               resend_timeout_ms=150, request_retry_s=2.0),
+               resend_timeout_ms=150, request_retry_s=2.0,
+               heartbeat_interval_s=0.1, heartbeat_timeout_s=1.0),
         fault=FaultPolicy(drop_rate=0.15, seed=11))
     try:
         x, y = synthetic_classification(n=512, shape=(8, 8, 1), seed=3)
@@ -224,5 +228,39 @@ def test_chaos_soak_drops_joins_leaves_compression():
         for k in s0:
             np.testing.assert_allclose(s0[k], s1[k], rtol=1e-4,
                                        atol=1e-5)
+
+        # phase 3: an UNGRACEFUL kill — worker:0@p1 dies without a
+        # leave message; the remaining three stall at most one heartbeat
+        # timeout before the eviction folds it out, then train 12 more
+        # steps under the same drop rate
+        sim.kill_worker(1, 0)
+        survivors3 = [ws[0], ws[3], w4]
+        hist.clear()
+        ths = [threading.Thread(target=train, args=(w, i, 3, 12))
+               for i, w in enumerate(survivors3)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=300)
+        assert not errs, errs
+        assert len(hist) == 3, "a survivor hung after the ungraceful kill"
+        for h in hist.values():
+            assert np.isfinite([loss for loss, _ in h]).all()
+        assert sim.local_servers[1].evicted_workers == 1
+        assert sim.eviction_monitors[1].evictions == 1
+
+        # the zombie resumes and pushes its stale round — fenced, told
+        # to rejoin; the survivor-set training above stays untouched
+        ws[2].po.start()
+        ws[2].push(0, np.ones(4, np.float32))
+        with pytest.raises(RuntimeError, match="evicted"):
+            ws[2].wait_all()
+        assert sim.local_servers[1].eviction_fenced_pushes >= 1
+
+        # convergence on the survivor set: the party stores still agree
+        for k in s0:
+            np.testing.assert_allclose(
+                sim.local_servers[0].store[k],
+                sim.local_servers[1].store[k], rtol=1e-4, atol=1e-5)
     finally:
         sim.shutdown()
