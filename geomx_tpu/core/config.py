@@ -380,6 +380,17 @@ class Config:
     enable_eviction: bool = True
     eviction_check_interval_s: float = 0.0  # detector sweep period;
     #                                         0 = follow heartbeat_interval_s
+    # --- distributed tracing (geomx_tpu/trace; beyond the reference —
+    # its profiler is per-process only).  trace_sample_every = N traces
+    # every N-th synchronization round end-to-end: causal spans ride the
+    # messages, a collector on the global scheduler merges all nodes'
+    # spans into one clock-corrected timeline plus a per-round
+    # critical-path report.  0 (default) = off; the disabled hot path is
+    # a single flag check per message, no allocation.
+    trace_sample_every: int = 0
+    trace_dir: str = ""          # launch.py dumps the merged trace +
+    #                              critical-path report here at shutdown
+    trace_batch_events: int = 256  # spans per TRACE_REPORT batch
     verbose: int = 0
 
     def __post_init__(self):
@@ -418,6 +429,10 @@ class Config:
                 "a shared relay payload); use fp16 or none")
         if self.replicate_every < 1:
             raise ValueError("replicate_every must be >= 1")
+        if self.trace_sample_every < 0:
+            raise ValueError("trace_sample_every must be >= 0 (0 = off)")
+        if self.trace_batch_events < 1:
+            raise ValueError("trace_batch_events must be >= 1")
         if self.topology.num_standby_globals and self.request_retry_s <= 0:
             # failover's client-side replay rides the request-retry
             # inflight table; a standby without it would promote cleanly
@@ -504,5 +519,8 @@ class Config:
             eviction_check_interval_s=_env_float(
                 "GEOMX_EVICTION_CHECK_INTERVAL", 0.0
             ),
+            trace_sample_every=_env_int("GEOMX_TRACE_SAMPLE_EVERY", 0),
+            trace_dir=os.environ.get("GEOMX_TRACE_DIR", ""),
+            trace_batch_events=_env_int("GEOMX_TRACE_BATCH_EVENTS", 256),
             verbose=_env_int("GEOMX_VERBOSE", _env_int("PS_VERBOSE", 0)),
         )

@@ -80,6 +80,11 @@ class WorkerKVStore:
         self._pending: List[int] = []
         self._last_push_ts: Dict[int, int] = {}
         self._mu = threading.Lock()
+        # distributed tracing: the worker is where a sampled round's root
+        # span opens (trace_round); push/pull issue spans hang under it
+        from geomx_tpu.trace.recorder import get_tracer
+
+        self._tracer = get_tracer(str(postoffice.node))
         # dynamic membership: track the server's join/leave broadcasts
         postoffice.add_control_hook(self._membership_hook)
         # global-tier failover: workers never talk to the global tier
@@ -162,6 +167,19 @@ class WorkerKVStore:
     def _track(self, ts: int):
         with self._mu:
             self._pending.append(ts)
+
+    def trace_round(self, round_idx: int):
+        """Root span of one synchronization round (no-op unless
+        ``Config.trace_sample_every`` hits this round).  Wrap the whole
+        step — grad compute, pushes, pulls, wait — so every message the
+        step sends joins the round's trace:
+
+            with kv.trace_round(step):
+                ... push/pull ...
+                kv.wait_all()
+        """
+        return self._tracer.round(round_idx,
+                                  self.config.trace_sample_every)
 
     # ---- public API ---------------------------------------------------------
     def init(self, tid: int, value: np.ndarray, barrier: bool = False,
@@ -424,8 +442,10 @@ class WorkerKVStore:
         if num_merge > 1:
             body_out["num_merge"] = int(num_merge)
         fields = {"body": body_out} if body_out else {}
-        ts = self.worker.zpush(self._encode(tid, flat, priority),
-                               cmd=Cmd.DEFAULT, priority=priority, **fields)
+        with self._tracer.span("worker.push"):
+            ts = self.worker.zpush(self._encode(tid, flat, priority),
+                                   cmd=Cmd.DEFAULT, priority=priority,
+                                   **fields)
         with self._mu:
             self._last_push_ts[tid] = ts
             if self.ts_client is not None and _count_round:
@@ -482,10 +502,19 @@ class WorkerKVStore:
         keys = [p.ps_key for p in self.plan.parts(tid, size)]
         with self._mu:
             after = self._last_push_ts.get(tid)
-        ts = self.worker.zpull(
-            keys, cb=lambda kvs: cb(tid, self._decode(tid, kvs)),
-            cmd=Cmd.DEFAULT, priority=priority, after_ts=after,
-        )
+
+        def decode(kvs):
+            # runs on the response-delivery thread under the response's
+            # trace context — the decode span closes the round's chain
+            with self._tracer.span("worker.pull_decode"):
+                out = self._decode(tid, kvs)
+            cb(tid, out)
+
+        with self._tracer.span("worker.pull"):
+            ts = self.worker.zpull(
+                keys, cb=decode,
+                cmd=Cmd.DEFAULT, priority=priority, after_ts=after,
+            )
         self._track(ts)
         return ts
 

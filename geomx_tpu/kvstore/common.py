@@ -139,3 +139,7 @@ class Ctrl(enum.IntEnum):
     #                            replacement local server's warm boot asks
     #                            each global shard for its hosted key set
     #                            before pulling the model state
+    TRACE_REPORT = 22          # node -> global scheduler (fire-and-forget,
+    #                            no response slot): one batch of completed
+    #                            trace spans + the sender's heartbeat-RTT
+    #                            clock offsets (geomx_tpu/trace/collector)

@@ -53,6 +53,7 @@ from typing import Dict, Optional
 
 from geomx_tpu.core.config import NodeId, Role
 from geomx_tpu.ps import Postoffice
+from geomx_tpu.trace.recorder import get_tracer
 from geomx_tpu.transport.message import Control, Domain, Message
 from geomx_tpu.utils.metrics import system_counter
 
@@ -227,6 +228,11 @@ class WorkerEvictionMonitor(_HeartbeatActuator):
                 self._evicted[node_s] = boot
                 self.evictions += 1
             self._counter.inc()
+            # control events land on the shared trace timeline (traceless
+            # instants) so a flaky soak's dump shows WHEN the actuation
+            # fired relative to the stalled round
+            get_tracer(str(self.po.node)).instant(
+                "evict.worker", node=node_s, boot=boot)
             print(f"{self.po.node}: evicted {node_s} (heartbeat expired, "
                   f"boot={boot}) — rounds and barriers fold to the "
                   "survivor set", flush=True)
@@ -307,6 +313,8 @@ class LocalServerRecoveryMonitor(_HeartbeatActuator):
             self._folded[party] = boot
         self.party_folds += 1
         self._fold_counter.inc()
+        get_tracer(str(self.po.node)).instant(
+            "evict.party_fold", party=party, node=node_s)
         print(f"{self.po.node}: folded party {party} out of global "
               f"rounds ({node_s} heartbeat expired) — the WAN root "
               "continues on the survivor parties", flush=True)
@@ -339,6 +347,9 @@ class LocalServerRecoveryMonitor(_HeartbeatActuator):
             self._folded.pop(party, None)
         self.party_unfolds += 1
         self._unfold_counter.inc()
+        get_tracer(str(self.po.node)).instant(
+            "recover.party_unfold", party=party,
+            warm_booted_keys=int(reply.get("keys", 0)))
         print(f"{self.po.node}: party {party} recovered — {node} "
               f"warm-booted {reply.get('keys', 0)} keys and folded back "
               "into global rounds", flush=True)

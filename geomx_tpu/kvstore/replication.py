@@ -250,6 +250,11 @@ class GlobalFailoverMonitor:
         self._promoted.add(rank)
         self.failover_events += 1
         self._counter.inc()
+        from geomx_tpu.trace.recorder import get_tracer
+
+        # failover lands on the merged trace timeline as a control event
+        get_tracer(str(self.po.node)).instant(
+            "failover.promoted", rank=rank, term=term, reason=reason)
         print(f"{self.po.node}: promoted {standby} to primary of shard "
               f"{rank} (term={term}, {reason})", flush=True)
         self._broadcast_new_primary(rank, repeats=3)

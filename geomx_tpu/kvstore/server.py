@@ -249,9 +249,11 @@ class LocalServer:
         self.store: Dict[int, np.ndarray] = {}
         self._keys: Dict[int, _KeyState] = {}
         self._mu = threading.RLock()
+        from geomx_tpu.trace.recorder import get_tracer
         from geomx_tpu.utils import get_profiler
 
         self._prof = get_profiler(str(postoffice.node))
+        self._tr = get_tracer(str(postoffice.node))
         self._recent = RecentRequests()  # replayed-push dedup
         self.server = KVServer(APP_PS, 0, postoffice, self._handle)
         self.server.cmd_handler = self._on_cmd
@@ -357,12 +359,15 @@ class LocalServer:
             # routed here because the KVServer owns the PS app id
             self.ts_push_inter._on_merge_msg(msg)
         elif msg.push:
-            with prof.span("local.push"):
+            # the tracer span nests inside the profiler span: same
+            # buffer, but the tracer one carries the causal ids and is
+            # gated on the round's sampling, not on profiler.running
+            with prof.span("local.push"), self._tr.span("local.push"):
                 self._handle_push(msg, kvs)
             if prof.running:
                 prof.count("push_bytes", float(msg.nbytes))
         elif msg.pull:
-            with prof.span("local.pull"):
+            with prof.span("local.pull"), self._tr.span("local.pull"):
                 self._handle_pull(msg, kvs)
 
     def _handle_init(self, msg: Message, kvs: KVPairs):
@@ -1107,12 +1112,13 @@ class LocalServer:
         else:
             from geomx_tpu.compression import MpqSelector
 
-            for k, v in kvs.slices():
-                codec = (self.push_codec.select(len(v))
-                         if isinstance(self.push_codec, MpqSelector)
-                         else self.push_codec)
-                groups.setdefault(codec.name, []).append(
-                    (k, codec.compress(k, v)))
+            with self._tr.span("codec.encode"):
+                for k, v in kvs.slices():
+                    codec = (self.push_codec.select(len(v))
+                             if isinstance(self.push_codec, MpqSelector)
+                             else self.push_codec)
+                    groups.setdefault(codec.name, []).append(
+                        (k, codec.compress(k, v)))
         # P3 piggyback on the WAN tier: combined push_pull saves the
         # per-round ack -> pull-request chain (2 messages + 2 latencies
         # per key per round); the global server replies with the updated
@@ -1246,7 +1252,7 @@ class LocalServer:
         pulls already drained); the rest finish normally."""
         tags = kvs.tags or {}
         pv = kvs.pv or {}
-        with self._mu:
+        with self._tr.span("local.pull_down"), self._mu:
             live = []
             for k, v in kvs.slices():
                 if (epochs is not None
@@ -1579,9 +1585,11 @@ class GlobalServer:
         self._since_ckpt = 0
         self._ckpt_busy = False
         self._ckpt_pending = False
+        from geomx_tpu.trace.recorder import get_tracer
         from geomx_tpu.utils import get_profiler
 
         self._prof = get_profiler(str(postoffice.node))
+        self._tr = get_tracer(str(postoffice.node))
         # inter-party TSEngine: after a sync round updates, disseminate
         # the fresh weights to the local servers via the WAN overlay
         # instead of serving N pulls (sync tier only)
@@ -1731,7 +1739,7 @@ class GlobalServer:
             prof.count("push_bytes", float(msg.nbytes))
         span_name = ("global.init" if msg.cmd == Cmd.INIT
                      else "global.push" if msg.push else "global.pull")
-        with prof.span(span_name):
+        with prof.span(span_name), self._tr.span(span_name):
             self._handle_inner(msg, kvs, server)
 
     def _handle_inner(self, msg: Message, kvs: Optional[KVPairs],
@@ -1831,7 +1839,7 @@ class GlobalServer:
 
         thr = float(self.compression.get("threshold", 0.5))
         ks, vs, ls = [], [], []
-        with self._mu:
+        with self._tr.span("codec.decode"), self._mu:
             for k, payload in kvs.slices():
                 orig = len(self.store[k])
                 dense = decompress_payload(msg.compr, k, payload, orig, thr)
@@ -1904,6 +1912,11 @@ class GlobalServer:
         :meth:`_flush_completions` outside the lock.  Shared by the push
         handler and the party-leave fold (both decide completion)."""
         to_ack: List[tuple] = []
+        # one optimizer span per completion batch (the per-key update
+        # loop IS the global tier's compute stage on the critical path)
+        opt_span = self._tr.span("global.opt") if completed else None
+        if opt_span is not None:
+            opt_span.__enter__()
         for k in completed:
             st = self._keys[k]
             if k not in self.store:
@@ -1938,6 +1951,8 @@ class GlobalServer:
                     to_ack.append((ent[0], None))
             st.parked_pushes.clear()
             self._serve_parked_pulls_locked(k)
+        if opt_span is not None:
+            opt_span.__exit__(None, None, None)
         if completed:
             self._auto_ckpt_locked(len(completed))
             if self._repl is not None:
@@ -2097,6 +2112,11 @@ class GlobalServer:
         typ = self.compression.get("type")
         size_bound = (int(self.compression.get("size_bound", 200_000))
                       if typ == "mpq" else 0)
+        with self._tr.span("codec.encode"):
+            self._respond_pull_compressed_inner(req, typ, size_bound)
+
+    def _respond_pull_compressed_inner(self, req: Message, typ,
+                                       size_bound: int):
         sender = str(req.sender)
         echo = {}
         if isinstance(req.body, dict):
@@ -2275,6 +2295,7 @@ class GlobalServer:
             return False
         body = msg.body if isinstance(msg.body, dict) else {}
         term = int(body.get("term", 0))
+        self._tr.instant("failover.promote", term=term)
         parked: List[tuple] = []
         with self._mu:
             if term > self.term:
@@ -2328,6 +2349,7 @@ class GlobalServer:
             self._fence_reason = reason
             if self._repl is not None:
                 self._repl.stopped = True
+        self._tr.instant("failover.fenced", term=self.term, reason=reason)
         from geomx_tpu.utils.metrics import system_counter
 
         system_counter(f"{self.po.node}.fenced").inc()

@@ -28,10 +28,21 @@ class Simulation:
         self.topology = config.topology
         self.fabric = InProcFabric(fault=fault, config=config)
         self.offices: Dict[str, Postoffice] = {}
+        # distributed tracing (geomx_tpu/trace): collector on the global
+        # scheduler, a reporter per node.  Constructed BEFORE the other
+        # postoffices start so no TRACE_REPORT can beat the collector's
+        # customer registration.
+        self.trace_collector = None
+        gsched = str(self.topology.global_scheduler())
         for n in self.topology.all_nodes():
             po = Postoffice(n, self.topology, self.fabric, config)
+            if config.trace_sample_every > 0 and str(n) == gsched:
+                from geomx_tpu.trace import get_collector
+
+                self.trace_collector = get_collector(po)
             po.start()
             self.offices[str(n)] = po
+            self._attach_tracer(po, fresh=True)
         self.ts_schedulers = []
         if config.enable_intra_ts:
             from geomx_tpu.sched.ts_push import TsPushScheduler
@@ -108,6 +119,57 @@ class Simulation:
             self.recovery_monitor = LocalServerRecoveryMonitor(
                 self.offices[str(self.topology.global_scheduler())])
 
+    def _attach_tracer(self, po: Postoffice, fresh: bool = False) -> None:
+        """Bind the node's tracer to its (possibly replacement)
+        postoffice so completed spans batch-ship to the collector.
+        ``fresh`` (deployment construction) drops spans left over from a
+        previous Simulation reusing the same node names — their
+        round-derived trace ids would collide with this run's."""
+        if self.config.trace_sample_every <= 0:
+            return
+        from geomx_tpu.trace import get_tracer
+
+        tr = get_tracer(str(po.node))
+        if fresh:
+            tr.reset()
+        tr.batch_events = self.config.trace_batch_events
+        tr.attach(po)
+
+    def flush_traces(self, timeout: float = 5.0) -> int:
+        """Ship every node's pending spans and wait for the collector's
+        event count to settle; returns the number of collected events."""
+        if self.trace_collector is None:
+            return 0
+        from geomx_tpu.trace import get_tracer
+
+        import time as _time
+
+        for s in self.offices:
+            get_tracer(s).flush()
+        deadline = _time.monotonic() + timeout
+        last = -1
+        while _time.monotonic() < deadline:
+            cur = len(self.trace_collector.merged_events())
+            if cur == last:
+                break
+            last = cur
+            _time.sleep(0.05)
+        return last
+
+    def dump_trace(self, path: str) -> dict:
+        """Merged cross-node Chrome-trace JSON (see docs/tracing.md)."""
+        assert self.trace_collector is not None, \
+            "tracing off: set Config.trace_sample_every"
+        self.flush_traces()
+        return self.trace_collector.dump(path)
+
+    def trace_report(self) -> dict:
+        """Per-round critical-path report from the collector."""
+        assert self.trace_collector is not None, \
+            "tracing off: set Config.trace_sample_every"
+        self.flush_traces()
+        return self.trace_collector.critical_path()
+
     def worker(self, party: int, rank: int) -> WorkerKVStore:
         return self.workers[str(NodeId.parse(f"worker:{rank}@p{party}"))]
 
@@ -133,6 +195,7 @@ class Simulation:
             self.offices[str(n)] = po
             kv = WorkerKVStore(po, self.config)
             self.workers[str(n)] = kv
+            self._attach_tracer(po)
         kv.join_party()
         return kv
 
@@ -186,6 +249,7 @@ class Simulation:
         po.start()
         self.offices[str(n)] = po
         self.local_servers[party] = ls
+        self._attach_tracer(po)
         return ls
 
     def wan_bytes(self) -> dict:
@@ -197,6 +261,8 @@ class Simulation:
         return {"wan_send_bytes": send, "wan_recv_bytes": recv}
 
     def shutdown(self):
+        if self.trace_collector is not None:
+            self.trace_collector.stop()
         if self.failover_monitor is not None:
             self.failover_monitor.stop()
         for m in self.eviction_monitors:

@@ -52,6 +52,18 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
     fabric = TcpFabric(plan, config=config)
     po = Postoffice(node, config.topology, fabric, config)
     stop_ev = threading.Event()
+    # distributed tracing: the global scheduler hosts the collector
+    # (registered BEFORE po.start so no TRACE_REPORT beats it); every
+    # node gets a reporter bound to its postoffice
+    po.trace_collector = None
+    if config.trace_sample_every > 0:
+        from geomx_tpu.trace import get_collector, get_tracer
+
+        if node.role is Role.GLOBAL_SCHEDULER:
+            po.trace_collector = get_collector(po)
+        tracer = get_tracer(str(node))
+        tracer.batch_events = config.trace_batch_events
+        tracer.attach(po)
 
     def on_control(msg: Message) -> bool:
         if msg.control is Control.TERMINATE:
@@ -525,6 +537,15 @@ def main(argv=None):
     ap.add_argument("--central-worker", action="store_true",
                     help="topology includes a dedicated master worker in "
                          "the central party (ref: DMLC_ENABLE_CENTRAL_WORKER)")
+    ap.add_argument("--trace-sample-every", type=int,
+                    default=int(os.environ.get("GEOMX_TRACE_SAMPLE_EVERY",
+                                               "0")),
+                    help="distributed tracing: trace every N-th round "
+                         "end-to-end (0 = off); the global scheduler "
+                         "merges all nodes' spans and writes the timeline "
+                         "+ critical-path report to --trace-dir")
+    ap.add_argument("--trace-dir",
+                    default=os.environ.get("GEOMX_TRACE_DIR", ""))
     ap.add_argument("--optimizer", default="adam",
                     choices=["sgd", "adam", "dcasgd"])
     args = ap.parse_args(argv)
@@ -577,6 +598,9 @@ def main(argv=None):
                                 or cfg.enable_inter_ts_push)
     cfg.sync_global_mode = (args.sync == "fsa") and cfg.sync_global_mode
     cfg.enable_dgt = args.dgt or cfg.enable_dgt
+    cfg.trace_sample_every = (args.trace_sample_every
+                              or cfg.trace_sample_every)
+    cfg.trace_dir = args.trace_dir or cfg.trace_dir
     # CLI overrides bypass dataclass construction — re-run the invariant
     # checks so invalid combinations fail here, not as a runtime hang
     cfg.__post_init__()
@@ -693,6 +717,29 @@ def main(argv=None):
         feats.append(f"term={role_obj.term}")
     if feats:
         print(f"{node}: " + " ".join(feats), flush=True)
+    if cfg.trace_sample_every > 0:
+        from geomx_tpu.trace import get_tracer
+
+        get_tracer(str(node)).flush()
+        coll = getattr(po, "trace_collector", None)
+        if coll is not None:
+            # grace for the last TRACE_REPORT batches to land, then dump
+            # the merged timeline + critical-path report
+            time.sleep(1.0)
+            out_dir = cfg.trace_dir or "."
+            os.makedirs(out_dir, exist_ok=True)
+            trace_path = os.path.join(out_dir, "geomx_trace.json")
+            coll.dump(trace_path)
+            report_path = os.path.join(out_dir, "geomx_trace_report.json")
+            import json as _json
+
+            with open(report_path, "w") as f:
+                _json.dump(coll.critical_path(), f, indent=1)
+            print(f"{node}: merged trace -> {trace_path}; critical-path "
+                  f"report -> {report_path}", flush=True)
+            txt = coll.report_text()
+            if txt:
+                print(txt, flush=True)
     po.stop()
     return 0
 

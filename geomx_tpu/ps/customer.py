@@ -16,6 +16,7 @@ import threading
 from typing import Callable, Dict, Optional
 
 from geomx_tpu.ps.postoffice import Postoffice
+from geomx_tpu.trace import context as _tctx
 from geomx_tpu.transport.message import Message
 
 
@@ -150,10 +151,26 @@ class Customer:
             )
 
     # ---- inbound ------------------------------------------------------------
+    def _invoke_traced(self, msg: Message):
+        """Run the handler with the message's trace context installed:
+        handler-side spans (and any messages the handler sends — the
+        merge→push-up→pull-down chain) become children of the inbound
+        message, which is what connects one round's spans across nodes.
+        Callers gate on ``ACTIVE and msg.trace_id`` FIRST so untraced
+        messages pay one attribute read, not an extra frame."""
+        prev = _tctx.swap(_tctx.TraceContext(msg.trace_id, msg.span_id))
+        try:
+            self._handler(msg)
+        finally:
+            _tctx.restore(prev)
+
     def accept(self, msg: Message):
         if self._inline:
             try:
-                self._handler(msg)
+                if _tctx.ACTIVE and msg.trace_id > 0:
+                    self._invoke_traced(msg)
+                else:
+                    self._handler(msg)
             except Exception:  # pragma: no cover
                 import traceback
 
@@ -170,7 +187,10 @@ class Customer:
             if msg is None:
                 return
             try:
-                self._handler(msg)
+                if _tctx.ACTIVE and msg.trace_id > 0:
+                    self._invoke_traced(msg)
+                else:
+                    self._handler(msg)
             except Exception:  # pragma: no cover
                 import traceback
 

@@ -20,6 +20,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from geomx_tpu.core.config import Config, Group, NodeId, Role, Topology
+from geomx_tpu.trace import context as _tctx
 from geomx_tpu.transport.message import Control, Domain, Message
 from geomx_tpu.transport.van import InProcFabric, Van
 
@@ -66,6 +67,10 @@ class Postoffice:
         self.node = node
         self.topology = topology
         self.config = config or Config()
+        if self.config.trace_sample_every > 0:
+            # flip the process-wide tracing gate once; everything else
+            # (sampling, span recording) keys off per-round contexts
+            _tctx.activate()
         self.van = Van(
             node,
             fabric,
@@ -93,6 +98,16 @@ class Postoffice:
         self._hb_stop = threading.Event()
         self._hb_epoch = 0.0
         self._dead_replies: Dict[int, dict] = {}
+        # clock-offset estimation (non-scheduler side): heartbeats carry
+        # a send stamp, the scheduler echoes it with its own receive
+        # stamp, and the classic RTT/2 estimate gives "scheduler clock
+        # minus mine" per scheduler target — what the trace collector
+        # uses to merge per-node span timestamps onto one timeline
+        self._clock_offsets: Dict[str, float] = {}
+        self._hb_rtts: Dict[str, float] = {}
+        self._rtt_gauge = None
+        self._offset_gauge = None
+        self._tracer = None
         # scheduler-side barrier exclusion: members declared dead by the
         # eviction monitor stop counting toward barrier quorums, so FSA
         # degrades to the survivor set instead of timing out
@@ -176,12 +191,17 @@ class Postoffice:
             if self.node.role is Role.SERVER:
                 targets.append(
                     (self.topology.global_scheduler(), Domain.GLOBAL))
+        import time as _time
+
         while not stop_ev.is_set():
             for sched, domain in targets:
                 try:
+                    # the send stamp makes the ping echo-able: the
+                    # scheduler replies with (echo_t, sched_t) and this
+                    # node derives RTT + clock offset from the pair
                     self.van.send(Message(
                         recipient=sched, control=Control.HEARTBEAT,
-                        domain=domain))
+                        domain=domain, body={"t": _time.monotonic()}))
                 except (KeyError, OSError):
                     # scheduler not up yet (startup race on TCP) — a
                     # transient failure must not kill the heartbeat thread
@@ -223,6 +243,18 @@ class Postoffice:
             return ({n: (t, self._hb_boots.get(n, 0))
                      for n, t in self._heartbeats.items()},
                     self._hb_epoch)
+
+    def clock_offsets(self) -> Dict[str, float]:
+        """Estimated scheduler-clock-minus-mine per scheduler target
+        (from heartbeat echoes); {} until a first echo lands — and
+        always {} on schedulers, whose clock others measure against."""
+        with self._lock:
+            return dict(self._clock_offsets)
+
+    def heartbeat_rtts(self) -> Dict[str, float]:
+        """Last measured heartbeat RTT per scheduler target."""
+        with self._lock:
+            return dict(self._hb_rtts)
 
     def query_dead_nodes(self, timeout: float = 10.0) -> List[str]:
         """Ask my scheduler for its dead-node list
@@ -284,9 +316,36 @@ class Postoffice:
         if msg.control is Control.HEARTBEAT:
             import time as _time
 
+            b = msg.body if isinstance(msg.body, dict) else {}
+            if "sched_t" in b:
+                # echo reply from my scheduler: RTT/2 clock estimate
+                now = _time.monotonic()
+                rtt = max(0.0, now - float(b["echo_t"]))
+                offset = float(b["sched_t"]) - (float(b["echo_t"]) + rtt / 2)
+                with self._lock:
+                    self._hb_rtts[str(msg.sender)] = rtt
+                    self._clock_offsets[str(msg.sender)] = offset
+                    if self._rtt_gauge is None:
+                        from geomx_tpu.utils.metrics import system_gauge
+
+                        self._rtt_gauge = system_gauge(
+                            f"{self.node}.heartbeat_rtt_s")
+                        self._offset_gauge = system_gauge(
+                            f"{self.node}.clock_offset_s")
+                self._rtt_gauge.set(rtt)
+                self._offset_gauge.set(offset)
+                return
             with self._lock:
                 self._heartbeats[str(msg.sender)] = _time.monotonic()
                 self._hb_boots[str(msg.sender)] = msg.boot
+            if "t" in b:
+                try:
+                    self.van.send(msg.reply_to(
+                        control=Control.HEARTBEAT,
+                        body={"echo_t": b["t"],
+                              "sched_t": _time.monotonic()}))
+                except (KeyError, OSError):
+                    pass  # sender vanished between ping and echo
             return
         if msg.control is Control.BARRIER:
             self._handle_barrier(msg)
@@ -349,11 +408,25 @@ class Postoffice:
             recipient=sched, control=Control.BARRIER, domain=domain, request=True,
             body={"group": group.value, "party": party, "seq": seq},
         )
-        self.van.send(req)
-        with self._barrier_cv:
-            ok = self._barrier_cv.wait_for(
-                lambda: self._barrier_done.pop(seq, False), timeout=timeout
-            )
+        if _tctx.ACTIVE and _tctx.current() is not None:
+            # barrier waits inside a sampled round are a first-class
+            # critical-path stage (FSA stalls ARE barrier time)
+            if self._tracer is None:
+                from geomx_tpu.trace.recorder import get_tracer
+
+                self._tracer = get_tracer(str(self.node))
+            with self._tracer.span("barrier.wait"):
+                self.van.send(req)
+                with self._barrier_cv:
+                    ok = self._barrier_cv.wait_for(
+                        lambda: self._barrier_done.pop(seq, False),
+                        timeout=timeout)
+        else:
+            self.van.send(req)
+            with self._barrier_cv:
+                ok = self._barrier_cv.wait_for(
+                    lambda: self._barrier_done.pop(seq, False),
+                    timeout=timeout)
         if not ok:
             # diagnosable stall: ask the scheduler who is dead and who
             # never entered this token, so the exception alone names the

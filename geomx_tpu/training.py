@@ -425,39 +425,46 @@ def run_worker(
         # change
         scale = 1.0 / kv.num_workers if normalize else 1.0
         m.step_start()
-        with m.phase("grad"):
-            loss, acc, grads = grad_fn(params, x, y)
-            g_leaves, _ = jax.tree_util.tree_flatten(grads)
-            # block HERE so the phase split is honest: jax dispatch is
-            # async, and without this the whole backward pass would be
-            # billed to the push phase's first np.asarray (the plain
-            # loop converts leaf-by-leaf right below anyway, so this
-            # does not change the schedule; the staged OVERLAP loop —
-            # overlap.py — is the path that interleaves, not this one)
-            jax.block_until_ready(g_leaves)
-        with m.phase("push"):
-            if kv.ts_push is not None:
-                # TS push direction: worker-to-worker merge tree; the
-                # elected holder pushes the merged set once for the party
-                kv.ts_merge_push({tid: np.asarray(g) * scale
-                                  for tid, g in enumerate(g_leaves)})
-                for tid in range(len(leaves)):
-                    kv.pull(tid, lambda t, arr: buf.__setitem__(t, arr),
-                            priority=-tid)
-            elif kv.config.enable_p3:
-                # P3: sliced combined push+pull, values ride the response
-                for tid, g in enumerate(g_leaves):
-                    kv.push_pull(tid, np.asarray(g) * scale,
-                                 lambda t, arr: buf.__setitem__(t, arr),
-                                 priority=-tid)
-            else:
-                for tid, g in enumerate(g_leaves):
-                    kv.push(tid, np.asarray(g) * scale, priority=-tid)
-                for tid in range(len(leaves)):
-                    kv.pull(tid, lambda t, arr: buf.__setitem__(t, arr),
-                            priority=-tid)
-        with m.phase("pull_wait"):
-            kv.wait_all()
+        # the whole step under one sampled root span (no-op unless
+        # Config.trace_sample_every hits this round): every push/pull the
+        # step issues joins the round's cross-node trace
+        with kv.trace_round(step):
+            with m.phase("grad"):
+                loss, acc, grads = grad_fn(params, x, y)
+                g_leaves, _ = jax.tree_util.tree_flatten(grads)
+                # block HERE so the phase split is honest: jax dispatch
+                # is async, and without this the whole backward pass
+                # would be billed to the push phase's first np.asarray
+                # (the plain loop converts leaf-by-leaf right below
+                # anyway, so this does not change the schedule; the
+                # staged OVERLAP loop — overlap.py — is the path that
+                # interleaves, not this one)
+                jax.block_until_ready(g_leaves)
+            with m.phase("push"):
+                if kv.ts_push is not None:
+                    # TS push direction: worker-to-worker merge tree; the
+                    # elected holder pushes the merged set for the party
+                    kv.ts_merge_push({tid: np.asarray(g) * scale
+                                      for tid, g in enumerate(g_leaves)})
+                    for tid in range(len(leaves)):
+                        kv.pull(tid,
+                                lambda t, arr: buf.__setitem__(t, arr),
+                                priority=-tid)
+                elif kv.config.enable_p3:
+                    # P3: sliced push+pull, values ride the response
+                    for tid, g in enumerate(g_leaves):
+                        kv.push_pull(tid, np.asarray(g) * scale,
+                                     lambda t, arr: buf.__setitem__(t, arr),
+                                     priority=-tid)
+                else:
+                    for tid, g in enumerate(g_leaves):
+                        kv.push(tid, np.asarray(g) * scale, priority=-tid)
+                    for tid in range(len(leaves)):
+                        kv.pull(tid,
+                                lambda t, arr: buf.__setitem__(t, arr),
+                                priority=-tid)
+            with m.phase("pull_wait"):
+                kv.wait_all()
         params = unflatten_params(treedef, buf)  # type: ignore[arg-type]
         m.step_end()
         history.append((float(loss), float(acc)))

@@ -140,6 +140,12 @@ class DgtSender:
                 channel=channel_of[c],
                 total_bytes=n,            # total elements of the payload
                 val_bytes=c * bs,         # element offset of this chunk
+                # every chunk carries the logical message's trace context
+                # — reassembly must restore it whichever chunks survive
+                # the lossy channels, and a lost lossy chunk must not
+                # orphan the round's causal chain
+                trace_id=msg.trace_id, span_id=msg.span_id,
+                parent_span_id=msg.parent_span_id, sampled=msg.sampled,
             )
             if chunk_body is not None:
                 chunk.body = chunk_body
@@ -232,6 +238,11 @@ class DgtReassembler:
             cmd=final.cmd, priority=final.priority, compr=final.compr,
             keys=final.keys, vals=vals, lens=final.lens,
             body=(final.body or {}).get("orig"),
+            # the reassembled logical message IS the original on the
+            # timeline: same trace/span ids (any surviving chunk carries
+            # them; the completion chunk always does)
+            trace_id=final.trace_id, span_id=final.span_id,
+            parent_span_id=final.parent_span_id, sampled=final.sampled,
             # the reassembly buffer is freshly allocated and exclusively
             # ours — the receiving server may adopt it as its accumulator
             donated=True,

@@ -128,6 +128,19 @@ class Message:
     # misclassified as replays of its predecessor's (advisor r1)
     boot: int = 0
 
+    # distributed-tracing context (geomx_tpu/trace): 0/False = untraced.
+    # ``span_id`` identifies THIS message on the timeline; receivers use
+    # it as the parent of their handler spans, so the cross-node chain
+    # stays connected.  Stamped by Van.send from the sender thread's
+    # context; responses inherit the request's trace via reply_to (the
+    # same timestamp/Customer correlation that pairs them).  A replayed
+    # or retransmitted request keeps its original ids — the replay shows
+    # up as extra children of the original round, not a new trace.
+    trace_id: int = 0
+    span_id: int = 0
+    parent_span_id: int = 0
+    sampled: bool = False
+
     _nbytes_cache: Optional[int] = dataclasses.field(
         default=None, repr=False, compare=False
     )
@@ -167,12 +180,18 @@ class Message:
             # holds on the return path (pull-downs / piggybacked values
             # contend on the server's uplink too)
             priority=self.priority,
+            # request→response trace correlation: the response joins the
+            # request's trace as a child of the request MESSAGE (span_id
+            # itself is assigned fresh at send time)
+            trace_id=self.trace_id,
+            parent_span_id=self.span_id,
+            sampled=self.sampled,
         )
         kw.update(overrides)
         return Message(**kw)
 
     # ---- binary serialization (for the TCP van) -----------------------------
-    _HDR = struct.Struct("<B B i i q B B B i i q q q q q B q q q")
+    _HDR = struct.Struct("<B B i i q B B B i i q q q q q B q q q q q q")
 
     def to_bytes(self) -> bytes:
         buf = io.BytesIO()
@@ -183,7 +202,8 @@ class Message:
             "compr": self.compr,
         }
         meta_b = pickle.dumps(meta, protocol=4)
-        flags = (self.request << 0) | (self.push << 1) | (self.pull << 2)
+        flags = ((self.request << 0) | (self.push << 1) | (self.pull << 2)
+                 | (self.sampled << 3))
         arrs = []
         for a in (self.keys, self.vals, self.lens):
             if a is None:
@@ -197,7 +217,7 @@ class Message:
             self.timestamp, flags, 0, 0, self.cmd, self.priority,
             self.first_key, self.seq, self.seq_begin, self.seq_end,
             self.total_bytes, self.channel, self.val_bytes, self.msg_sig,
-            self.boot,
+            self.boot, self.trace_id, self.span_id, self.parent_span_id,
         )
         buf.write(struct.pack("<i", len(hdr)))
         buf.write(hdr)
@@ -213,7 +233,7 @@ class Message:
         fields = cls._HDR.unpack_from(data, off); off += hlen
         (control, domain, app_id, customer_id, timestamp, flags, _, _, cmd,
          priority, first_key, seq, seq_begin, seq_end, total_bytes, channel,
-         val_bytes, msg_sig, boot) = fields
+         val_bytes, msg_sig, boot, trace_id, span_id, parent_span_id) = fields
         blobs = []
         for _ in range(4):
             (blen,) = struct.unpack_from("<q", data, off); off += 8
@@ -236,5 +256,7 @@ class Message:
             first_key=first_key, seq=seq, seq_begin=seq_begin, seq_end=seq_end,
             channel=channel, total_bytes=total_bytes, val_bytes=val_bytes,
             compr=meta["compr"], msg_sig=msg_sig, boot=boot,
+            trace_id=trace_id, span_id=span_id,
+            parent_span_id=parent_span_id, sampled=bool(flags & 8),
             donated=True,  # deserialized buffers are exclusively ours
         )

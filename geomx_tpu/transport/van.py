@@ -31,6 +31,7 @@ from typing import Callable, Dict, Optional
 import logging
 
 from geomx_tpu.core.config import Config, NodeId
+from geomx_tpu.trace import context as _tctx
 from geomx_tpu.transport.message import Control, Domain, Message
 
 _WIRE_LOG = logging.getLogger("geomx.wire")
@@ -330,6 +331,12 @@ class Van:
         self.recv_bytes = 0
         self.wan_send_bytes = 0
         self.wan_recv_bytes = 0
+        # distributed tracing (geomx_tpu/trace): recorder fetched lazily
+        # (tracing may activate after this van is built), plus per-codec
+        # WAN byte counters mirrored into the system-metrics registry so
+        # the tracer's reports and bench.py read the same ledger
+        self._tracer = None
+        self._wan_codec_counters: Dict[str, object] = {}
         # P3 observability: count priority-queue overtakes (a message
         # dequeued before an earlier-enqueued one — i.e. the queue
         # actually reordered under contention)
@@ -411,6 +418,20 @@ class Van:
         msg.boot = self.boot
         if priority is not None:
             msg.priority = priority
+        if _tctx.ACTIVE:
+            # automatic context propagation: a message sent from inside a
+            # sampled span joins its trace.  A message that already
+            # carries a trace (a response, a retransmit, a retarget
+            # replay) keeps its ORIGINAL ids — replays show up as extra
+            # children of the original round, never as a new trace.
+            if msg.trace_id == 0:
+                ctx = _tctx.current()
+                if ctx is not None:
+                    msg.trace_id = ctx.trace_id
+                    msg.parent_span_id = ctx.span_id
+                    msg.sampled = True
+            if msg.trace_id > 0 and msg.span_id == 0:
+                msg.span_id = _tctx.new_span_id()
         if self._use_send_thread and msg.control is Control.EMPTY:
             # negative: PriorityQueue pops smallest first, we want highest first
             self._pq.put((-msg.priority, next(self._pq_tie), msg))
@@ -447,8 +468,42 @@ class Van:
             self.send_bytes += n
             if msg.domain is Domain.GLOBAL:
                 self.wan_send_bytes += n
+        if msg.control is Control.EMPTY:
+            is_wan = msg.domain is Domain.GLOBAL
+            if is_wan:
+                # per-codec WAN ledger, keyed by the wire compr tag ("" =
+                # vanilla/uncompressed; mpq shows up as the bsc/fp16
+                # split it actually chose per message)
+                self._wan_codec_counter(msg.compr).inc(n)
+            if _tctx.ACTIVE and msg.trace_id > 0:
+                # one instant per sampled message, under the MESSAGE's
+                # span id: receivers parent their handler spans at it,
+                # so every edge of the cross-node chain resolves to a
+                # recorded event (LAN hops included)
+                self._trace_event("wan.send" if is_wan else "lan.send",
+                                  span=msg.span_id,
+                                  parent=msg.parent_span_id,
+                                  trace_id=msg.trace_id, nbytes=n,
+                                  peer=str(msg.recipient))
         if self.config.verbose >= 2:
             self._log_wire("SEND", msg, n)
+
+    def _wan_codec_counter(self, tag: str):
+        c = self._wan_codec_counters.get(tag)
+        if c is None:
+            from geomx_tpu.utils.metrics import system_counter
+
+            c = self._wan_codec_counters.setdefault(tag, system_counter(
+                f"{self.node}.wan_bytes_{tag or 'vanilla'}"))
+        return c
+
+    def _trace_event(self, name: str, **kw):
+        tr = self._tracer
+        if tr is None:
+            from geomx_tpu.trace.recorder import get_tracer
+
+            tr = self._tracer = get_tracer(str(self.node))
+        tr.instant(name, **kw)
 
     def _log_wire(self, direction: str, msg: Message, nbytes: int):
         """Wire-level message log (ref: PS_VERBOSE >= 2 prints every
@@ -508,6 +563,15 @@ class Van:
             self.recv_bytes += n
             if msg.domain is Domain.GLOBAL:
                 self.wan_recv_bytes += n
+        if (_tctx.ACTIVE and msg.trace_id > 0
+                and msg.domain is Domain.GLOBAL
+                and msg.control is Control.EMPTY):
+            # paired with the sender's wan.send (parent = the message's
+            # span id): the collector recovers WAN transit time from the
+            # clock-corrected gap between the two instants
+            self._trace_event("wan.recv", parent=msg.span_id,
+                              trace_id=msg.trace_id, nbytes=n,
+                              peer=str(msg.sender))
         if self.config.verbose >= 2:
             self._log_wire("RECV", msg, n)
         if msg.control is Control.ACK:

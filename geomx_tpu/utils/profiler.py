@@ -32,6 +32,10 @@ class Profiler:
         self._mu = threading.Lock()
         self.running = False
         self._t0 = time.perf_counter()
+        # monotonic twin of _t0: the distributed tracer (geomx_tpu/trace)
+        # records into THIS buffer with profiler-relative ts but ships
+        # absolute monotonic stamps for cross-node merging
+        self.t0_mono = time.monotonic()
 
     # ---- control (ref: MXSetProfilerState / MXProfilePause) -----------------
     def configure(self, process_name: Optional[str] = None):
@@ -68,6 +72,15 @@ class Profiler:
                     "pid": self.process_name,
                     "tid": threading.current_thread().name,
                 })
+
+    def add_event(self, ev: dict) -> None:
+        """Append one pre-built Chrome-trace event (the distributed
+        tracer's entry point — shares this buffer instead of keeping its
+        own, so the remote-profiler dump and the merged distributed
+        trace can never drift apart).  Not gated on ``running``: the
+        tracer has its own gate (round sampling)."""
+        with self._mu:
+            self._events.append(ev)
 
     def count(self, name: str, value: float = 1.0):
         if not self.running:

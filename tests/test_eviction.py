@@ -282,11 +282,20 @@ def test_crash_eviction_e2e_worker_and_local_server():
     completes on the survivor set with loss parity versus an
     uninterrupted control run, the restarted local server rejoins and
     contributes again, and the eviction / fence / party-fold counters
-    are visible in the system-metrics registry."""
+    are visible in the system-metrics registry.
+
+    Phase timings ride the distributed tracer (PhaseTracer) and are
+    dumped as a Chrome-trace artifact at the end — a future flake of
+    this soak names the phase (and the eviction/fold control instants
+    around it) that stalled."""
+    from geomx_tpu.trace import PhaseTracer
+
+    pt = PhaseTracer("crash_eviction_e2e")
     steps = 24
     kill_after = 8
 
     # ---- control: same topology, nobody killed -------------------------
+    pt.begin("control_run")
     sim = Simulation(Config(topology=Topology(num_parties=2,
                                               workers_per_party=2)))
     try:
@@ -302,6 +311,7 @@ def test_crash_eviction_e2e_worker_and_local_server():
         sim.shutdown()
 
     # ---- phase A: a worker dies ungracefully mid-training --------------
+    pt.begin("worker_crash_eviction")
     sim = Simulation(Config(
         topology=Topology(num_parties=2, workers_per_party=2),
         heartbeat_interval_s=0.1, heartbeat_timeout_s=0.8,
@@ -319,6 +329,7 @@ def test_crash_eviction_e2e_worker_and_local_server():
         ths = _train_cnn(jobs, hist, errs)
         ths[1].join(300)
         assert 1 in hist, errs
+        pt.mark("kill_worker", node="worker:1@p0")
         sim.kill_worker(0, 1)
         for t in ths:
             t.join(300)
@@ -343,6 +354,7 @@ def test_crash_eviction_e2e_worker_and_local_server():
         sim.shutdown()
 
     # ---- phase B: a local server dies mid-training, replacement rejoins
+    pt.begin("local_server_crash_recovery")
     sim = Simulation(Config(
         topology=Topology(num_parties=2, workers_per_party=1),
         heartbeat_interval_s=0.1, heartbeat_timeout_s=0.8,
@@ -357,10 +369,12 @@ def test_crash_eviction_e2e_worker_and_local_server():
         # let a few rounds land, then kill party 1's server MID-training;
         # its worker blocks on replayed requests until the warm boot
         assert _wait_for(lambda: progress.get(1, 0) >= 6, 120), progress
+        pt.mark("kill_local_server", party=1)
         sim.kill_local_server(1)
         time.sleep(2.5)  # detection + fold; party 0 keeps training
         killed_at = progress.get(1, 0)
         assert killed_at < steps, "server outlived the training run"
+        pt.mark("restart_local_server", party=1)
         sim.restart_local_server(1)
         # the warm-booted replacement folds the party back in
         assert _wait_for(
@@ -400,3 +414,4 @@ def test_crash_eviction_e2e_worker_and_local_server():
             np.isfinite(v).all() for v in ls2.store.values())
     finally:
         sim.shutdown()
+        print("phase timeline artifact:", pt.dump(), flush=True)

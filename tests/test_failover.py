@@ -293,8 +293,15 @@ def test_failover_e2e_processes(tmp_path):
     promoted standby WITHOUT operator action and finishes all steps;
     the final loss matches an unkilled control run within tolerance;
     the relaunched (zombie) ex-primary's late replication is provably
-    rejected by term (it prints its fenced state)."""
+    rejected by term (it prints its fenced state).
+
+    Phase timings ride the distributed tracer (PhaseTracer); the dumped
+    timeline artifact names the phase a future flake stalled in."""
     import tests.test_tcp as ttcp
+
+    from geomx_tpu.trace import PhaseTracer
+
+    pt = PhaseTracer("failover_e2e_processes")
 
     cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     topo = Topology(num_parties=1, workers_per_party=1,
@@ -334,6 +341,7 @@ def test_failover_e2e_processes(tmp_path):
         try:
             if kill_primary:
                 time.sleep(6.0)  # several rounds + replication shipped
+                pt.mark("sigkill_primary", role=gs_role)
                 procs[gs_role].send_signal(signal.SIGKILL)
                 procs[gs_role].wait(timeout=10)
                 time.sleep(3.0)  # detection + promotion + replay window
@@ -386,12 +394,17 @@ def test_failover_e2e_processes(tmp_path):
         return float(m.group(1))
 
     # control run: same topology, nobody killed
-    ctrl, _, _ = run_cluster(ttcp.free_base_port(), kill_primary=False)
-    ctrl_worker = ctrl[str(topo.workers(0)[0])]
-    assert "steps=120" in ctrl_worker, ctrl_worker[-2000:]
+    try:
+        pt.begin("control_run")
+        ctrl, _, _ = run_cluster(ttcp.free_base_port(), kill_primary=False)
+        ctrl_worker = ctrl[str(topo.workers(0)[0])]
+        assert "steps=120" in ctrl_worker, ctrl_worker[-2000:]
 
-    outs, gs_role, sb_role = run_cluster(ttcp.free_base_port(),
-                                         kill_primary=True)
+        pt.begin("kill_primary_run")
+        outs, gs_role, sb_role = run_cluster(ttcp.free_base_port(),
+                                             kill_primary=True)
+    finally:
+        print("phase timeline artifact:", pt.dump(), flush=True)
     worker_out = outs[str(topo.workers(0)[0])]
     assert "steps=120" in worker_out, worker_out[-2000:]
     # the mechanism: the standby was promoted under term 1...
