@@ -1340,30 +1340,44 @@ def child_wan():
 
     out = {}
     registry = {}
+    table = {}   # per-config {wan_bytes_per_step, round_wall_s}: the
+    #              static baseline the adaptive controller's win is
+    #              measured against (plus an "adaptive" row below)
+
+    def _run_steps(sim, extra_cfg=None):
+        """Steady-state (bytes/step, wall s/step) over STEPS_W rounds."""
+        ws = sim.all_workers()
+        rng = np.random.default_rng(0)
+        for w in ws:
+            w.init(0, np.zeros(N_BIG, np.float32))
+            w.init(1, np.zeros(N_SMALL, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        if extra_cfg is not None:
+            for p in range(2):
+                sim.worker(p, 0).set_gradient_compression(extra_cfg)
+        base = sim.wan_bytes()["wan_send_bytes"]
+        t0 = time.perf_counter()
+        for _ in range(STEPS_W):
+            for tid, nel in ((0, N_BIG), (1, N_SMALL)):
+                g = rng.standard_normal(nel).astype(np.float32)
+                for w in ws:
+                    w.push(tid, g)
+            for w in ws:
+                w.pull_sync(0)
+                w.pull_sync(1)
+        wall = (time.perf_counter() - t0) / STEPS_W
+        sent = (sim.wan_bytes()["wan_send_bytes"] - base) / STEPS_W
+        return sent, wall
+
     for name, comp in configs.items():
         sim = Simulation(Config(
             topology=Topology(num_parties=2, workers_per_party=1)))
         try:
-            ws = sim.all_workers()
-            rng = np.random.default_rng(0)
-            for w in ws:
-                w.init(0, np.zeros(N_BIG, np.float32))
-                w.init(1, np.zeros(N_SMALL, np.float32))
-            ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
-            if comp is not None:
-                for p in range(2):
-                    sim.worker(p, 0).set_gradient_compression(comp)
-            base = sim.wan_bytes()["wan_send_bytes"]
             base_reg = _wan_registry()
-            for _ in range(STEPS_W):
-                for tid, nel in ((0, N_BIG), (1, N_SMALL)):
-                    g = rng.standard_normal(nel).astype(np.float32)
-                    for w in ws:
-                        w.push(tid, g)
-                for w in ws:
-                    w.pull_sync(0)
-                    w.pull_sync(1)
-            out[name] = (sim.wan_bytes()["wan_send_bytes"] - base) / STEPS_W
+            sent, wall = _run_steps(sim, comp)
+            out[name] = sent
+            table[name] = {"wan_bytes_per_step": round(sent, 1),
+                           "round_wall_s": round(wall, 4)}
             # per-codec split from the system-metrics registry (the vans
             # count every GLOBAL-domain data send under its wire compr
             # tag) — the same ledger the trace subsystem reports against,
@@ -1379,6 +1393,46 @@ def child_wan():
                               for t, v in sorted(per_tag.items())}
         finally:
             sim.shutdown()
+
+    # adaptive row: same workload under the closed-loop controller with
+    # a round budget the vanilla config cannot meet, driven by manual
+    # ticks (adapt_interval_s=0) so the run is deterministic.  The
+    # controller's decisions move the run down the codec ladder; the row
+    # records where it landed and what that cost per step.
+    sim = Simulation(Config(
+        topology=Topology(num_parties=2, workers_per_party=1),
+        adaptive_wan=True, adapt_interval_s=0.0,
+        adapt_round_budget_s=1e-4, adapt_cooldown_s=0.0))
+    try:
+        ws = sim.all_workers()
+        rng = np.random.default_rng(0)
+        for w in ws:
+            w.init(0, np.zeros(N_BIG, np.float32))
+            w.init(1, np.zeros(N_SMALL, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        base = sim.wan_bytes()["wan_send_bytes"]
+        t0 = time.perf_counter()
+        for _ in range(STEPS_W):
+            for tid, nel in ((0, N_BIG), (1, N_SMALL)):
+                g = rng.standard_normal(nel).astype(np.float32)
+                for w in ws:
+                    w.push(tid, g)
+            for w in ws:
+                w.pull_sync(0)
+                w.pull_sync(1)
+            sim.wan_controller.tick()
+        wall = (time.perf_counter() - t0) / STEPS_W
+        sent = (sim.wan_bytes()["wan_send_bytes"] - base) / STEPS_W
+        st = sim.wan_controller.status()
+        table["adaptive"] = {
+            "wan_bytes_per_step": round(sent, 1),
+            "round_wall_s": round(wall, 4),
+            "final_codec": st["compression"].get("type"),
+            "epoch": st["epoch"],
+            "decisions": st["decisions"],
+        }
+    finally:
+        sim.shutdown()
 
     # flagship-scale ledger (VERDICT r2 #7): one 50M-element tensor (200
     # MB fp32) through MultiGPS shards (3 global servers) x BSC — the
@@ -1439,6 +1493,7 @@ def child_wan():
         "bytes_per_step": {k: round(v, 1) for k, v in out.items()},
         "reduction": {k: round(out["vanilla"] / v, 2)
                       for k, v in out.items() if v > 0},
+        "table": table,
         "registry_bytes_per_step": registry,
         "flagship_50m_multigps_bsc": flagship,
     }))

@@ -488,19 +488,98 @@ def make_push_codec(config: dict):
     raise ValueError(f"unknown compression type '{typ}'")
 
 
-_TWOBIT_DECODERS: Dict[float, TwoBitCodec] = {}
+# Wire tags a gradient-push payload may legally carry ("" = vanilla
+# uncompressed f32).  Receivers fence anything else at message-decode
+# time instead of letting a bare ValueError poison the merge path.
+KNOWN_PUSH_TAGS = frozenset(("", "fp16", "2bit", "bsc"))
+
+# codecs whose payload semantics survive carrying WEIGHTS instead of
+# gradients (HFA rounds exchange party-mean weights; residual-feedback /
+# top-k-delta codecs assume a gradient stream and silently corrupt a
+# weight exchange)
+WEIGHT_SAFE_CODECS = frozenset(("none", "fp16"))
+
+
+def compression_allowed(codec: str, *, inter_ts: bool = False,
+                        hfa: bool = False) -> Tuple[bool, Optional[str]]:
+    """THE compatibility matrix for WAN codecs vs. operating modes.
+
+    One predicate shared by static config validation
+    (``Config.__post_init__``), the runtime ``SET_COMPRESSION`` /
+    ``SET_WAN_POLICY`` command gates, and the adaptive policy engine's
+    ladder construction (``geomx_tpu/control/policy.py``) — so the
+    rules can never drift.  Returns ``(ok, reason)``; ``reason`` is
+    None when allowed.
+
+    ``hfa=True`` is the RUNTIME-ACTUATION context (the adaptive policy
+    ladder and SET_WAN_POLICY): under HFA only weight-safe codecs may
+    be *switched to*, because the others either do nothing (the HFA K2
+    push path bypasses the push codec with dense milestone deltas) or
+    would corrupt a weight stream if they ever applied.  A STATIC
+    config combining HFA with bsc/mpq stays legal — the HFA data path
+    routes around gradient codecs with dense pushes and dense pulls
+    (see test_hfa_with_bsc_pull_stays_dense_and_synced) — so config
+    validation passes ``hfa=False``."""
+    if codec not in ("none", "fp16", "2bit", "bsc", "mpq"):
+        return False, f"unknown compression type '{codec}'"
+    if inter_ts and codec in ("bsc", "mpq"):
+        return False, (
+            "enable_inter_ts cannot combine with bsc/mpq pull "
+            "compression (per-subscriber sparsified deltas don't fit "
+            "a shared relay payload); use fp16 or none")
+    if hfa and codec not in WEIGHT_SAFE_CODECS:
+        return False, (
+            f"'{codec}' is not weight-safe: HFA rounds exchange party-"
+            "mean weights, and residual/top-k gradient codecs corrupt a "
+            "weight stream; use fp16 or none")
+    return True, None
+
+
+class DecoderBank:
+    """Per-endpoint stateful-decoder cache (bounded, LRU).
+
+    Replaces the old module-level ``_TWOBIT_DECODERS`` dict, which was
+    shared across every Simulation in one process and unbounded across
+    thresholds: two concurrent deployments decoding 2-bit payloads with
+    different thresholds hit the same instances, and any future decoder
+    that keeps per-key state (residuals, bases) would silently leak one
+    run's state into another.  Each receiving server owns one bank."""
+
+    def __init__(self, cap: int = 32):
+        import collections
+
+        self._cap = int(cap)
+        self._decoders: "collections.OrderedDict" = collections.OrderedDict()
+
+    def twobit(self, threshold: float) -> TwoBitCodec:
+        key = ("2bit", float(threshold))
+        dec = self._decoders.get(key)
+        if dec is None:
+            dec = self._decoders[key] = TwoBitCodec(threshold)
+        self._decoders.move_to_end(key)
+        while len(self._decoders) > self._cap:
+            self._decoders.popitem(last=False)
+        return dec
+
+    def clear(self) -> None:
+        """Drop all decoder state (a policy-epoch switch installs fresh
+        codec parameters; stale residual-bearing decoders must not
+        outlive the epoch that created them)."""
+        self._decoders.clear()
 
 
 def decompress_payload(compr: str, key: int, payload: np.ndarray,
-                       orig_len: int, threshold: float = 0.5) -> np.ndarray:
-    """Stateless decode by tag (receiver side)."""
+                       orig_len: int, threshold: float = 0.5,
+                       bank: Optional[DecoderBank] = None) -> np.ndarray:
+    """Decode by tag (receiver side).  ``bank`` scopes stateful decoders
+    to the calling endpoint; without one a fresh (stateless-for-decode)
+    codec is used."""
     if compr == "fp16":
         return payload.astype(np.float32)
     if compr == "bsc":
         return scatter_sparse(payload, orig_len)
     if compr == "2bit":
-        dec = _TWOBIT_DECODERS.get(threshold)
-        if dec is None:
-            dec = _TWOBIT_DECODERS[threshold] = TwoBitCodec(threshold)
+        dec = bank.twobit(threshold) if bank is not None \
+            else TwoBitCodec(threshold)
         return dec.decompress(key, payload, orig_len)
     raise ValueError(f"unknown compr tag '{compr}'")

@@ -391,6 +391,25 @@ class Config:
     trace_dir: str = ""          # launch.py dumps the merged trace +
     #                              critical-path report here at shutdown
     trace_batch_events: int = 256  # spans per TRACE_REPORT batch
+    # --- adaptive WAN control plane (geomx_tpu/control; beyond the
+    # reference, whose codec/ratio choice is fixed at launch).  When on,
+    # a controller on the global scheduler samples per-link goodput /
+    # RTT / round-rate signals and retunes the WAN codec tier mid-
+    # training via an epoch-fenced Ctrl.SET_WAN_POLICY broadcast (see
+    # docs/adaptive-wan.md).  Off (default) = zero new work on any
+    # message path beyond a single flag check.
+    adaptive_wan: bool = False
+    adapt_interval_s: float = 1.0   # controller sampling period; 0 =
+    #                                 no sweep thread (manual tick only —
+    #                                 what deterministic tests use)
+    adapt_round_budget_s: float = 0.0  # target WAN round time; 0 = auto-
+    #                                    calibrate to 1.5x the median of
+    #                                    the first observation window
+    adapt_deadband: float = 0.25    # hysteresis band around the budget:
+    #                                 no action while round time is within
+    #                                 budget*(1±deadband)
+    adapt_cooldown_s: float = 5.0   # min seconds between policy changes
+    adapt_window: int = 8           # sliding-window length (samples)
     verbose: int = 0
 
     def __post_init__(self):
@@ -422,11 +441,23 @@ class Config:
                 "enable_p3 and enable_intra_ts are mutually exclusive "
                 "accelerations: P3's piggybacked pulls bypass the TS "
                 "overlay, and the merge tree bypasses P3's sliced sends")
-        if self.enable_inter_ts and self.compression in ("bsc", "mpq"):
-            raise ValueError(
-                "enable_inter_ts cannot combine with bsc/mpq pull "
-                "compression (per-subscriber sparsified deltas don't fit "
-                "a shared relay payload); use fp16 or none")
+        # codec × mode compatibility lives in ONE shared predicate (also
+        # used by the runtime SET_COMPRESSION/SET_WAN_POLICY gates and
+        # the adaptive policy engine), so the rules can't drift.
+        # hfa=False here: a STATIC HFA+bsc config is legal — the HFA
+        # data path bypasses gradient codecs with dense exchanges (see
+        # the predicate's docstring); only runtime RETUNING under HFA is
+        # restricted to weight-safe codecs
+        from geomx_tpu.compression.codecs import compression_allowed
+
+        ok, reason = compression_allowed(
+            self.compression, inter_ts=self.enable_inter_ts)
+        if not ok:
+            raise ValueError(reason)
+        if self.adapt_deadband < 0.0 or self.adapt_deadband >= 1.0:
+            raise ValueError("adapt_deadband must be in [0, 1)")
+        if self.adapt_window < 2:
+            raise ValueError("adapt_window must be >= 2")
         if self.replicate_every < 1:
             raise ValueError("replicate_every must be >= 1")
         if self.trace_sample_every < 0:
@@ -522,5 +553,11 @@ class Config:
             trace_sample_every=_env_int("GEOMX_TRACE_SAMPLE_EVERY", 0),
             trace_dir=os.environ.get("GEOMX_TRACE_DIR", ""),
             trace_batch_events=_env_int("GEOMX_TRACE_BATCH_EVENTS", 256),
+            adaptive_wan=_env_bool("GEOMX_ADAPTIVE_WAN"),
+            adapt_interval_s=_env_float("GEOMX_ADAPT_INTERVAL", 1.0),
+            adapt_round_budget_s=_env_float("GEOMX_ADAPT_ROUND_BUDGET", 0.0),
+            adapt_deadband=_env_float("GEOMX_ADAPT_DEADBAND", 0.25),
+            adapt_cooldown_s=_env_float("GEOMX_ADAPT_COOLDOWN", 5.0),
+            adapt_window=_env_int("GEOMX_ADAPT_WINDOW", 8),
             verbose=_env_int("GEOMX_VERBOSE", _env_int("PS_VERBOSE", 0)),
         )

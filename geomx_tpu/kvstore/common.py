@@ -143,3 +143,11 @@ class Ctrl(enum.IntEnum):
     #                            no response slot): one batch of completed
     #                            trace spans + the sender's heartbeat-RTT
     #                            clock offsets (geomx_tpu/trace/collector)
+    SET_WAN_POLICY = 23        # adaptive WAN controller -> servers (both
+    #                            tiers): body {"epoch": int, "compression":
+    #                            {...}} — global servers (receivers) adopt
+    #                            immediately, local servers (senders) at
+    #                            their next WAN round boundary; gradient
+    #                            pushes then carry Message.policy_epoch and
+    #                            cross-epoch payloads are fenced with a
+    #                            retryable error (geomx_tpu/control)

@@ -299,6 +299,24 @@ class LocalServer:
         self._esync = None  # EsyncState, lazily built on first Ctrl.ESYNC
         self.compression: dict = {"type": "none"}
         self.push_codec = None  # set by Ctrl.SET_COMPRESSION
+        # adaptive WAN control plane (geomx_tpu/control).  This server
+        # is the SENDER side of the epoch protocol: SET_WAN_POLICY lands
+        # as _policy_pending and is applied atomically at the next WAN
+        # round boundary (_push_up_send), every gradient push is stamped
+        # with the current epoch, and a receiver's policy fence is
+        # answered by re-encoding the stashed raw gradients under the
+        # newer policy and retrying.  Off (default): one flag check per
+        # round, no stash, no stamping.
+        self._adaptive = bool(self.config.adaptive_wan)
+        self._policy_epoch = 0
+        self._policy_pending: Optional[dict] = None
+        self.wan_push_rounds = 0      # WAN push-up batches (controller's
+        #                               round-rate signal, via QUERY_STATS)
+        self.policy_fence_retries = 0  # fenced pushes re-encoded+retried
+        self.policy_drops = 0          # fence retries abandoned (loud)
+        if self._adaptive:
+            self._policy_stash: Dict[int, dict] = {}  # up-ts -> entry
+            self.up.error_handler = self._on_up_error
         # TSEngine intra-party dissemination (ref: DefaultAutoPull
         # kvstore_dist_server.h:1368-1384)
         self.ts_client = None
@@ -1068,6 +1086,18 @@ class LocalServer:
         if self._prof.running:
             self._prof.count("wan_rounds", 1.0)
         keys = [int(k) for k in kvs.keys]
+        raw = None
+        if self._adaptive:
+            with self._mu:
+                # the WAN round boundary: a pending policy applies HERE,
+                # so the whole batch below is encoded under one epoch
+                self._apply_policy_locked()
+            # stash the raw merged gradients until the round is acked —
+            # a receiver's policy fence is answered by re-encoding them
+            # under the newer codec (one extra copy per round, paid only
+            # with adaptive WAN on)
+            raw = {int(k): np.array(v, copy=True) for k, v in kvs.slices()}
+        self.wan_push_rounds += 1
 
         with self._mu:
             epochs = {k: self._keys[k].epoch for k in keys
@@ -1094,6 +1124,48 @@ class LocalServer:
 
         # group keys by wire codec so each message has a uniform payload
         # dtype + compr tag (ref: PushCompressed kvstore_dist.h:530-563)
+        groups = self._encode_wan_groups(kvs, rs_keys)
+        # P3 piggyback on the WAN tier: combined push_pull saves the
+        # per-round ack -> pull-request chain (2 messages + 2 latencies
+        # per key per round); the global server replies with the updated
+        # values once the round completes.  Not combinable with the
+        # inter-TS overlay (which replaces the pull-down entirely),
+        # merged pushes (num_merge body), or the adaptive epoch
+        # protocol (a fenced piggyback would eat the pull's response
+        # slot; the split push + pull path retries cleanly).
+        use_piggyback = (self.config.enable_p3 and push_body is None
+                         and self.ts_inter is None and not self._adaptive)
+        if use_piggyback:
+            for tag, pairs in groups.items():
+                ks = np.array([k for k, _ in pairs], dtype=np.int64)
+                vals = (pairs[0][1] if len(pairs) == 1
+                        else np.concatenate([p for _, p in pairs]))
+                lens = np.array([len(p) for _, p in pairs], dtype=np.int64)
+                self.up.push_pull(
+                    KVPairs(ks, vals, lens), cmd=Cmd.DEFAULT,
+                    cb=lambda kvs: self._on_pull_down(kvs, epochs),
+                    compr=tag, priority=prio, donated=True,
+                    body=self._pull_echo([int(k) for k in ks]))
+            return
+
+        remaining = [len(groups)]
+        lock = threading.Lock()
+
+        def one_group_acked():
+            with lock:
+                remaining[0] -= 1
+                done = remaining[0] == 0
+            if done:
+                pull_down()
+
+        for tag, pairs in groups.items():
+            self._send_wan_group(tag, pairs, one_group_acked, push_body,
+                                 prio, rs_keys, raw)
+
+    def _encode_wan_groups(self, kvs: KVPairs,
+                           rs_keys=frozenset()) -> Dict[str, list]:
+        """Group a push-up batch by wire codec (shared by the round path
+        and the adaptive fence-retry re-encode)."""
         groups: Dict[str, list] = {}
         if self.push_codec is None:
             # uncompressed mode — except row-sparse rounds, whose merged
@@ -1119,48 +1191,204 @@ class LocalServer:
                              else self.push_codec)
                     groups.setdefault(codec.name, []).append(
                         (k, codec.compress(k, v)))
-        # P3 piggyback on the WAN tier: combined push_pull saves the
-        # per-round ack -> pull-request chain (2 messages + 2 latencies
-        # per key per round); the global server replies with the updated
-        # values once the round completes.  Not combinable with the
-        # inter-TS overlay (which replaces the pull-down entirely) or
-        # merged pushes (num_merge body).
-        use_piggyback = (self.config.enable_p3 and push_body is None
-                         and self.ts_inter is None)
-        if use_piggyback:
-            for tag, pairs in groups.items():
-                ks = np.array([k for k, _ in pairs], dtype=np.int64)
-                vals = (pairs[0][1] if len(pairs) == 1
-                        else np.concatenate([p for _, p in pairs]))
-                lens = np.array([len(p) for _, p in pairs], dtype=np.int64)
-                self.up.push_pull(
-                    KVPairs(ks, vals, lens), cmd=Cmd.DEFAULT,
-                    cb=lambda kvs: self._on_pull_down(kvs, epochs),
-                    compr=tag, priority=prio, donated=True,
-                    body=self._pull_echo([int(k) for k in ks]))
-            return
+        return groups
 
-        remaining = [len(groups)]
-        lock = threading.Lock()
-
-        def one_group_acked():
-            with lock:
-                remaining[0] -= 1
-                done = remaining[0] == 0
-            if done:
-                pull_down()
-
-        for tag, pairs in groups.items():
-            ks = np.array([k for k, _ in pairs], dtype=np.int64)
-            vals = (pairs[0][1] if len(pairs) == 1
-                    else np.concatenate([p for _, p in pairs]))
-            lens = np.array([len(p) for _, p in pairs], dtype=np.int64)
+    def _send_wan_group(self, tag: str, pairs: list, done_cb,
+                        push_body, prio: int, rs_keys, raw,
+                        attempts: int = 0):
+        """Push one codec group up.  Under adaptive WAN the push is
+        stamped with the current policy epoch and stashed so a receiver
+        fence can re-encode + retry it; ``done_cb`` fires exactly once —
+        on the successful (possibly retried) ack, or on a loudly-logged
+        give-up."""
+        ks = np.array([k for k, _ in pairs], dtype=np.int64)
+        vals = (pairs[0][1] if len(pairs) == 1
+                else np.concatenate([p for _, p in pairs]))
+        lens = np.array([len(p) for _, p in pairs], dtype=np.int64)
+        kvp = KVPairs(ks, vals, lens)
+        if not self._adaptive:
             # donated: every push-up payload is server-owned (the round's
             # aggregation buffer, a codec output, or a fresh delta) and
             # never touched again — the receiving tier may adopt it
-            self.up.zpush(KVPairs(ks, vals, lens), cmd=Cmd.DEFAULT,
-                          on_complete=one_group_acked, compr=tag,
-                          body=push_body, priority=prio, donated=True)
+            self.up.zpush(kvp, cmd=Cmd.DEFAULT, on_complete=done_cb,
+                          compr=tag, body=push_body, priority=prio,
+                          donated=True)
+            return
+        # a retried "" (vanilla) payload IS the stashed raw copy — the
+        # receiver must not adopt+mutate the buffer a further retry may
+        # need, so only first sends donate it
+        donate = not (tag == "" and attempts > 0)
+        ent = {"raw": {int(k): raw[int(k)] for k, _ in pairs},
+               "rs": frozenset(rs_keys), "body": push_body, "prio": prio,
+               "done": done_cb, "attempts": attempts, "fenced": False,
+               "ts": None}
+
+        def guard():
+            # ordering contract: the fence error-handler runs BEFORE the
+            # completion fires (same response-processing thread), so
+            # "fenced" is authoritative here; a fenced ack means the
+            # retry owns done_cb now
+            with self._mu:
+                fenced = ent["fenced"]
+                ent["fenced"] = False
+                if not fenced:
+                    self._policy_stash.pop(ent["ts"], None)
+            if not fenced:
+                done_cb()
+
+        # hold the lock across send + stash insert: the response (and
+        # with it the fence handler / guard) can race zpush's return,
+        # and both take this lock before touching the stash
+        with self._mu:
+            ts = self.up.zpush(kvp, cmd=Cmd.DEFAULT, on_complete=guard,
+                               compr=tag, body=push_body, priority=prio,
+                               donated=donate,
+                               policy_epoch=self._policy_epoch)
+            ent["ts"] = ts
+            self._policy_stash[ts] = ent
+
+    # ---- adaptive WAN: policy application + fence retry ---------------------
+    def _on_set_wan_policy(self, msg: Message, body: dict):
+        """Ctrl.SET_WAN_POLICY from the controller (sender side): store
+        as pending; the next WAN round boundary applies it atomically.
+        Constraint-gated by the SAME predicate as static config."""
+        if not self._adaptive:
+            self.server.reply_cmd(msg, body={
+                "error": "adaptive WAN is disabled on this server "
+                         "(Config.adaptive_wan / --adaptive-wan)"})
+            return
+        from geomx_tpu.compression import compression_allowed
+
+        comp = dict(body.get("compression") or {})
+        ok, why = compression_allowed(
+            comp.get("type", "none"),
+            inter_ts=self.config.enable_inter_ts, hfa=self.hfa_enabled)
+        if not ok:
+            self.server.reply_cmd(msg, body={"error": why})
+            return
+        with self._mu:
+            epoch = int(body.get("epoch", 0))
+            if epoch > self._policy_epoch and (
+                    self._policy_pending is None
+                    or epoch > int(self._policy_pending["epoch"])):
+                self._policy_pending = {"epoch": epoch,
+                                        "compression": comp}
+            cur = self._policy_epoch
+        self.server.reply_cmd(msg, body={"epoch": cur, "pending": epoch})
+
+    def _apply_policy_locked(self):
+        """Install a pending SET_WAN_POLICY (caller holds ``_mu``).
+        Replacing the push codec drops its residual/velocity state by
+        design — the unsent mass belongs to the old epoch's stream."""
+        p = self._policy_pending
+        if p is None:
+            return
+        self._policy_pending = None
+        epoch = int(p["epoch"])
+        if epoch <= self._policy_epoch:
+            return  # stale (an older broadcast raced a fence adoption)
+        from geomx_tpu.compression import make_push_codec
+
+        comp = dict(p["compression"])
+        try:
+            codec = make_push_codec(comp)
+        except ValueError:
+            import logging
+
+            logging.getLogger(__name__).error(
+                "%s: refusing malformed WAN policy %r", self.po.node, comp)
+            return
+        self.push_codec = codec
+        self.compression = comp
+        self._policy_epoch = epoch
+        from geomx_tpu.utils.metrics import system_gauge
+
+        system_gauge(f"{self.po.node}.wan_policy_epoch").set(epoch)
+        self._tr.instant("wanpolicy.apply", epoch=epoch,
+                         codec=comp.get("type"))
+        print(f"{self.po.node}: WAN policy epoch {epoch} applied at "
+              f"round boundary -> {comp.get('type')}", flush=True)
+
+    def _on_up_error(self, msg: Message) -> bool:
+        """KVWorker error hook on the up-link: turn a receiver's policy
+        fence into re-encode + retry.  Returns True when the error is
+        fully handled here (it never reaches ``up.errors``)."""
+        b = msg.body if isinstance(msg.body, dict) else {}
+        if not b.get("policy_fenced"):
+            return False
+        retry = None
+        with self._mu:
+            # self-healing: the fence reply names the receiver's current
+            # policy — adopt it NOW (this round must be re-encoded under
+            # it anyway) even if the SET_WAN_POLICY broadcast was lost
+            ep = int(b.get("policy_epoch", 0))
+            comp = b.get("policy")
+            adopted = comp is not None and ep > self._policy_epoch
+            if adopted:
+                self._policy_pending = {"epoch": ep, "compression": comp}
+                self._apply_policy_locked()
+            ent = self._policy_stash.pop(msg.timestamp, None)
+            if ent is not None:
+                self.policy_fence_retries += 1
+                if ent["attempts"] < 5:
+                    ent["fenced"] = True  # guard defers done to the retry
+                    retry = ent
+                else:
+                    # give up LOUDLY: guard fires done_cb so the round
+                    # completes; this round's gradient for these keys is
+                    # dropped — the same staleness class as an async-tier
+                    # lost push, and far better than a wedged FSA round
+                    self.policy_drops += 1
+                    import logging
+
+                    logging.getLogger(__name__).error(
+                        "%s: dropping WAN push after %d policy-fence "
+                        "retries (keys %s)", self.po.node,
+                        ent["attempts"], sorted(ent["raw"]))
+        if ent is None:
+            return False  # not ours (already handled / unknown ts)
+        from geomx_tpu.utils.metrics import system_counter
+
+        system_counter(f"{self.po.node}.policy_fence_retries").inc()
+        if retry is not None:
+            if adopted or ep >= self._policy_epoch:
+                self._repush_fenced(retry)
+            else:
+                # the RECEIVER is the stale side (a promoted standby the
+                # controller has not reached yet): back off so its
+                # rebroadcast can land before the retry budget burns
+                t = threading.Timer(0.1 * (retry["attempts"] + 1),
+                                    self._repush_fenced, args=(retry,))
+                t.daemon = True
+                t.start()
+        return True
+
+    def _repush_fenced(self, ent: dict):
+        """Re-encode a fenced group's stashed raw gradients under the
+        (now-adopted) policy and push again.  The new policy may split
+        the keys into different codec groups (MPQ), so the original
+        ``done`` fires once ALL sub-groups ack."""
+        raw = ent["raw"]
+        ks = sorted(raw)
+        vals = [raw[k] for k in ks]
+        kvp = KVPairs(np.array(ks, dtype=np.int64),
+                      vals[0] if len(vals) == 1 else np.concatenate(vals),
+                      np.array([len(v) for v in vals], dtype=np.int64))
+        groups = self._encode_wan_groups(kvp, ent["rs"])
+        remaining = [len(groups)]
+        lock = threading.Lock()
+
+        def sub_done():
+            with lock:
+                remaining[0] -= 1
+                fire = remaining[0] == 0
+            if fire:
+                ent["done"]()
+
+        for tag, pairs in groups.items():
+            self._send_wan_group(tag, pairs, sub_done, ent["body"],
+                                 ent["prio"], ent["rs"], raw,
+                                 attempts=ent["attempts"] + 1)
 
     def _push_up_hfa(self, kvs: KVPairs):
         """K2 round: ship (mean_weights - milestone)/num_global_workers
@@ -1390,12 +1618,22 @@ class LocalServer:
         if msg.cmd == Ctrl.SET_SYNC_MODE:
             self.sync_mode = bool(body["sync"])
         elif msg.cmd == Ctrl.SET_COMPRESSION:
-            from geomx_tpu.compression import make_push_codec
+            from geomx_tpu.compression import (compression_allowed,
+                                               make_push_codec)
 
             if body == self.compression:
                 # idempotent: a mid-training recreation would drop the
                 # unsent residual/velocity mass held in the old codec
                 self.server.reply_cmd(msg)
+                return
+            # hfa=False: a static/operator SET_COMPRESSION under HFA is
+            # the dense-bypass case (predicate docstring); only runtime
+            # POLICY retuning restricts to weight-safe codecs
+            ok, why = compression_allowed(
+                body.get("type", "none"),
+                inter_ts=self.config.enable_inter_ts)
+            if not ok:
+                self.server.reply_cmd(msg, body={"error": why})
                 return
             try:
                 self.push_codec = make_push_codec(body)
@@ -1403,6 +1641,9 @@ class LocalServer:
             except ValueError as e:
                 self.server.reply_cmd(msg, body={"error": str(e)})
                 return
+        elif msg.cmd == Ctrl.SET_WAN_POLICY:
+            self._on_set_wan_policy(msg, body)
+            return
         elif msg.cmd == Ctrl.SET_HFA:
             if bool(body["enabled"]) and self._saw_row_sparse:
                 self.server.reply_cmd(msg, body={
@@ -1437,6 +1678,14 @@ class LocalServer:
                 "mpq_bsc_picks": getattr(self.push_codec, "bsc_picks", 0),
                 "mpq_fp16_picks": getattr(self.push_codec, "fp16_picks", 0),
                 "pq_overtakes": van.pq_overtakes,
+                # adaptive-WAN controller signals: round rate + link RTT
+                # + this sender's applied policy epoch
+                "wan_push_rounds": self.wan_push_rounds,
+                "policy_epoch": self._policy_epoch,
+                "policy_fence_retries": self.policy_fence_retries,
+                "policy_drops": self.policy_drops,
+                "hb_rtt_s": max(self.po.heartbeat_rtts().values(),
+                                default=None),
             })
             return
         elif msg.cmd == Ctrl.ESYNC:
@@ -1579,6 +1828,22 @@ class GlobalServer:
         self.sync_mode = self.config.sync_global_mode
         self.compression: dict = {"type": "none"}
         self.pull_comp = None  # BroadcastCompressor under bsc/mpq
+        # adaptive WAN (geomx_tpu/control), RECEIVER side: SET_WAN_POLICY
+        # adopts the new decode parameters + pull compressor immediately
+        # (tracked views invalidated through the version handshake —
+        # subscribers resync dense), and gradient pushes stamped with a
+        # different epoch are fenced with a retryable error carrying the
+        # current policy, so the sender re-encodes instead of this server
+        # misdecoding.  Off (default): one flag check per push.
+        self._adaptive = bool(self.config.adaptive_wan)
+        self._policy_epoch = 0
+        self.policy_fenced_pushes = 0
+        self.rejected_compr_tags = 0
+        # per-endpoint stateful-decoder cache (replaces the process-wide
+        # _TWOBIT_DECODERS dict two concurrent Simulations used to share)
+        from geomx_tpu.compression import DecoderBank
+
+        self._decoders = DecoderBank()
         self._recent = RecentRequests()  # replayed-push dedup
         # automatic periodic checkpoints (mid-round crash recovery; an
         # improvement over the reference, whose server state is RAM-only)
@@ -1821,6 +2086,8 @@ class GlobalServer:
             self._recent.mark_done(msg)
             server.response(msg)
             return
+        if msg.push and msg.request and self._reject_bad_push(msg):
+            return  # fenced at message-decode time, before any merge
         if msg.push and msg.compr and kvs is not None:
             kvs = self._decompress_push(msg, kvs)
         if msg.push:
@@ -1830,6 +2097,50 @@ class GlobalServer:
                 self._push_async(msg, kvs)
         elif msg.pull:
             self._pull(msg, kvs)
+
+    def _reject_bad_push(self, msg: Message) -> bool:
+        """Fence a push BEFORE it can reach the merge: (a) a malformed /
+        foreign compr tag would raise a bare ValueError deep inside
+        ``decompress_payload`` and poison the round — answer with an
+        error naming the offending node, tag and policy epoch instead;
+        (b) under adaptive WAN, a gradient push whose policy epoch
+        differs from this server's current one is refused with a
+        RETRYABLE error carrying the current policy, so the sender
+        re-encodes rather than this server decoding with the wrong
+        parameters.  Deliberately ahead of the replay-dedup window: a
+        fenced request is never recorded, so its retried re-encode is
+        processed fresh.  Returns True when the push was answered."""
+        from geomx_tpu.compression.codecs import KNOWN_PUSH_TAGS
+
+        if msg.compr and msg.compr not in KNOWN_PUSH_TAGS:
+            self.rejected_compr_tags += 1
+            from geomx_tpu.utils.metrics import system_counter
+
+            system_counter(f"{self.po.node}.rejected_compr_tags").inc()
+            self.server.response(msg, body={
+                "error": f"unknown compression tag '{msg.compr}' in push "
+                         f"from {msg.sender} (policy epoch "
+                         f"{msg.policy_epoch}); payload refused before "
+                         "merge", "compr": msg.compr})
+            return True
+        if (self._adaptive and msg.cmd == Cmd.DEFAULT
+                and msg.policy_epoch != self._policy_epoch):
+            self.policy_fenced_pushes += 1
+            from geomx_tpu.utils.metrics import system_counter
+
+            system_counter(f"{self.po.node}.policy_fenced_pushes").inc()
+            with self._mu:
+                cur_epoch = self._policy_epoch
+                cur_policy = dict(self.compression)
+            self.server.response(msg, body={
+                "error": f"policy epoch fenced: push from {msg.sender} "
+                         f"carries epoch {msg.policy_epoch}, server is "
+                         f"at {cur_epoch}; re-encode under the current "
+                         "policy and retry",
+                "policy_fenced": True, "policy_epoch": cur_epoch,
+                "policy": cur_policy})
+            return True
+        return False
 
     def _decompress_push(self, msg: Message, kvs: KVPairs) -> KVPairs:
         """Decode a compressed gradient push to dense before aggregation
@@ -1842,7 +2153,8 @@ class GlobalServer:
         with self._tr.span("codec.decode"), self._mu:
             for k, payload in kvs.slices():
                 orig = len(self.store[k])
-                dense = decompress_payload(msg.compr, k, payload, orig, thr)
+                dense = decompress_payload(msg.compr, k, payload, orig, thr,
+                                           bank=self._decoders)
                 ks.append(k); vs.append(dense); ls.append(orig)
         return KVPairs(np.array(ks, dtype=np.int64),
                        vs[0] if len(vs) == 1 else np.concatenate(vs),
@@ -2145,6 +2457,60 @@ class GlobalServer:
             body={"compr": tags, "pv": pvs},
         )
 
+    def _on_set_wan_policy(self, msg: Message, body: dict):
+        """Ctrl.SET_WAN_POLICY from the controller (receiver side):
+        adopt the decode parameters + pull compressor IMMEDIATELY (the
+        controller contacts receivers before senders).  The rebuilt
+        compressor carries ``trust_init=False`` and its tracked views
+        are gone, so every subscriber's next compressed pull resyncs
+        dense through the existing version handshake — the coherent
+        invalidation the epoch protocol relies on.  Old-epoch pushes
+        already merged into an open round stay merged (they were decoded
+        under their own epoch's parameters when they arrived); only
+        NOT-yet-decoded cross-epoch payloads are fenced."""
+        if not self._adaptive:
+            self.server.reply_cmd(msg, body={
+                "error": "adaptive WAN is disabled on this server "
+                         "(Config.adaptive_wan / --adaptive-wan)"})
+            return
+        from geomx_tpu.compression import (compression_allowed,
+                                           make_push_codec)
+
+        comp = dict(body.get("compression") or {})
+        ok, why = compression_allowed(
+            comp.get("type", "none"),
+            inter_ts=self.ts_inter is not None, hfa=self.config.use_hfa)
+        if not ok:
+            self.server.reply_cmd(msg, body={"error": why})
+            return
+        try:
+            make_push_codec(comp)  # validate before adopting
+        except ValueError as e:
+            self.server.reply_cmd(msg, body={"error": str(e)})
+            return
+        applied = False
+        with self._mu:
+            epoch = int(body.get("epoch", 0))
+            if epoch > self._policy_epoch:
+                self._policy_epoch = epoch
+                # trust_init=False: subscribers hold trained weights,
+                # not INIT values — their first pull under the new
+                # policy must resync dense, never sparse-from-INIT
+                self._apply_compression_locked(comp, trust_init=False)
+                # stateful decoders die with the epoch that created them
+                self._decoders.clear()
+                applied = True
+            cur = self._policy_epoch
+        if applied:
+            from geomx_tpu.utils.metrics import system_gauge
+
+            system_gauge(f"{self.po.node}.wan_policy_epoch").set(cur)
+            self._tr.instant("wanpolicy.apply", epoch=cur,
+                             codec=comp.get("type"))
+            print(f"{self.po.node}: WAN policy epoch {cur} adopted -> "
+                  f"{comp.get('type')}", flush=True)
+        self.server.reply_cmd(msg, body={"epoch": cur})
+
     def _apply_compression_locked(self, body: dict, trust_init: bool = True):
         """Install a compression config (caller holds self._mu).
 
@@ -2378,19 +2744,21 @@ class GlobalServer:
             self.optimizer = make_optimizer(body)
             self._optimizer_configured = True
         elif msg.cmd == Ctrl.SET_COMPRESSION:
-            from geomx_tpu.compression import make_push_codec
+            from geomx_tpu.compression import (compression_allowed,
+                                               make_push_codec)
 
             try:
                 make_push_codec(body)  # validate
             except ValueError as e:
                 self.server.reply_cmd(msg, body={"error": str(e)})
                 return
-            if (self.ts_inter is not None
-                    and body.get("type") in ("bsc", "mpq")):
-                self.server.reply_cmd(msg, body={
-                    "error": "bsc/mpq pull compression cannot combine with "
-                             "inter-TS dissemination (per-subscriber deltas "
-                             "don't fit a shared relay payload)"})
+            # hfa=False for the same reason as the local-server gate:
+            # static HFA+bsc is the dense-bypass case
+            ok, why = compression_allowed(
+                body.get("type", "none"),
+                inter_ts=self.ts_inter is not None)
+            if not ok:
+                self.server.reply_cmd(msg, body={"error": why})
                 return
             with self._mu:
                 if body == self.compression:
@@ -2400,6 +2768,9 @@ class GlobalServer:
                     self.server.reply_cmd(msg)
                     return
                 self._apply_compression_locked(body)
+        elif msg.cmd == Ctrl.SET_WAN_POLICY:
+            self._on_set_wan_policy(msg, body)
+            return
         elif msg.cmd == Ctrl.SET_SYNC_GLOBAL_MODE:
             if self.ts_inter is not None and bool(body["sync"]) != self.sync_mode:
                 # local servers key their round-completion path off the
@@ -2447,6 +2818,10 @@ class GlobalServer:
                 "party_folds": self.party_folds,
                 "party_unfolds": self.party_unfolds,
                 "num_global_workers": self.num_contributors,
+                # adaptive WAN: receiver-side epoch + fence observables
+                "policy_epoch": self._policy_epoch,
+                "policy_fenced_pushes": self.policy_fenced_pushes,
+                "rejected_compr_tags": self.rejected_compr_tags,
             })
             return
         elif msg.cmd == Ctrl.LIST_KEYS:

@@ -118,6 +118,17 @@ class Simulation:
                     self.offices[str(self.topology.scheduler(p))]))
             self.recovery_monitor = LocalServerRecoveryMonitor(
                 self.offices[str(self.topology.global_scheduler())])
+        # adaptive WAN control plane (geomx_tpu/control): closed-loop
+        # codec/ratio retuning on the global scheduler.  With
+        # adapt_interval_s == 0 no sweep thread runs — tests drive
+        # wan_controller.tick() deterministically.
+        self.wan_controller = None
+        if config.adaptive_wan:
+            from geomx_tpu.control import AdaptiveWanController
+
+            self.wan_controller = AdaptiveWanController(
+                self.offices[str(self.topology.global_scheduler())],
+                config, collector=self.trace_collector)
 
     def _attach_tracer(self, po: Postoffice, fresh: bool = False) -> None:
         """Bind the node's tracer to its (possibly replacement)
@@ -252,6 +263,19 @@ class Simulation:
         self._attach_tracer(po)
         return ls
 
+    def set_wan_policy(self, compression: dict,
+                       reason: str = "manual override") -> dict:
+        """Manual override of the adaptive WAN policy: broadcast
+        ``compression`` (e.g. ``{"type": "2bit"}``) under a fresh epoch
+        through the same two-phase, fence-checked protocol the
+        controller's automatic decisions use.  Requires
+        ``Config.adaptive_wan``."""
+        assert self.wan_controller is not None, \
+            "adaptive WAN off: set Config.adaptive_wan"
+        d = self.wan_controller.set_policy(compression, reason=reason)
+        return {"epoch": self.wan_controller.epoch,
+                "compression": d.compression}
+
     def wan_bytes(self) -> dict:
         """Total WAN traffic (tier-2 links) across the deployment."""
         send = sum(ls.po.van.wan_send_bytes for ls in self.local_servers)
@@ -261,6 +285,8 @@ class Simulation:
         return {"wan_send_bytes": send, "wan_recv_bytes": recv}
 
     def shutdown(self):
+        if self.wan_controller is not None:
+            self.wan_controller.stop()
         if self.trace_collector is not None:
             self.trace_collector.stop()
         if self.failover_monitor is not None:

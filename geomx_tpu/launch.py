@@ -137,6 +137,15 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
         from geomx_tpu.kvstore.eviction import LocalServerRecoveryMonitor
 
         role_obj = role_obj or LocalServerRecoveryMonitor(po)
+    if node.role is Role.GLOBAL_SCHEDULER and config.adaptive_wan:
+        # closed-loop WAN codec autotuning (geomx_tpu/control): the
+        # controller samples server stats + the trace report and
+        # broadcasts epoch-fenced SET_WAN_POLICY down both tiers
+        from geomx_tpu.control import AdaptiveWanController
+
+        po.wan_controller = AdaptiveWanController(
+            po, config, collector=po.trace_collector)
+        role_obj = role_obj or po.wan_controller
     if (node.role is Role.GLOBAL_SCHEDULER
             and config.topology.num_standby_globals
             and config.heartbeat_interval_s > 0):
@@ -546,6 +555,12 @@ def main(argv=None):
                          "+ critical-path report to --trace-dir")
     ap.add_argument("--trace-dir",
                     default=os.environ.get("GEOMX_TRACE_DIR", ""))
+    ap.add_argument("--adaptive-wan", action="store_true",
+                    help="closed-loop WAN codec autotuning: a controller "
+                         "on the global scheduler retunes compression "
+                         "mid-training via epoch-fenced SET_WAN_POLICY "
+                         "broadcasts (GEOMX_ADAPT_* tune the loop; see "
+                         "docs/adaptive-wan.md)")
     ap.add_argument("--optimizer", default="adam",
                     choices=["sgd", "adam", "dcasgd"])
     args = ap.parse_args(argv)
@@ -601,6 +616,7 @@ def main(argv=None):
     cfg.trace_sample_every = (args.trace_sample_every
                               or cfg.trace_sample_every)
     cfg.trace_dir = args.trace_dir or cfg.trace_dir
+    cfg.adaptive_wan = args.adaptive_wan or cfg.adaptive_wan
     # CLI overrides bypass dataclass construction — re-run the invariant
     # checks so invalid combinations fail here, not as a runtime hang
     cfg.__post_init__()

@@ -162,6 +162,13 @@ class KVWorker(_App):
         # inbound-request hook (TSEngine overlay relays arrive at workers
         # as data requests, ref: TS_Process kv_app.h:1111-1179)
         self.ts_handler: Optional[Callable[[Message], None]] = None
+        # error-response hook: sees every response whose body carries an
+        # "error" BEFORE it lands in self.errors; return True to claim it
+        # (the response still counts toward completion — claiming only
+        # suppresses the errors-list entry).  The adaptive-WAN local
+        # server uses this to turn policy-fence replies into a re-encode
+        # + retry instead of a surfaced failure.
+        self.error_handler: Optional[Callable[[Message], bool]] = None
         # DGT chunking applies on the WAN domain when enabled
         # (ref: KVServer::Send DGT branch kv_app.h:917-995)
         self.dgt_sender = None
@@ -439,8 +446,10 @@ class KVWorker(_App):
         if not self._on_response_tracked(msg):
             return  # duplicate response caused by a replayed request
         if isinstance(msg.body, dict) and "error" in msg.body:
-            with self._mu:
-                self.errors.append(str(msg.body["error"]))
+            h = self.error_handler
+            if h is None or not h(msg):
+                with self._mu:
+                    self.errors.append(str(msg.body["error"]))
         ts = msg.timestamp
         if msg.keys is not None and msg.vals is not None:
             # pull (or push_pull) response carrying data

@@ -146,6 +146,9 @@ class DgtSender:
                 # orphan the round's causal chain
                 trace_id=msg.trace_id, span_id=msg.span_id,
                 parent_span_id=msg.parent_span_id, sampled=msg.sampled,
+                # every chunk carries the WAN-policy epoch too: the
+                # reassembled push must fence like an unsplit one
+                policy_epoch=msg.policy_epoch,
             )
             if chunk_body is not None:
                 chunk.body = chunk_body
@@ -243,6 +246,7 @@ class DgtReassembler:
             # them; the completion chunk always does)
             trace_id=final.trace_id, span_id=final.span_id,
             parent_span_id=final.parent_span_id, sampled=final.sampled,
+            policy_epoch=final.policy_epoch,
             # the reassembly buffer is freshly allocated and exclusively
             # ours — the receiving server may adopt it as its accumulator
             donated=True,

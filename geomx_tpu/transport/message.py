@@ -128,6 +128,13 @@ class Message:
     # misclassified as replays of its predecessor's (advisor r1)
     boot: int = 0
 
+    # adaptive-WAN policy epoch (geomx_tpu/control): 0 = no policy /
+    # adaptive off.  WAN gradient pushes carry the sender's current
+    # epoch; a receiver on a different epoch fences the payload with a
+    # retryable error instead of decoding it under the wrong codec
+    # parameters (see docs/adaptive-wan.md).
+    policy_epoch: int = 0
+
     # distributed-tracing context (geomx_tpu/trace): 0/False = untraced.
     # ``span_id`` identifies THIS message on the timeline; receivers use
     # it as the parent of their handler spans, so the cross-node chain
@@ -180,6 +187,9 @@ class Message:
             # holds on the return path (pull-downs / piggybacked values
             # contend on the server's uplink too)
             priority=self.priority,
+            # ...and the request's policy epoch, so a fence reply is
+            # attributable to the exact epoch that was refused
+            policy_epoch=self.policy_epoch,
             # request→response trace correlation: the response joins the
             # request's trace as a child of the request MESSAGE (span_id
             # itself is assigned fresh at send time)
@@ -191,7 +201,7 @@ class Message:
         return Message(**kw)
 
     # ---- binary serialization (for the TCP van) -----------------------------
-    _HDR = struct.Struct("<B B i i q B B B i i q q q q q B q q q q q q")
+    _HDR = struct.Struct("<B B i i q B B B i i q q q q q B q q q q q q q")
 
     def to_bytes(self) -> bytes:
         buf = io.BytesIO()
@@ -218,6 +228,7 @@ class Message:
             self.first_key, self.seq, self.seq_begin, self.seq_end,
             self.total_bytes, self.channel, self.val_bytes, self.msg_sig,
             self.boot, self.trace_id, self.span_id, self.parent_span_id,
+            self.policy_epoch,
         )
         buf.write(struct.pack("<i", len(hdr)))
         buf.write(hdr)
@@ -233,7 +244,8 @@ class Message:
         fields = cls._HDR.unpack_from(data, off); off += hlen
         (control, domain, app_id, customer_id, timestamp, flags, _, _, cmd,
          priority, first_key, seq, seq_begin, seq_end, total_bytes, channel,
-         val_bytes, msg_sig, boot, trace_id, span_id, parent_span_id) = fields
+         val_bytes, msg_sig, boot, trace_id, span_id, parent_span_id,
+         policy_epoch) = fields
         blobs = []
         for _ in range(4):
             (blen,) = struct.unpack_from("<q", data, off); off += 8
@@ -256,6 +268,7 @@ class Message:
             first_key=first_key, seq=seq, seq_begin=seq_begin, seq_end=seq_end,
             channel=channel, total_bytes=total_bytes, val_bytes=val_bytes,
             compr=meta["compr"], msg_sig=msg_sig, boot=boot,
+            policy_epoch=policy_epoch,
             trace_id=trace_id, span_id=span_id,
             parent_span_id=parent_span_id, sampled=bool(flags & 8),
             donated=True,  # deserialized buffers are exclusively ours

@@ -1,0 +1,442 @@
+"""Adaptive WAN control plane (PR 4 tentpole): closed-loop codec
+retuning over the HiPS tree — signal estimators, hysteresis policy,
+and the epoch-fenced SET_WAN_POLICY reconfiguration protocol — plus the
+codec-layer satellites (per-endpoint decoder state, unknown-tag fencing,
+the shared compatibility predicate).
+
+Fast tests are tier-1 (in-proc fabric, manual controller ticks via
+``adapt_interval_s=0``); the throttled-bandwidth e2e with loss parity
+against an uninterrupted static-BSC control is marked slow.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from geomx_tpu.core.config import Config, Topology
+from geomx_tpu.kvstore import Simulation
+from geomx_tpu.kvstore.common import Cmd, Ctrl
+from geomx_tpu.utils.metrics import system_snapshot
+
+
+def _cfg(parties=2, workers=1, **kw):
+    kw.setdefault("adaptive_wan", True)
+    kw.setdefault("adapt_interval_s", 0.0)  # manual tick (deterministic)
+    return Config(topology=Topology(num_parties=parties,
+                                    workers_per_party=workers), **kw)
+
+
+def _round(ws, g, tid=0):
+    for w in ws:
+        w.push(tid, g)
+    outs = [w.pull_sync(tid) for w in ws]
+    for w in ws:
+        w.wait_all()
+    return outs
+
+
+# --------------------------------------------------------------------------
+# tentpole: closed loop + epoch protocol
+# --------------------------------------------------------------------------
+
+def test_controller_downshifts_and_both_tiers_adopt():
+    """The whole loop: an impossible round budget drives the engine down
+    the ladder; every decision is broadcast under a fresh epoch, adopted
+    by the global tier immediately and by the local servers at their
+    next round boundary; the decisions are visible in the metrics
+    registry; and training stays correct throughout."""
+    base = system_snapshot()
+    sim = Simulation(_cfg(adapt_round_budget_s=1e-4, adapt_cooldown_s=0.0))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(1000, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        g = np.ones(1000, np.float32)
+        for _ in range(8):
+            outs = _round(ws, g)
+            sim.wan_controller.tick()
+        st = sim.wan_controller.status()
+        assert st["epoch"] >= 1, "controller never actuated"
+        assert st["compression"]["type"] != "none", "never left vanilla"
+        # both tiers converged to the controller's epoch
+        for ls in sim.local_servers:
+            assert ls._policy_epoch == st["epoch"]
+            assert ls.compression["type"] == st["compression"]["type"]
+        assert sim.global_servers[0]._policy_epoch == st["epoch"]
+        # correctness through the switches: replicas identical and exact
+        # (sum grads = 2, /2 contributors, lr 1 → -1 per round)
+        np.testing.assert_allclose(outs[0], outs[1])
+        assert np.isfinite(outs[0]).all()
+        # decisions are in the registry (gauge + per-action counters)
+        snap = system_snapshot()
+        assert snap.get("global_scheduler:0.wan_policy_epoch") == st["epoch"]
+        assert (snap.get("global_scheduler:0.wan_policy_downshifts", 0)
+                - base.get("global_scheduler:0.wan_policy_downshifts", 0)) >= 1
+    finally:
+        sim.shutdown()
+
+
+def test_old_epoch_push_fenced_then_retried_no_corrupt_merge():
+    """The epoch fence end-to-end: the receiver adopts a policy the
+    senders have not heard of; their next push (old epoch) is rejected
+    with a retryable error, the fence reply's policy is adopted, the
+    stashed raw gradients are re-encoded and retried, and the round
+    completes with EXACT values — no corrupted merge, no wedge."""
+    sim = Simulation(_cfg())
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(1000, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        g = np.ones(1000, np.float32)
+        _round(ws, g)  # round 1: everyone at epoch 0
+        # push the policy to the RECEIVER only — the broadcast the
+        # senders would normally get is "lost"
+        gs_node = sim.topology.global_servers()[0]
+        reply = sim.wan_controller._app.rpc(
+            gs_node, Ctrl.SET_WAN_POLICY,
+            body={"epoch": 7, "compression": {"type": "fp16"}})
+        assert reply == {"epoch": 7}
+        outs = _round(ws, g)  # round 2: fenced → adopt → retry
+        gs = sim.global_servers[0]
+        assert gs.policy_fenced_pushes >= 2  # both parties fenced once
+        for ls in sim.local_servers:
+            assert ls.policy_fence_retries >= 1
+            assert ls.policy_drops == 0
+            assert ls._policy_epoch == 7
+            assert ls.compression["type"] == "fp16"
+        # exact math survived the fence+retry: two rounds of mean grad 1
+        # at lr 1 → weights exactly -2 (fp16-exact values)
+        np.testing.assert_allclose(outs[0], -2.0)
+        np.testing.assert_allclose(outs[0], outs[1])
+    finally:
+        sim.shutdown()
+
+
+def test_manual_override_via_simulation():
+    """Simulation.set_wan_policy drives the same epoch protocol as
+    automatic decisions (and refuses constraint-violating codecs)."""
+    sim = Simulation(_cfg())
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(64, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        _round(ws, np.ones(64, np.float32))
+        info = sim.set_wan_policy({"type": "2bit", "threshold": 0.5})
+        assert info["epoch"] == 1
+        assert info["compression"]["type"] == "2bit"
+        outs = _round(ws, np.ones(64, np.float32))
+        # 2bit emits ±threshold: grads 1 → +0.5 each, mean 0.5; weights
+        # moved by exactly lr*0.5 past the first (vanilla) round
+        np.testing.assert_allclose(outs[0], -1.5)
+        assert sim.global_servers[0]._policy_epoch == 1
+    finally:
+        sim.shutdown()
+
+
+def test_hysteresis_deadband_and_cooldown_bound_decisions():
+    """Engine unit test on a fake clock: an oscillating signal inside
+    the patience window produces ZERO decisions, and a sustained
+    over-budget signal produces at most one decision per cooldown."""
+    from geomx_tpu.control.policy import WanPolicyEngine
+    from geomx_tpu.control.signals import WanSignals
+
+    now = [0.0]
+    clock = lambda: now[0]  # noqa: E731
+
+    def sig(rt):
+        return WanSignals(t=now[0], round_time_s=rt, goodput_bps=None,
+                          wan_bytes_rate={}, rtt_s=None,
+                          dominant_stage=None, straggler_party=None,
+                          rounds_total=0)
+
+    # oscillation: alternating over/under never reaches patience=2
+    eng = WanPolicyEngine({"type": "none"}, budget_s=1.0, deadband=0.2,
+                          cooldown_s=5.0, patience=2, clock=clock)
+    for i in range(50):
+        now[0] += 1.0
+        d = eng.observe(sig(3.0 if i % 2 == 0 else 0.1))
+        assert d is None, "oscillation broke the hysteresis"
+    assert eng.decisions == []
+
+    # sustained overload: decisions rate-limited by the cooldown
+    eng = WanPolicyEngine({"type": "none"}, budget_s=1.0, deadband=0.2,
+                          cooldown_s=10.0, patience=2, clock=clock)
+    now[0] = 0.0
+    for _ in range(40):  # 40 "seconds" of overload
+        now[0] += 1.0
+        eng.observe(sig(5.0))
+    # at most ceil(40/10) + the initial free shift
+    assert 1 <= len(eng.decisions) <= 5
+    for a, b in zip(eng.decisions, eng.decisions[1:]):
+        assert a.compression != b.compression  # monotone down the ladder
+
+    # compute-bound veto: WAN compression can't fix a merge bottleneck
+    eng = WanPolicyEngine({"type": "none"}, budget_s=1.0, deadband=0.2,
+                          cooldown_s=0.0, patience=1, clock=clock)
+    s = sig(5.0)
+    s.dominant_stage = "global_merge"
+    for _ in range(5):
+        now[0] += 1.0
+        assert eng.observe(s) is None
+    assert eng.vetoes == 5
+    assert eng.decisions == []
+
+
+def test_ladder_constraint_gating_under_ts_and_hfa():
+    """The policy ladder is filtered by the SAME predicate as config
+    validation: no bsc/mpq under the inter-party TS overlay, only
+    weight-safe codecs under HFA; and runtime overrides that violate
+    the constraints are refused end-to-end."""
+    from geomx_tpu.control.policy import build_ladder
+
+    plain = [r["type"] for r in build_ladder({"type": "none"})]
+    assert plain == ["none", "fp16", "bsc", "bsc", "2bit"]
+    ts = [r["type"] for r in build_ladder({"type": "none"}, inter_ts=True)]
+    assert "bsc" not in ts and "mpq" not in ts and "2bit" in ts
+    hfa = [r["type"] for r in build_ladder({"type": "none"}, hfa=True)]
+    assert hfa == ["none", "fp16"]
+    # MPQ base → size-bound retuning rungs
+    mpq = build_ladder({"type": "mpq", "size_bound": 160_000})
+    bounds = [r["size_bound"] for r in mpq if r["type"] == "mpq"]
+    assert bounds == [160_000, 40_000, 10_000]
+
+    # end-to-end: a manual bsc override under HFA is refused before any
+    # broadcast happens
+    sim = Simulation(_cfg(parties=1, workers=1, use_hfa=True, hfa_k2=1))
+    try:
+        with pytest.raises(ValueError, match="weight-safe"):
+            sim.set_wan_policy({"type": "bsc"})
+        assert sim.wan_controller.epoch == 0
+    finally:
+        sim.shutdown()
+
+
+def test_disabled_path_is_one_flag_check():
+    """Default config: no controller, no stash, no epoch stamping, no
+    fence state — the acceptance bar's 'behavior unchanged' guard."""
+    sim = Simulation(Config(topology=Topology(num_parties=1,
+                                              workers_per_party=1)))
+    try:
+        assert sim.wan_controller is None
+        ls = sim.local_servers[0]
+        gs = sim.global_servers[0]
+        assert ls._adaptive is False and gs._adaptive is False
+        # the stash only exists when the feature is on
+        assert not hasattr(ls, "_policy_stash")
+        assert ls.up.error_handler is None
+        # capture the actual wire traffic of one round
+        seen = []
+        orig = sim.fabric.deliver
+        sim.fabric.deliver = lambda m: (seen.append(m), orig(m))[1]
+        w = sim.worker(0, 0)
+        w.init(0, np.zeros(32, np.float32))
+        w.set_optimizer({"type": "sgd", "lr": 0.1})
+        w.push(0, np.ones(32, np.float32))
+        w.pull_sync(0)
+        w.wait_all()
+        assert seen, "tap saw no traffic"
+        assert all(m.policy_epoch == 0 for m in seen)
+        assert gs.policy_fenced_pushes == 0
+        assert gs._policy_epoch == 0 and ls._policy_epoch == 0
+    finally:
+        sim.shutdown()
+
+
+# --------------------------------------------------------------------------
+# satellites: codec-layer fixes
+# --------------------------------------------------------------------------
+
+def test_twobit_decoder_state_is_per_endpoint():
+    """Two concurrent Simulations with different 2-bit thresholds must
+    not share decoder state (the old module-level cache did): each
+    global server decodes with its OWN threshold, exactly."""
+    sims = {
+        0.25: Simulation(Config(topology=Topology())),
+        0.75: Simulation(Config(topology=Topology())),
+    }
+    try:
+        for thr, sim in sims.items():
+            w = sim.worker(0, 0)
+            w.init(0, np.zeros(64, np.float32))
+            w.set_optimizer({"type": "sgd", "lr": 1.0})
+            w.set_gradient_compression({"type": "2bit", "threshold": thr})
+        # interleave the rounds so both decoders are live simultaneously
+        for thr, sim in sims.items():
+            sim.worker(0, 0).push(0, np.ones(64, np.float32))
+        for thr, sim in sims.items():
+            w = sim.worker(0, 0)
+            out = w.pull_sync(0)
+            w.wait_all()
+            # grad 1 > thr → emit +thr; lr 1 → weights exactly -thr
+            np.testing.assert_allclose(out, -thr)
+        banks = [sim.global_servers[0]._decoders for sim in sims.values()]
+        assert banks[0] is not banks[1]
+    finally:
+        for sim in sims.values():
+            sim.shutdown()
+
+
+def test_decoder_bank_bounded():
+    from geomx_tpu.compression import DecoderBank
+
+    bank = DecoderBank(cap=8)
+    for i in range(100):
+        bank.twobit(float(i))
+    assert len(bank._decoders) <= 8
+    # LRU: the most recent threshold survives and is reused
+    d = bank.twobit(99.0)
+    assert bank.twobit(99.0) is d
+
+
+def test_unknown_compr_tag_fenced_names_node_and_tag():
+    """A malformed/foreign compr tag is fenced at message-decode time
+    with an error naming the offender — it must never raise a bare
+    ValueError inside the merge or poison later rounds."""
+    from geomx_tpu.ps import KVPairs
+
+    sim = Simulation(Config(topology=Topology(num_parties=1,
+                                              workers_per_party=1)))
+    try:
+        w = sim.worker(0, 0)
+        w.init(0, np.zeros(64, np.float32))
+        w.set_optimizer({"type": "sgd", "lr": 1.0})
+        ls = sim.local_servers[0]
+        gs = sim.global_servers[0]
+        # forge a push with a garbage tag straight up the WAN link
+        ls.up.zpush(KVPairs(np.array([0], np.int64),
+                            np.ones(64, np.float32),
+                            np.array([64], np.int64)),
+                    cmd=Cmd.DEFAULT, compr="evil", wait=True)
+        assert gs.rejected_compr_tags == 1
+        errs = "; ".join(ls.up.errors)
+        assert "evil" in errs and "server:0@p0" in errs
+        # the merge was not poisoned: a normal round still works exactly
+        w.push(0, np.ones(64, np.float32))
+        np.testing.assert_allclose(w.pull_sync(0), -1.0)
+        w.wait_all()
+    finally:
+        sim.shutdown()
+
+
+def test_compression_allowed_full_matrix():
+    """The shared predicate, exhaustively (the same matrix config
+    validation, the runtime gates, and the ladder builder consume)."""
+    from geomx_tpu.compression import compression_allowed
+
+    matrix = {
+        # codec: (plain, inter_ts, hfa-runtime)
+        "none": (True, True, True),
+        "fp16": (True, True, True),
+        "2bit": (True, True, False),
+        "bsc":  (True, False, False),
+        "mpq":  (True, False, False),
+    }
+    for codec, (plain, ts, hfa) in matrix.items():
+        assert compression_allowed(codec)[0] is plain, codec
+        assert compression_allowed(codec, inter_ts=True)[0] is ts, codec
+        assert compression_allowed(codec, hfa=True)[0] is hfa, codec
+    ok, why = compression_allowed("garbage")
+    assert not ok and "unknown" in why
+    # config validation consumes it (inter_ts context)
+    with pytest.raises(ValueError, match="relay payload"):
+        Config(topology=Topology(), enable_inter_ts=True,
+               enable_intra_ts=True, compression="bsc")
+
+
+def test_policy_epoch_survives_wire_roundtrip():
+    from geomx_tpu.transport.message import Message
+
+    m = Message(keys=np.array([1], np.int64),
+                vals=np.ones(4, np.float32),
+                lens=np.array([4], np.int64),
+                push=True, request=True, policy_epoch=42)
+    back = Message.from_bytes(m.to_bytes())
+    assert back.policy_epoch == 42
+    assert back.reply_to().policy_epoch == 42
+
+
+# --------------------------------------------------------------------------
+# slow e2e: throttled WAN → downshift within K rounds → wall-time
+# recovery + loss parity vs an uninterrupted static-BSC control
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_throttled_wan_downshift_recovers_wall_time_with_loss_parity():
+    from geomx_tpu.transport.van import FaultPolicy
+
+    N = 200_000
+    LR = 0.1
+    rng = np.random.default_rng(0)
+    target = rng.standard_normal(N).astype(np.float32)
+
+    def train(sim, rounds, throttle_at=None, throttle_bps=None,
+              tick=False):
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(N, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": LR})
+        walls, losses = [], []
+        w_hat = np.zeros(N, np.float32)
+        for r in range(rounds):
+            if throttle_at is not None and r == throttle_at:
+                sim.fabric.fault.wan_bandwidth_bps = throttle_bps
+            t0 = time.perf_counter()
+            grads = [w_hat - target for _ in ws]  # same shard both
+            for w, g in zip(ws, grads):
+                w.push(0, g.astype(np.float32))
+            outs = [w.pull_sync(0) for w in ws]
+            for w in ws:
+                w.wait_all()
+            w_hat = outs[0]
+            walls.append(time.perf_counter() - t0)
+            losses.append(float(np.mean((w_hat - target) ** 2)))
+            if tick:
+                sim.wan_controller.tick()
+        return walls, losses
+
+    ROUNDS, THROTTLE_AT = 16, 4
+    BPS = 4e6  # ~0.2 s per dense 800 KB push → dense rounds blow budget
+
+    # adaptive run: starts vanilla, bandwidth collapses mid-run.  The
+    # 1 s cooldown is load-bearing: it makes the engine observe each
+    # tier's STEADY state (bsc's first pull is a one-time dense resync)
+    # instead of overshooting down the ladder on transients.
+    fault = FaultPolicy(wan_bandwidth_bps=1e12)  # send threads on
+    sim = Simulation(_cfg(adapt_round_budget_s=0.15, adapt_cooldown_s=1.0,
+                          adapt_window=3), fault=fault)
+    try:
+        walls_a, losses_a = train(sim, ROUNDS, throttle_at=THROTTLE_AT,
+                                  throttle_bps=BPS, tick=True)
+        st = sim.wan_controller.status()
+    finally:
+        sim.shutdown()
+    assert st["epoch"] >= 1, "controller never downshifted"
+    assert st["compression"]["type"] in ("fp16", "bsc", "2bit")
+    # wall-time recovery: the last rounds run at a fraction of the worst
+    # throttled-dense round AND inside the budget band the controller
+    # was asked to hold
+    worst = max(walls_a[THROTTLE_AT:THROTTLE_AT + 3])
+    steady = float(np.median(walls_a[-3:]))
+    assert steady < worst * 0.5, (worst, steady, walls_a)
+    assert steady < 0.15 * 1.5, (steady, walls_a)
+
+    # control: uninterrupted static BSC, full bandwidth, same rounds
+    sim = Simulation(Config(topology=Topology(num_parties=2,
+                                              workers_per_party=1)))
+    try:
+        ws = sim.all_workers()
+        for p in range(2):
+            sim.worker(p, 0).set_gradient_compression(
+                {"type": "bsc", "ratio": 0.01})
+        _, losses_c = train(sim, ROUNDS)
+    finally:
+        sim.shutdown()
+    # loss parity: both descended, and the adaptive run's final loss is
+    # within tolerance of the static control's
+    assert losses_a[-1] < losses_a[0] * 0.9
+    assert losses_a[-1] <= losses_c[-1] * 1.5 + 1e-3, (
+        losses_a[-1], losses_c[-1])
