@@ -91,6 +91,49 @@ BENCH_FLOCK_PATH = ROOT / ".bench.lock"
 _allow_lkg = True        # cleared by --skip-tpu: a CPU-only record must
 #                          stay a pure function of the flags
 
+# Short-TTL tunnel-probe verdict stamp, shared across bench invocations
+# (the round's live bench, the watcher's capture passes, reruns): a
+# dead tunnel used to cost EVERY run the full 2 x 120 s probe timeout
+# (BENCH_r05 errors.probe/errors.tpu) — now only the first run in the
+# TTL window pays it.  Distinct from the LKG result cache above: this
+# caches LIVENESS, expires fast, and honors a GEOMX_FORCE_PROBE
+# override ("fresh" re-probes regardless, "dead"/"skip" forces the
+# dead verdict — the GEOMX_FORCE_ACCUM pattern).
+PROBE_STAMP_PATH = Path(os.environ.get("GEOMX_PROBE_STAMP",
+                                       "/tmp/geomx_probe.json"))
+PROBE_STAMP_TTL_S = float(os.environ.get("GEOMX_PROBE_TTL_S", "900"))
+
+
+def _cached_probe_verdict():
+    """Returns {"verdict": "alive"|"dead", "result": ..., "source": ...}
+    or None when the probe must run for real."""
+    force = os.environ.get("GEOMX_FORCE_PROBE", "").strip().lower()
+    if force in ("fresh", "probe", "live"):
+        return None
+    if force in ("dead", "skip"):
+        return {"verdict": "dead", "result": None,
+                "source": f"GEOMX_FORCE_PROBE={force}"}
+    try:
+        st = json.loads(PROBE_STAMP_PATH.read_text())
+        age = time.time() - float(st.get("at", 0))
+        if 0 <= age <= PROBE_STAMP_TTL_S and st.get("verdict"):
+            return {"verdict": st["verdict"], "result": st.get("result"),
+                    "source": f"{PROBE_STAMP_PATH} ({age:.0f}s old)"}
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def _write_probe_stamp(verdict: str, result=None):
+    try:
+        tmp = PROBE_STAMP_PATH.with_suffix(f".{os.getpid()}.tmp")
+        tmp.write_text(json.dumps({"verdict": verdict, "result": result,
+                                   "at": time.time(),
+                                   "commit": _git_head()}))
+        tmp.replace(PROBE_STAMP_PATH)
+    except OSError:
+        pass  # the stamp is an optimization; never fail the bench on it
+
 
 def _git_head() -> str:
     try:
@@ -718,6 +761,130 @@ def child_probe():
         "device": str(dev),
         "init_s": round(init_s, 1),
         "dispatch_s": round(time.perf_counter() - t1, 2),
+    }))
+
+
+def child_serde():
+    """Wire-format + sharded-merge microbench (CPU, in-proc).
+
+    Measures BOTH wire formats in one run — v2 (raw header +
+    np.frombuffer views, scatter-gather frames) vs the legacy v1
+    np.save path — and the aggregate push throughput of the key-sharded
+    server merge at 8 concurrent pushers, sharded vs single-lock, with
+    a bit-identical-sum check (integer-valued gradients make float
+    accumulation exact, so any order is the same sum)."""
+    import threading as _th
+
+    import numpy as np
+
+    from geomx_tpu.core.config import Config, NodeId, Role, Topology
+    from geomx_tpu.kvstore import Simulation
+    from geomx_tpu.kvstore.common import Cmd
+    from geomx_tpu.ps.kv_app import KVPairs
+    from geomx_tpu.transport.message import Message
+
+    # ---- serde: encode/decode MB/s, v1 vs v2 ----------------------------
+    n = int(os.environ.get("BENCH_SERDE_ELEMS", str(8 << 20)))  # 32 MB f32
+    rng = np.random.default_rng(0)
+    vals = rng.standard_normal(n).astype(np.float32)
+    msg = Message(sender=NodeId(Role.SERVER, 0, 0),
+                  recipient=NodeId(Role.GLOBAL_SERVER, 0),
+                  keys=np.array([0], np.int64), vals=vals,
+                  lens=np.array([n], np.int64), push=True, request=True)
+    mb = vals.nbytes / 1e6
+    reps = 5
+
+    def best(fn):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    raw1 = msg.to_bytes_v1()
+    raw2 = bytearray(b"".join(bytes(f) for f in msg.to_frames()))
+    t_enc1 = best(msg.to_bytes_v1)
+    t_enc2 = best(msg.to_bytes)        # includes the one join copy
+    t_frames = best(msg.to_frames)     # the TCP scatter-gather path
+    t_dec1 = best(lambda: Message.from_bytes(raw1))
+    t_dec2 = best(lambda: Message.from_bytes(raw2))
+    decoded = Message.from_bytes(raw2)
+    zero_copy_ok = bool(
+        np.shares_memory(decoded.vals, np.frombuffer(raw2, np.uint8))
+        and decoded.vals.flags.writeable)
+
+    # ---- sharded merge: 8 pushers, disjoint + shared keys ---------------
+    def push_throughput(shards: int, pushers: int = 8, pushes: int = 16,
+                        elems: int = 1 << 18):
+        cfg = Config(topology=Topology(num_parties=1,
+                                       workers_per_party=pushers),
+                     server_shards=shards)
+        sim = Simulation(cfg)
+        try:
+            ls = sim.local_servers[0]
+            # rounds must never complete (pure merge throughput, no WAN
+            # round side effects): raise the aggregation target out of
+            # reach for the bench's push count, and drop the acks on
+            # the floor — we measure the merge, not reply routing
+            ls._workers_target = 1 << 30
+            ls.server.response = lambda *a, **k: None
+            grads = [np.full(elems, float(i + 1), np.float32)
+                     for i in range(pushers)]
+            workers = sim.topology.workers(0)
+
+            def pusher(i):
+                for t in range(pushes):
+                    k = i  # disjoint: one key per pusher
+                    m = Message(sender=workers[i], recipient=ls.po.node,
+                                push=True, request=True, timestamp=t,
+                                cmd=Cmd.DEFAULT,
+                                keys=np.array([k], np.int64),
+                                vals=grads[i],
+                                lens=np.array([elems], np.int64))
+                    ls._handle_push(m, KVPairs(m.keys, m.vals, m.lens))
+
+            threads = [_th.Thread(target=pusher, args=(i,))
+                       for i in range(pushers)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            ls._shards.drain()
+            wall = time.perf_counter() - t0
+            sums = {int(k): float(st.accum.sum())
+                    for k, st in ls._keys.items() if st.accum is not None}
+            return wall, sums
+        finally:
+            sim.shutdown()
+
+    t_single, sums_single = push_throughput(shards=1)
+    t_sharded, sums_sharded = push_throughput(shards=8)
+    print(json.dumps({
+        "elems": n,
+        "encode_MBps": {"v1_npsave": round(mb / t_enc1, 1),
+                        "v2": round(mb / t_enc2, 1),
+                        "v2_frames": round(mb / t_frames, 1)},
+        "decode_MBps": {"v1_npsave": round(mb / t_dec1, 1),
+                        "v2": round(mb / t_dec2, 1)},
+        "speedup_encode": round(t_enc1 / t_enc2, 2),
+        "speedup_decode": round(t_dec1 / t_dec2, 2),
+        # one full hop, old vs new: v1 encode+decode vs v2 frames+decode
+        # (the actual TCP path — scatter-gather out, frombuffer in)
+        "speedup_roundtrip": round((t_enc1 + t_dec1)
+                                   / (t_frames + t_dec2), 2),
+        "zero_copy_ok": zero_copy_ok,
+        "merge_scaling": {
+            "pushers": 8,
+            "single_lock_s": round(t_single, 3),
+            "sharded_s": round(t_sharded, 3),
+            "scaling": round(t_single / t_sharded, 2),
+            "sums_bit_identical": sums_single == sums_sharded,
+            # scaling > 1 needs real cores: stripes beyond cpu_count
+            # only remove lock contention, not compute serialization
+            "cpus": os.cpu_count(),
+        },
     }))
 
 
@@ -1602,7 +1769,7 @@ def _build_record() -> dict:
                       ("flash_autotune", "flash_autotune"),
                       ("stress", "stress"), ("lm", "lm"),
                       ("scaling", "scaling"), ("parity", "parity"),
-                      ("probe", "probe")):
+                      ("serde", "serde"), ("probe", "probe")):
         if name in _results:
             record[key] = _results[name]
         elif name in TPU_CHILDREN and name in lkg:
@@ -1656,6 +1823,13 @@ def _compact(record: dict) -> dict:
     par = record.get("parity") or {}
     if par.get("worst_delta"):
         out["parity_worst_accuracy_delta"] = par["worst_delta"]
+    sd = record.get("serde") or {}
+    if sd.get("speedup_encode"):
+        out["serde_speedup"] = {"encode": sd["speedup_encode"],
+                                "decode": sd["speedup_decode"],
+                                "zero_copy": sd.get("zero_copy_ok"),
+                                "merge_scaling": (sd.get("merge_scaling")
+                                                  or {}).get("scaling")}
     if record.get("errors"):
         out["errors"] = {k: str(v)[:80] for k, v in
                          record["errors"].items()}
@@ -1803,7 +1977,8 @@ def main():
     ap.add_argument("--child",
                     choices=["cnn", "mfu", "mfu_sweep", "quant", "wan",
                              "overlap", "overlap_tpu", "stress", "probe",
-                             "flash_autotune", "lm", "scaling", "parity"])
+                             "flash_autotune", "lm", "scaling", "parity",
+                             "serde"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -1827,7 +2002,7 @@ def main():
          "quant": child_quant, "wan": child_wan, "overlap": child_overlap,
          "overlap_tpu": child_overlap_tpu, "stress": child_stress,
          "probe": child_probe, "lm": child_lm, "scaling": child_scaling,
-         "parity": child_parity,
+         "parity": child_parity, "serde": child_serde,
          "flash_autotune": child_flash_autotune}[args.child]()
         return
 
@@ -1865,7 +2040,17 @@ def main():
         if no_tpu is not None:
             print(json.dumps({"capture_lkg": no_tpu}))
             return
-        if locked_do("probe", 180):
+        cached = _cached_probe_verdict()
+        if cached is not None and cached["verdict"] == "dead":
+            print(json.dumps({"capture_lkg": "skipped: cached dead-"
+                              f"tunnel verdict ({cached['source']})"}))
+            return
+        probed = locked_do("probe", 180)
+        _write_probe_stamp(
+            "alive" if (probed and _results.get("probe", {})
+                        .get("platform") not in ("cpu", None)) else "dead",
+            _results.get("probe"))
+        if probed:
             platform = _results.get("probe", {}).get("platform")
             if platform not in ("cpu", None):
                 # exactness-first: quant (on-chip 2-bit round-trip
@@ -1900,6 +2085,7 @@ def main():
         # flagship metrics first: under a tight driver deadline the tail
         # children are the ones clipped
         _do("wan", 180, cpu_env)
+        _do("serde", 120, cpu_env)
         _do("lm", 210, cpu_env)
         _do("overlap", 150, cpu_env)
         # scaling's roofline is calibrated by the lm child's measured
@@ -1944,11 +2130,32 @@ def main():
         # cold backend init has been observed to exceed 75 s (VERDICT
         # r3), and a dead tunnel no longer forfeits the round's numbers
         # anyway — the LKG cache covers it — so probing harder is cheap
-        # relative to what a live window is worth.
-        ok = _do("probe", 120)
-        if not ok and _remaining() > 180:
-            time.sleep(15)
+        # relative to what a live window is worth.  A recent stamp from
+        # ANY bench invocation (watcher pass, rerun) skips the probe
+        # entirely — a dead tunnel costs the 2 x 120 s timeout once per
+        # TTL window, not per run (GEOMX_FORCE_PROBE=fresh overrides).
+        cached = _cached_probe_verdict()
+        if cached is not None:
+            ok = cached["verdict"] == "alive"
+            if ok and cached.get("result"):
+                with _lock:
+                    _results["probe"] = dict(cached["result"],
+                                             probe_cached=cached["source"])
+            else:
+                with _lock:
+                    _errors["probe"] = (
+                        f"skipped: cached dead-tunnel verdict "
+                        f"({cached['source']}; GEOMX_FORCE_PROBE=fresh "
+                        "re-probes)")
+        else:
             ok = _do("probe", 120)
+            if not ok and _remaining() > 180:
+                time.sleep(15)
+                ok = _do("probe", 120)
+            res = _results.get("probe")
+            alive = bool(ok and res
+                         and res.get("platform") not in ("cpu", None))
+            _write_probe_stamp("alive" if alive else "dead", res)
         platform = _results.get("probe", {}).get("platform")
         if ok and platform not in ("cpu", None):
             # tunnel alive: no retries/backoffs — the deadline governs

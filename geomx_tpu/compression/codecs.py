@@ -192,12 +192,18 @@ class BscCodec(Codec):
 
     def __init__(self, ratio: float = 0.01, momentum: float = 0.9,
                  sample_rate: float = 0.005, seed: int = 0):
+        import threading
+
         self.ratio = float(ratio)
         self.momentum = float(momentum)
         self.sample_rate = float(sample_rate)
         self._velocity: Dict[int, np.ndarray] = {}
         self._accum: Dict[int, np.ndarray] = {}
         self._rng = np.random.default_rng(seed)
+        # np.random.Generator is not thread-safe; the parallel WAN
+        # encode pool compresses different KEYS concurrently (per-key
+        # velocity/accum never collide) but they share this sampler
+        self._rng_mu = threading.Lock()
 
     def _threshold(self, arr: np.ndarray) -> float:
         """Sampled |.|-quantile threshold.  Takes the RAW array and
@@ -206,7 +212,8 @@ class BscCodec(Codec):
         path for values the sample never looks at."""
         n = len(arr)
         sample_n = max(int(n * self.sample_rate), min(n, 64))
-        idx = self._rng.integers(0, n, size=sample_n)
+        with self._rng_mu:
+            idx = self._rng.integers(0, n, size=sample_n)
         sample = np.abs(arr[idx])
         # top `ratio` of the sample ⇒ quantile threshold
         return float(np.quantile(sample, max(0.0, 1.0 - self.ratio)))
@@ -456,7 +463,16 @@ class BroadcastCompressor:
     @staticmethod
     def decompress_into(store_val: np.ndarray, payload: np.ndarray) -> np.ndarray:
         vals, idx = unpack_sparse(payload)
-        out = np.ascontiguousarray(store_val, dtype=np.float32).copy()
+        out = np.ascontiguousarray(store_val, dtype=np.float32)
+        if np.may_share_memory(out, store_val) or not out.flags.writeable:
+            # ascontiguousarray of an already-contiguous same-dtype
+            # input ALIASES it — copy only then (we mutate below and
+            # must not write the caller's replica), or when the dtype
+            # conversion produced a fresh-but-frozen array.  A
+            # non-contiguous or non-f32 input already paid its one
+            # conversion copy; the old unconditional .copy() stacked a
+            # second full-model copy on every subscriber pull.
+            out = out.copy()
         nlib = _native()
         if nlib is not None:
             nlib.geo_sparse_add(out, np.ascontiguousarray(vals),
@@ -547,25 +563,31 @@ class DecoderBank:
 
     def __init__(self, cap: int = 32):
         import collections
+        import threading
 
         self._cap = int(cap)
         self._decoders: "collections.OrderedDict" = collections.OrderedDict()
+        # the parallel decode pool hits one endpoint's bank from
+        # several threads; the LRU reorder needs real mutual exclusion
+        self._mu = threading.Lock()
 
     def twobit(self, threshold: float) -> TwoBitCodec:
         key = ("2bit", float(threshold))
-        dec = self._decoders.get(key)
-        if dec is None:
-            dec = self._decoders[key] = TwoBitCodec(threshold)
-        self._decoders.move_to_end(key)
-        while len(self._decoders) > self._cap:
-            self._decoders.popitem(last=False)
+        with self._mu:
+            dec = self._decoders.get(key)
+            if dec is None:
+                dec = self._decoders[key] = TwoBitCodec(threshold)
+            self._decoders.move_to_end(key)
+            while len(self._decoders) > self._cap:
+                self._decoders.popitem(last=False)
         return dec
 
     def clear(self) -> None:
         """Drop all decoder state (a policy-epoch switch installs fresh
         codec parameters; stale residual-bearing decoders must not
         outlive the epoch that created them)."""
-        self._decoders.clear()
+        with self._mu:
+            self._decoders.clear()
 
 
 def decompress_payload(compr: str, key: int, payload: np.ndarray,

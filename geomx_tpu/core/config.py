@@ -367,7 +367,19 @@ class Config:
     #                                INSIDE each merge (native axpy) so
     #                                the per-key state machines stay
     #                                single-writer (ref: engine-pool
-    #                                merge, kvstore_dist_server.h:1277-1296)
+    #                                merge, kvstore_dist_server.h:1277-1296).
+    #                                Also sizes the shared per-key codec
+    #                                pool (parallel WAN encode/decode)
+    server_shards: int = 0  # key-sharded server merge: per-key state
+    #                         splits into N lock stripes with N serial
+    #                         merge lanes, so concurrent pushes touching
+    #                         disjoint keys merge in parallel (0 = auto
+    #                         min(8, cpus); 1 = the single-lock server).
+    #                         Membership folds / eviction fences / round
+    #                         completion take an all-stripes barrier, so
+    #                         decide-under-lock semantics are unchanged.
+    #                         Deterministic mode forces 1 (see
+    #                         kvstore.common.resolve_server_shards)
     heartbeat_interval_s: float = 0.0   # 0 = off
     heartbeat_timeout_s: float = 10.0
     # --- crash-tolerant membership (heartbeat-driven ACTUATION; requires
@@ -460,6 +472,8 @@ class Config:
             raise ValueError("adapt_window must be >= 2")
         if self.replicate_every < 1:
             raise ValueError("replicate_every must be >= 1")
+        if self.server_shards < 0:
+            raise ValueError("server_shards must be >= 0 (0 = auto)")
         if self.trace_sample_every < 0:
             raise ValueError("trace_sample_every must be >= 0 (0 = off)")
         if self.trace_batch_events < 1:
@@ -540,6 +554,7 @@ class Config:
                 os.environ.get("MXNET_ENGINE_TYPE") == "NaiveEngine",
             ),
             server_merge_threads=_env_int("GEOMX_SERVER_MERGE_THREADS", 0),
+            server_shards=_env_int("GEOMX_SERVER_SHARDS", 0),
             heartbeat_interval_s=_env_float(
                 "GEOMX_HEARTBEAT_INTERVAL", _env_float("PS_HEARTBEAT_INTERVAL", 0.0)
             ),

@@ -36,12 +36,37 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from geomx_tpu.core.config import Config, Group, NodeId, Topology
-from geomx_tpu.kvstore.common import APP_PS, Cmd, Ctrl, RecentRequests
+from geomx_tpu.kvstore.common import (APP_PS, Cmd, Ctrl, RecentRequests,
+                                      ShardExecutor, StripedRLock,
+                                      codec_pool, resolve_server_shards)
 from geomx_tpu.native.bindings import accumulate as _native_accumulate
 from geomx_tpu.optim import DCASGD, ServerOptimizer, Sgd, make_optimizer
 from geomx_tpu.ps import KVPairs, KVServer, KVWorker, Postoffice
 from geomx_tpu.ps.postoffice import split_range
+from geomx_tpu.trace import context as _tctx
 from geomx_tpu.transport.message import Control, Domain, Message
+
+
+def _ctx_bound(fn):
+    """Carry the calling (handler) thread's trace context onto a merge
+    lane: a sampled round's merge spans — and the WAN push-up messages
+    the lane sends at round completion — must stay children of the
+    inbound push, or sharding would sever every cross-node chain.
+    Free when tracing is off (returns ``fn`` itself)."""
+    if not _tctx.ACTIVE:
+        return fn
+    ctx = _tctx.current()
+    if ctx is None:
+        return fn
+
+    def bound():
+        prev = _tctx.swap(ctx)
+        try:
+            fn()
+        finally:
+            _tctx.restore(prev)
+
+    return bound
 
 
 def _handle_profiler_cmd(po: Postoffice, msg: Message, server: KVServer):
@@ -88,7 +113,9 @@ def _store_payload(arrs: List[np.ndarray]) -> np.ndarray:
     # multi-key responses concatenate — the concat IS the isolation
     # copy, so the source arrays stay writeable (freezing them here
     # would buy nothing and force a COW copy on every later in-place
-    # decode of those keys)
+    # decode of those keys).  The sharded LocalServer assembles its
+    # multi-key responses per key under each stripe instead of calling
+    # this (same one-copy result, tear-safe without the big lock).
     return np.concatenate([np.asarray(a, np.float32) for a in arrs])
 
 
@@ -248,7 +275,18 @@ class LocalServer:
         self._warm_boot_busy = False
         self.store: Dict[int, np.ndarray] = {}
         self._keys: Dict[int, _KeyState] = {}
-        self._mu = threading.RLock()
+        # key-sharded server state: ``stripe(k)`` guards key k's merge /
+        # pull / store entry; ``with self._mu:`` is the all-stripes
+        # barrier every membership fold, fence, snapshot and config
+        # change takes — their decide-under-lock semantics (PR 1-2) are
+        # unchanged.  server_shards=1 (the deterministic default, and
+        # the auto default on 1-core hosts) collapses both to the old
+        # single server RLock with inline merges.
+        self._mu = StripedRLock(resolve_server_shards(self.config))
+        self._shards = ShardExecutor(self._mu.n,
+                                     name=f"merge-{postoffice.node}")
+        self._ctr_mu = threading.Lock()  # leaf lock for shared counters
+        #                                  bumped from parallel lanes
         from geomx_tpu.trace.recorder import get_tracer
         from geomx_tpu.utils import get_profiler
 
@@ -367,8 +405,7 @@ class LocalServer:
                 self._handle_push_row_sparse(msg, kvs)
         elif msg.cmd == Cmd.ROW_SPARSE_PULL:
             with prof.span("local.pull_rs"):
-                with self._mu:
-                    self._try_serve_pull_locked(msg)
+                self._try_serve_pull(msg)
         elif msg.cmd == Cmd.TS_AUTOPULL:
             with prof.span("local.ts_inter"):
                 self._on_inter_ts_delivery(msg, kvs)
@@ -389,6 +426,11 @@ class LocalServer:
                 self._handle_pull(msg, kvs)
 
     def _handle_init(self, msg: Message, kvs: KVPairs):
+        # program order vs. the sharded merge: an overwrite-INIT that
+        # arrived after earlier pushes must not be applied while those
+        # pushes still sit queued on merge lanes (they would merge into
+        # the restored state); quiesce the lanes first
+        self._shards.drain()
         # replay dedup: a replayed overwrite-init re-applied after
         # training resumed would silently revert the store (plain init
         # replay was idempotent; overwrite replay is destructive)
@@ -616,7 +658,16 @@ class LocalServer:
     def _fence_evicted_push(self, msg: Message, sender_s: str) -> bool:
         """Reject a push from an evicted identity (caller already passed
         the replay-dedup check, so pre-eviction pushes re-ack normally).
-        Returns True when the push was fenced and answered."""
+        Returns True when the push was fenced and answered.
+
+        Lock-free fast path: membership transitions are rare, dict
+        lookups are GIL-atomic, and a push racing an eviction lands as
+        if ordered before or after it either way — only a positive
+        sighting re-checks under the barrier (the all-stripes
+        acquisition here per push would otherwise re-serialize the
+        sharded merge)."""
+        if sender_s not in self._evicted or sender_s in self._members:
+            return False
         with self._mu:
             if sender_s not in self._evicted or sender_s in self._members:
                 return False
@@ -680,6 +731,8 @@ class LocalServer:
         and install them — aborting any stale in-flight aggregation
         state (a revived zombie's open rounds refer to a world that
         moved on).  Returns the number of keys adopted."""
+        self._shards.drain()  # stale pre-crash merges must not land on
+        #                       the adopted state
         keys = set()
         for gs in list(self.up.targets):
             reply = self.up.send_cmd(gs, Ctrl.LIST_KEYS,
@@ -788,15 +841,13 @@ class LocalServer:
         if state == "done":
             # already applied; the ACK (or piggybacked values) was lost
             if msg.pull:
-                with self._mu:
-                    self._try_serve_pull_locked(msg)
+                self._try_serve_pull(msg)
             else:
                 self.server.response(msg, body=self._recent.done_body(msg))
             return
         sender_s = str(msg.sender)
         if self._fence_evicted_push(msg, sender_s):
             return  # evicted identity: rejected, told to rejoin
-        completed: List[int] = []
         # a TS-merged push carries several workers' contributions at once
         # (ref: num_merge counting van.cc:1197-1252)
         num_merge = 1
@@ -808,8 +859,26 @@ class LocalServer:
             # weights by; missing (old client) = assume current target
             hfa_n = float((msg.body or {}).get("hfa_n",
                                                self._workers_target))
-        with self._mu:
-            for k, v in kvs.slices():
+        slices = list(kvs.slices())
+        if not slices:
+            self._recent.mark_done(msg)
+            self.server.response(msg)
+            return
+        # key-sharded merge: each key's accumulate runs on its stripe's
+        # serial lane, so per-key arrival order is preserved while
+        # pushes touching disjoint keys merge in parallel.  The ack —
+        # and any completed rounds — dispatch from whichever lane
+        # finishes the message's last slice (ordering vs. the parked
+        # piggyback pull is identical to the single-lock path).  With
+        # server_shards=1 the lanes are inline and this is bit-for-bit
+        # the old serial handler.
+        pending = [len(slices)]
+        bundles: List[dict] = []
+        done_mu = threading.Lock()
+
+        def merge_one(k: int, v: np.ndarray):
+            bundle = None
+            with self._mu.stripe(k):
                 st = self._keys.setdefault(k, _KeyState())
                 st.contributors.add(sender_s)
                 st.pushers.add(sender_s)
@@ -827,18 +896,36 @@ class LocalServer:
                         self.config.server_merge_threads)
                 st.count += num_merge
                 st.priority = msg.priority
-                if (st.count >= (st.expected or self.num_workers)
+                if (self.sync_mode
+                        and st.count >= (st.expected or self.num_workers)
                         and not st.completing):
-                    # slate the completion HERE, under the lock: the
-                    # _round_complete call below runs after release, and
-                    # a concurrent leave must not decide the same key
-                    st.completing = True
-                    completed.append(k)
+                    # take-at-decide, still under the stripe: detaching
+                    # the accumulator AT the decision point closes the
+                    # decide→retake window a parallel lane could
+                    # otherwise merge the next round's gradient into
+                    bundle = self._take_completed_locked(k)
+            with done_mu:
+                if bundle is not None:
+                    bundles.append(bundle)
+                pending[0] -= 1
+                last = pending[0] == 0
+            if last:
+                self._push_merged(msg, kvs, bundles)
+
+        for k, v in slices:
+            self._shards.submit(k, _ctx_bound(lambda k=k, v=v: merge_one(k, v)))
+
+    def _push_merged(self, msg: Message, kvs: KVPairs,
+                     bundles: List[dict]):
+        """Post-merge step of one push message, on the lane that
+        finished its last slice: ack (or park the piggyback pull), then
+        dispatch any rounds the message completed.  Runs with no
+        stripes held."""
         if not self.sync_mode:
             # async local tier: no rounds — clear the aggregation state
-            # FIRST (the accumulate loop above raised st.count, which
-            # blocks pull serving), then serve any piggybacked pull from
-            # the current store and forward the push upward immediately
+            # FIRST (the accumulate lanes raised st.count, which blocks
+            # pull serving), then serve any piggybacked pull from the
+            # current store and forward the push upward immediately
             with self._mu:
                 for k in kvs.keys:
                     st = self._keys[int(k)]
@@ -849,7 +936,7 @@ class LocalServer:
                     st.contributors.clear()
                     st.hfa_inv = 0.0
                 if msg.pull:
-                    self._try_serve_pull_locked(msg)
+                    self._try_serve_pull(msg)
             if not msg.pull:
                 self._recent.mark_done(msg)
                 self.server.response(msg)
@@ -861,14 +948,15 @@ class LocalServer:
             # once the round completes (ref: server replies with values in
             # the push-response when enable_p3, kvstore_dist_server.h:
             # 1149-1165,1255-1267) — park it like a pull
-            with self._mu:
-                self._keys[int(msg.keys[0])].parked_pulls.append(msg)
+            k0 = int(msg.keys[0])
+            with self._mu.stripe(k0):
+                self._keys[k0].parked_pulls.append(msg)
         else:
             # ack the push immediately — workers overlap next layers
             self._recent.mark_done(msg)
             self.server.response(msg)
-        if completed:
-            self._round_complete(completed)
+        if bundles:
+            self._dispatch_rounds(bundles)
 
     def _handle_push_row_sparse(self, msg: Message, kvs: KVPairs):
         """Scatter-accumulate active rows; the merged round rides the
@@ -896,40 +984,48 @@ class LocalServer:
         cols = int(msg.body["rs_cols"])
         row_ids, rows = unpack_rows(kvs.vals, cols)
         key = int(kvs.keys[0])
-        if not self.sync_mode:
-            # async: no accumulation round — densify once and forward
-            with self._mu:
+        sender_s = str(msg.sender)
+        self._saw_row_sparse = True
+
+        # rides the key's merge lane like every other mutation of this
+        # key, so row-sparse and dense pushes of one key keep their
+        # arrival order under sharding
+        def merge_rs():
+            if not self.sync_mode:
+                # async: no accumulation round — densify once and forward
+                with self._mu:
+                    st = self._keys.setdefault(key, _KeyState())
+                    st.in_flight = 0
+                    dense = np.zeros_like(self.store[key], dtype=np.float32)
+                    np.add.at(dense.reshape(-1, cols), row_ids, rows)
+                    self._drain_parked_locked(st)
+                self._recent.mark_done(msg)
+                self.server.response(msg)
+                self._push_up(KVPairs(kvs.keys, dense,
+                                      np.array([len(dense)], np.int64)),
+                              rs_keys={key})
+                return
+            bundle = None
+            with self._mu.stripe(key):
                 st = self._keys.setdefault(key, _KeyState())
-                st.in_flight = 0
-                dense = np.zeros_like(self.store[key], dtype=np.float32)
-                np.add.at(dense.reshape(-1, cols), row_ids, rows)
-                self._drain_parked_locked(st)
+                st.contributors.add(sender_s)
+                st.pushers.add(sender_s)
+                if st.accum is None:
+                    st.accum = np.zeros_like(self.store[key],
+                                             dtype=np.float32)
+                    st.expected = self._workers_target
+                np.add.at(st.accum.reshape(-1, cols), row_ids, rows)
+                st.count += 1
+                st.row_sparse = True
+                if (st.count >= (st.expected or self.num_workers)
+                        and not st.completing):
+                    bundle = self._take_completed_locked(key)
             self._recent.mark_done(msg)
             self.server.response(msg)
-            self._push_up(KVPairs(kvs.keys, dense,
-                                  np.array([len(dense)], np.int64)),
-                          rs_keys={key})
-            return
-        completed = []
-        self._saw_row_sparse = True
-        with self._mu:
-            st = self._keys.setdefault(key, _KeyState())
-            st.contributors.add(str(msg.sender))
-            st.pushers.add(str(msg.sender))
-            if st.accum is None:
-                st.accum = np.zeros_like(self.store[key], dtype=np.float32)
-                st.expected = self._workers_target
-            np.add.at(st.accum.reshape(-1, cols), row_ids, rows)
-            st.count += 1
-            st.row_sparse = True
-            if (st.count >= (st.expected or self.num_workers)
-                    and not st.completing):
-                st.completing = True
-                completed.append(key)
-        self._recent.mark_done(msg)
-        self.server.response(msg)
-        if completed:
-            self._round_complete(completed)
+            if bundle is not None:
+                self._dispatch_rounds([bundle])
+
+        self._shards.submit(key, _ctx_bound(merge_rs))
 
     def _on_inter_ts_delivery(self, msg: Message, kvs: KVPairs):
         """Updated weights arrived via the WAN overlay instead of a pull
@@ -953,63 +1049,61 @@ class LocalServer:
         self.ts_inter.disseminate_async(msg.keys, msg.vals, msg.lens, it,
                                         Cmd.TS_AUTOPULL)
 
-    def _round_complete(self, keys: List[int]):
-        """All party workers pushed `keys` — run the WAN push-up.
+    def _take_completed_locked(self, k: int) -> dict:
+        """Detach key ``k``'s completed round (caller holds stripe(k);
+        completion was just decided).  Bumps the round counter, applies
+        the HFA convex renormalization — accum = Σ w_i/n_i with
+        possibly-mixed n_i (membership transition) or count < n (leave
+        completed the round short): dividing by Σ 1/n_i keeps the
+        result a weighted MEAN of weight vectors, never
+        scale-inflated/shrunk — resets the per-round state, and returns
+        the round bundle :meth:`_dispatch_rounds` ships."""
+        st = self._keys[k]
+        st.round += 1
+        gated = self.hfa_enabled and st.round % self.hfa_k2 != 0
+        if gated:
+            with self._ctr_mu:
+                self.hfa_gated_key_rounds += 1
+        if (self.hfa_enabled and st.hfa_inv > 0.0
+                and abs(st.hfa_inv - 1.0) > 1e-9):
+            np.multiply(st.accum, 1.0 / st.hfa_inv, out=st.accum)
+        bundle = {"k": k, "v": st.accum, "gated": gated,
+                  "rs": st.row_sparse}
+        st.hfa_inv = 0.0
+        st.accum = None
+        st.count = 0
+        st.completing = False  # slate consumed; next round may be
+        #                        decided again
+        st.contributors = set()
+        st.in_flight += 1  # round launched; finish decrements
+        st.row_sparse = False  # describes this round only
+        return bundle
 
-        HFA: each key counts its own aggregation rounds; only every k2-th
-        round of a key crosses the WAN (ref: kvstore_dist_server.h:1324-1343
-        — the reference gates on local_iters per key likewise)."""
-        local_ks, up_ks = [], []
-        with self._mu:
-            for k in sorted(keys):
-                st = self._keys[k]
-                st.round += 1
-                if self.hfa_enabled and st.round % self.hfa_k2 != 0:
-                    local_ks.append(k)
-                    self.hfa_gated_key_rounds += 1
-                else:
-                    up_ks.append(k)
+    def _dispatch_rounds(self, bundles: List[dict]):
+        """Ship completed rounds whose accumulators were already
+        detached at the decision point.  HFA: each key counts its own
+        aggregation rounds; only every k2-th round of a key crosses the
+        WAN (ref: kvstore_dist_server.h:1324-1343).  Runs with no
+        stripes held (or under the all-stripes barrier on the fold
+        path)."""
+        bundles = sorted(bundles, key=lambda b: b["k"])
+        rs_keys = {b["k"] for b in bundles if b["rs"] and not b["gated"]}
 
-            rs_keys = set()
+        def pack(bs):
+            vs = [b["v"] for b in bs]
+            # single-key rounds (the big-tensor regime) hand the
+            # accumulator over as-is — concatenate([one]) is a full
+            # copy (~0.27 s at 200 MB on this host)
+            return KVPairs(np.array([b["k"] for b in bs], dtype=np.int64),
+                           vs[0] if len(vs) == 1 else np.concatenate(vs),
+                           np.array([len(v) for v in vs], dtype=np.int64))
 
-            def take(ks):
-                vs, ls = [], []
-                for k in ks:
-                    st = self._keys[k]
-                    if (self.hfa_enabled and st.hfa_inv > 0.0
-                            and abs(st.hfa_inv - 1.0) > 1e-9):
-                        # convex renormalization of the weight mean:
-                        # accum = Σ w_i/n_i with possibly-mixed n_i
-                        # (membership transition) or count < n (leave
-                        # completed the round short) — divide by
-                        # Σ 1/n_i so the result is a weighted MEAN of
-                        # weight vectors, never scale-inflated/shrunk
-                        np.multiply(st.accum, 1.0 / st.hfa_inv,
-                                    out=st.accum)
-                    st.hfa_inv = 0.0
-                    vs.append(st.accum)
-                    ls.append(len(st.accum))
-                    st.accum = None
-                    st.count = 0
-                    st.completing = False  # slate consumed; next round
-                    #                        may be decided again
-                    st.contributors = set()
-                    st.in_flight += 1  # round launched; finish decrements
-                    if st.row_sparse:
-                        rs_keys.add(k)
-                        st.row_sparse = False  # describes this round only
-                # single-key rounds (the big-tensor regime) hand the
-                # accumulator over as-is — concatenate([one]) is a full
-                # copy (~0.27 s at 200 MB on this host)
-                return KVPairs(np.array(ks, dtype=np.int64),
-                               vs[0] if len(vs) == 1 else np.concatenate(vs),
-                               np.array(ls, dtype=np.int64))
-
-            kvs_local = take(local_ks) if local_ks else None
-            kvs_up = take(up_ks) if up_ks else None
-        if kvs_local is not None:
-            self._apply_local(kvs_local)
-        if kvs_up is not None:
+        local = [b for b in bundles if b["gated"]]
+        up = [b for b in bundles if not b["gated"]]
+        if local:
+            self._apply_local(pack(local))
+        if up:
+            kvs_up = pack(up)
             if self.hfa_enabled:
                 self._push_up_hfa(kvs_up)
             elif rs_keys:
@@ -1017,14 +1111,21 @@ class LocalServer:
             else:
                 self._push_up(kvs_up)
 
+    def _round_complete(self, keys: List[int]):
+        """Complete rounds already decided for ``keys`` — the
+        membership-fold path (caller holds the all-stripes barrier, so
+        the per-key takes below just re-enter their stripes)."""
+        self._dispatch_rounds(
+            [self._take_completed_locked(k) for k in sorted(keys)])
+
     def _apply_local(self, kvs: KVPairs):
         """HFA off-round: the merged push is already the party-mean weight
         vector (workers push weight/num_workers, ref: examples/cnn_hfa.py) —
         adopt it and serve pulls without touching the WAN."""
-        with self._mu:
-            for k, v in kvs.slices():
+        for k, v in kvs.slices():
+            with self._mu.stripe(k):
                 self.store[k] = np.array(v, copy=True)
-            self._finish_round(list(kvs.keys))
+        self._finish_round([int(k) for k in kvs.keys])
 
     @staticmethod
     def _is_merge_relay(msg: Message) -> bool:
@@ -1097,7 +1198,8 @@ class LocalServer:
             # under the newer codec (one extra copy per round, paid only
             # with adaptive WAN on)
             raw = {int(k): np.array(v, copy=True) for k, v in kvs.slices()}
-        self.wan_push_rounds += 1
+        with self._ctr_mu:  # rounds of disjoint keys dispatch from
+            self.wan_push_rounds += 1  # parallel lanes
 
         with self._mu:
             epochs = {k: self._keys[k].epoch for k in keys
@@ -1115,8 +1217,7 @@ class LocalServer:
                     # async tier: the overlay disseminates at its own
                     # (rate-limited) pace — finish the round from the
                     # current replica instead of gating on a delivery
-                    with self._mu:
-                        self._finish_round(keys)
+                    self._finish_round(keys)
                 return
             self.up.zpull(keys,
                           cb=lambda kvs: self._on_pull_down(kvs, epochs),
@@ -1165,7 +1266,15 @@ class LocalServer:
     def _encode_wan_groups(self, kvs: KVPairs,
                            rs_keys=frozenset()) -> Dict[str, list]:
         """Group a push-up batch by wire codec (shared by the round path
-        and the adaptive fence-retry re-encode)."""
+        and the adaptive fence-retry re-encode).
+
+        Multi-key batches fan the per-key compress calls across the
+        shared codec pool (sized like ``server_merge_threads``) instead
+        of encoding serially on the round-completion thread; codec
+        SELECTION stays serial (MPQ's pick counters), and per-key codec
+        state (residuals, velocities) is key-partitioned so parallel
+        keys never share an entry.  Single-key rounds (the big-tensor
+        regime) and 1-lane hosts keep the exact serial path."""
         groups: Dict[str, list] = {}
         if self.push_codec is None:
             # uncompressed mode — except row-sparse rounds, whose merged
@@ -1181,16 +1290,22 @@ class LocalServer:
                             (k, pack_sparse(v[idx], idx)))
                         continue
                 groups.setdefault("", []).append((k, v))
-        else:
-            from geomx_tpu.compression import MpqSelector
+            return groups
+        from geomx_tpu.compression import MpqSelector
 
-            with self._tr.span("codec.encode"):
-                for k, v in kvs.slices():
-                    codec = (self.push_codec.select(len(v))
-                             if isinstance(self.push_codec, MpqSelector)
-                             else self.push_codec)
-                    groups.setdefault(codec.name, []).append(
-                        (k, codec.compress(k, v)))
+        sel = [(k, v, (self.push_codec.select(len(v))
+                       if isinstance(self.push_codec, MpqSelector)
+                       else self.push_codec)) for k, v in kvs.slices()]
+        pool = codec_pool(self.config) if len(sel) > 1 else None
+        with self._tr.span("codec.encode"):
+            if pool is None:
+                enc = [(k, c.name, c.compress(k, v)) for k, v, c in sel]
+            else:
+                futs = [pool.submit(c.compress, k, v) for k, v, c in sel]
+                enc = [(k, c.name, f.result())
+                       for (k, v, c), f in zip(sel, futs)]
+        for k, name, payload in enc:
+            groups.setdefault(name, []).append((k, payload))
         return groups
 
     def _send_wan_group(self, tag: str, pairs: list, done_cb,
@@ -1399,14 +1514,14 @@ class LocalServer:
         any pull-compressor's tracked subscriber view; a sparse delta
         against that view would corrupt the replica."""
         topo = self.po.topology
-        with self._mu:
-            ks, vs, ls = [], [], []
-            for k, v in kvs.slices():
+        ks, vs, ls = [], [], []
+        for k, v in kvs.slices():
+            with self._mu.stripe(k):
                 self.store[k] = np.array(v, copy=True)  # adopt party mean
                 delta = (v - self._milestone[k]) / topo.num_global_workers
-                ks.append(k); vs.append(delta.astype(np.float32)); ls.append(len(v))
-            out = KVPairs(np.array(ks, dtype=np.int64), np.concatenate(vs),
-                          np.array(ls, dtype=np.int64))
+            ks.append(k); vs.append(delta.astype(np.float32)); ls.append(len(v))
+        out = KVPairs(np.array(ks, dtype=np.int64), np.concatenate(vs),
+                      np.array(ls, dtype=np.int64))
         keys = [int(k) for k in out.keys]
         with self._mu:
             epochs = {k: self._keys[k].epoch for k in keys
@@ -1421,9 +1536,9 @@ class LocalServer:
 
     def _on_pull_down_hfa(self, kvs: KVPairs, epochs: Optional[dict] = None):
         tags = kvs.tags or {}
-        with self._mu:
-            live = []
-            for k, v in kvs.slices():
+        live = []
+        for k, v in kvs.slices():
+            with self._mu.stripe(k):
                 if (epochs is not None and k in self._keys
                         and self._keys[k].epoch != epochs.get(k)):
                     continue  # aborted by a restore
@@ -1435,8 +1550,8 @@ class LocalServer:
                 # -1 can never equal a tracked version, forcing the next
                 # compressed pull of this key to resync dense
                 self._pull_ver[k] = -1
-                live.append(k)
-            self._finish_round(live)
+            live.append(k)
+        self._finish_round(live)
 
     def _pull_echo(self, keys) -> dict:
         """Request body for a pull-down: echo the per-key view versions
@@ -1447,7 +1562,8 @@ class LocalServer:
 
     def _decode_pull_value(self, k: int, v: np.ndarray, tag: str) -> np.ndarray:
         """Decode one pull-down slab into the new full weight vector.
-        Caller holds self._mu.  "bsc" payloads are sparse deltas against
+        Caller holds stripe(k) (or the all-stripes barrier).
+        "bsc" payloads are sparse deltas against
         the current replica (ref: BSC decode :310-336); "f32" is a dense
         resync forced by a view-version mismatch (server or subscriber
         restarted, or a pull response was lost)."""
@@ -1480,108 +1596,126 @@ class LocalServer:
         pulls already drained); the rest finish normally."""
         tags = kvs.tags or {}
         pv = kvs.pv or {}
-        with self._tr.span("local.pull_down"), self._mu:
+        with self._tr.span("local.pull_down"):
             live = []
             for k, v in kvs.slices():
-                if (epochs is not None
-                        and k in self._keys
-                        and self._keys[k].epoch != epochs.get(k)):
-                    continue  # aborted by a restore
-                tag = tags.get(k, "")
-                if k in pv:
-                    # overlapping rounds can deliver responses out of
-                    # order (van delay/priority queues): a bsc delta is
-                    # only valid against the exact view it was encoded
-                    # for (ver pv-1), and a dense resync must never be
-                    # overwritten by an older response.  Skipping still
-                    # finishes the round — the replica stays one round
-                    # behind and the next echo mismatch heals it dense.
-                    cur = self._pull_ver.get(k, 0)
-                    if tag == "bsc" and cur != pv[k] - 1:
-                        self.stale_pull_skips += 1
-                        live.append(k)
-                        continue
-                    if tag == "f32" and pv[k] <= cur:
-                        self.stale_pull_skips += 1
-                        live.append(k)
-                        continue
-                self.store[k] = self._decode_pull_value(k, v, tag)
-                if k in pv:
-                    self._pull_ver[k] = pv[k]
+                with self._mu.stripe(k):
+                    if (epochs is not None
+                            and k in self._keys
+                            and self._keys[k].epoch != epochs.get(k)):
+                        continue  # aborted by a restore
+                    tag = tags.get(k, "")
+                    if k in pv:
+                        # overlapping rounds can deliver responses out of
+                        # order (van delay/priority queues): a bsc delta is
+                        # only valid against the exact view it was encoded
+                        # for (ver pv-1), and a dense resync must never be
+                        # overwritten by an older response.  Skipping still
+                        # finishes the round — the replica stays one round
+                        # behind and the next echo mismatch heals it dense.
+                        cur = self._pull_ver.get(k, 0)
+                        if tag == "bsc" and cur != pv[k] - 1:
+                            self.stale_pull_skips += 1
+                            live.append(k)
+                            continue
+                        if tag == "f32" and pv[k] <= cur:
+                            self.stale_pull_skips += 1
+                            live.append(k)
+                            continue
+                    self.store[k] = self._decode_pull_value(k, v, tag)
+                    if k in pv:
+                        self._pull_ver[k] = pv[k]
                 live.append(k)
             self._finish_round(live)
 
     def _finish_round(self, keys: List[int]):
-        """Unblock keys and retry their parked pulls; must hold self._mu."""
+        """Unblock keys and retry their parked pulls.  Takes each key's
+        stripe itself (callers holding the all-stripes barrier just
+        re-enter); the retries run with no stripe held — a multi-key
+        pull re-acquires stripes in its own key order."""
         to_retry: List[Message] = []
         for k in keys:
-            st = self._keys[k]
-            st.in_flight = max(0, st.in_flight - 1)
-            st.version += 1
-            to_retry.extend(st.parked_pulls)
-            st.parked_pulls.clear()
+            with self._mu.stripe(k):
+                st = self._keys[k]
+                st.in_flight = max(0, st.in_flight - 1)
+                st.version += 1
+                to_retry.extend(st.parked_pulls)
+                st.parked_pulls.clear()
         for req in to_retry:
-            self._try_serve_pull_locked(req)
+            self._try_serve_pull(req)
         if self.ts_client is not None:
-            # hand fresh weights to the overlay dissemination thread
+            # hand fresh weights to the overlay dissemination thread;
+            # the per-key astype copies happen under the stripe so a
+            # concurrent in-place decode cannot tear them
             ks = sorted(keys)
-            self._ts_iter += 1
+            vs = []
+            for k in ks:
+                with self._mu.stripe(k):
+                    vs.append(self.store[k].astype(np.float32))
+            with self._ctr_mu:
+                self._ts_iter += 1
+                it = self._ts_iter
             self.ts_client.disseminate_async(
                 np.array(ks, dtype=np.int64),
-                np.concatenate([self.store[k].astype(np.float32) for k in ks]),
-                np.array([len(self.store[k]) for k in ks], dtype=np.int64),
-                f"{self.po.node}:{self._ts_iter}", Cmd.TS_AUTOPULL)
+                np.concatenate(vs),
+                np.array([len(v) for v in vs], dtype=np.int64),
+                f"{self.po.node}:{it}", Cmd.TS_AUTOPULL)
 
     def _drain_parked_locked(self, st: _KeyState):
+        """Caller holds the all-stripes barrier (init / warm-boot /
+        async paths)."""
         parked, st.parked_pulls = st.parked_pulls, []
         for req in parked:
-            self._try_serve_pull_locked(req)
+            self._try_serve_pull(req)
 
     def _handle_pull(self, msg: Message, kvs: KVPairs):
-        with self._mu:
-            self._try_serve_pull_locked(msg)
+        self._try_serve_pull(msg)
 
-    def _try_serve_pull_locked(self, req: Message) -> bool:
+    def _try_serve_pull(self, req: Message) -> bool:
         """Serve a pull if every key is initialized and not mid-round,
         else re-park it on the first blocking key (the reference spins on
         initialized_, ref :1721-1723 — we park event-driven).  A multi-key
-        pull is re-validated against ALL its keys each time it is retried."""
+        pull is re-validated against ALL its keys each time it is retried.
+        Takes one stripe at a time (never two); safe to call under the
+        all-stripes barrier (re-entry), never under a single OTHER
+        stripe."""
         sender_s = str(req.sender)
         for k in req.keys:
             k = int(k)
-            st = self._keys.get(k)
-            if st is None:
-                st = self._keys.setdefault(k, _KeyState())
-            # blocked while any WAN round is in flight OR a round this
-            # sender CONTRIBUTED to is accumulating: both mean fresher
-            # weights than the store's are owed to this puller.  A
-            # non-contributor's pull is served from the last completed
-            # round instead — a dynamic joiner bootstrapping (pull
-            # before first push) must not park behind a round that can
-            # only complete with its own push (advisor r4 deadlock),
-            # and a worker lagging a round behind wants exactly the
-            # store's weights, not the open round's future ones.
-            # EXCEPT during a TS-MERGED round (count > distinct senders:
-            # some push carried num_merge>1): an established member's
-            # contribution may be inside the open accumulator even
-            # though it never pushed directly, so serving it stale would
-            # silently diverge party replicas — park it; the round
-            # completes without its direct push by construction (its
-            # contribution already rode the merge tree).  Serve-stale
-            # stays for senders with no push history on this key (a
-            # bootstrapping joiner — parking those is the r4 deadlock)
-            # and for plain rounds (count == distinct senders), where
-            # the open round still NEEDS this sender's own push
-            # (advisor r5).
-            blocked = (k not in self.store or st.in_flight > 0
-                       or (st.count > 0 and sender_s in st.contributors))
-            if (not blocked and st.count > len(st.contributors)
-                    and sender_s in self._members
-                    and sender_s in st.pushers):
-                blocked = True
-            if blocked:
-                st.parked_pulls.append(req)
-                return False
+            with self._mu.stripe(k):
+                st = self._keys.get(k)
+                if st is None:
+                    st = self._keys.setdefault(k, _KeyState())
+                # blocked while any WAN round is in flight OR a round this
+                # sender CONTRIBUTED to is accumulating: both mean fresher
+                # weights than the store's are owed to this puller.  A
+                # non-contributor's pull is served from the last completed
+                # round instead — a dynamic joiner bootstrapping (pull
+                # before first push) must not park behind a round that can
+                # only complete with its own push (advisor r4 deadlock),
+                # and a worker lagging a round behind wants exactly the
+                # store's weights, not the open round's future ones.
+                # EXCEPT during a TS-MERGED round (count > distinct senders:
+                # some push carried num_merge>1): an established member's
+                # contribution may be inside the open accumulator even
+                # though it never pushed directly, so serving it stale would
+                # silently diverge party replicas — park it; the round
+                # completes without its direct push by construction (its
+                # contribution already rode the merge tree).  Serve-stale
+                # stays for senders with no push history on this key (a
+                # bootstrapping joiner — parking those is the r4 deadlock)
+                # and for plain rounds (count == distinct senders), where
+                # the open round still NEEDS this sender's own push
+                # (advisor r5).
+                blocked = (k not in self.store or st.in_flight > 0
+                           or (st.count > 0 and sender_s in st.contributors))
+                if (not blocked and st.count > len(st.contributors)
+                        and sender_s in self._members
+                        and sender_s in st.pushers):
+                    blocked = True
+                if blocked:
+                    st.parked_pulls.append(req)
+                    return False
         if req.cmd == Cmd.ROW_SPARSE_PULL:
             # gather the requested rows only (ref: PullRowSparse).
             # Out-of-range ids are clamped defensively (the client
@@ -1592,29 +1726,57 @@ class LocalServer:
             cols = int(req.body["rs_cols"])
             from geomx_tpu.compression.codecs import pack_rows
 
-            table = self.store[key].reshape(-1, cols)
-            row_ids = np.clip(row_ids, 0, len(table) - 1)
-            payload = pack_rows(row_ids, table[row_ids])
+            with self._mu.stripe(key):
+                table = self.store[key].reshape(-1, cols)
+                row_ids = np.clip(row_ids, 0, len(table) - 1)
+                payload = pack_rows(row_ids, table[row_ids])
             self.server.response(req, KVPairs(
                 np.array([key], np.int64), payload,
                 np.array([len(payload)], np.int64)))
             return True
-        ks, vs, ls = [], [], []
-        for k in req.keys:
-            k = int(k)
-            w = self.store[k]
-            ks.append(k); vs.append(w); ls.append(len(w))
+        ks = [int(k) for k in req.keys]
+        if len(ks) == 1:
+            # single key: freeze-in-place and serve the alias
+            # (_store_payload) — zero-copy, in-place decodes COW
+            with self._mu.stripe(ks[0]):
+                w = self.store[ks[0]]
+                payload = (_store_payload([w]) if w.dtype == np.float32
+                           else np.array(w, np.float32))
+            ls = [len(payload)]
+        else:
+            # multi-key: the response concatenates anyway (the isolation
+            # copy) — copy each slice under ITS stripe straight into the
+            # response buffer.  One total copy, exactly the pre-sharding
+            # concat; deliberately NO freeze — freezing here would force
+            # a full COW on every later in-place decode of these keys
+            # (+0.2 s/round at the 50M flagship), and the under-stripe
+            # copy already rules out a torn read.
+            ls = []
+            for k in ks:
+                with self._mu.stripe(k):
+                    ls.append(len(self.store[k]))
+            payload = np.empty(sum(ls), np.float32)
+            off = 0
+            for k, ln in zip(ks, ls):
+                with self._mu.stripe(k):
+                    payload[off:off + ln] = self.store[k]
+                off += ln
         # P3 piggybacked pushes park here until the round finishes; record
         # the response so a replay re-serves values instead of re-merging
         self._recent.mark_done(req)
         self.server.response(req, KVPairs(
-            np.array(ks, dtype=np.int64), _store_payload(vs),
+            np.array(ks, dtype=np.int64), payload,
             np.array(ls, dtype=np.int64)))
         return True
 
     # ---- control ------------------------------------------------------------
     def _on_cmd(self, msg: Message):
         body = msg.body or {}
+        if msg.cmd in (Ctrl.SET_SYNC_MODE, Ctrl.SET_COMPRESSION,
+                       Ctrl.SET_HFA):
+            # these flip how queued merges would be interpreted; keep
+            # the handler-thread program order vs. the merge lanes
+            self._shards.drain()
         if msg.cmd == Ctrl.SET_SYNC_MODE:
             self.sync_mode = bool(body["sync"])
         elif msg.cmd == Ctrl.SET_COMPRESSION:
@@ -1773,6 +1935,7 @@ class LocalServer:
             self.ts_inter.stop()
         if self.ts_push_inter is not None:
             self._merge_q.put(None)
+        self._shards.stop()
         self.server.stop()
         self.up.stop()
 
@@ -1810,7 +1973,19 @@ class GlobalServer:
         self.num_contributors = topo.num_global_workers
         self.store: Dict[int, np.ndarray] = {}
         self._keys: Dict[int, _GlobalKeyState] = {}
-        self._mu = threading.RLock()
+        # key-sharded merge (see LocalServer): stripe(k) guards key k,
+        # ``with self._mu:`` is the all-stripes barrier for party
+        # folds, failover fences, replication snapshots and policy
+        # swaps — their atomicity against the data path is unchanged
+        self._mu = StripedRLock(resolve_server_shards(self.config))
+        self._shards = ShardExecutor(self._mu.n,
+                                     name=f"gmerge-{postoffice.node}")
+        self._ack_mu = threading.Lock()  # leaf lock: a parked push's
+        #                                  remaining-keys set is shared
+        #                                  across stripes
+        self._pc_mu = threading.RLock()  # leaf lock: the pull
+        #                                  compressor's per-subscriber
+        #                                  views/caches are not striped
         # ---- failover state (tentpole PR 1) ----
         self.is_standby = bool(standby)
         self.term = 0              # fencing epoch; bumped by promotion
@@ -2029,6 +2204,9 @@ class GlobalServer:
                     self._parked_standby.append((msg, kvs))
             return
         if msg.cmd == Cmd.INIT:
+            # overwrite-INITs must not interleave with merges still
+            # queued on lanes from earlier-arrived pushes
+            self._shards.drain()
             state = self._recent.check(msg)
             if state == "pending":
                 return
@@ -2061,8 +2239,10 @@ class GlobalServer:
                                 if not ent[1]:
                                     stale_acks.append(ent[0])
                             st.parked_pushes.clear()
-                        # init may race ahead of early pulls
-                        self._serve_parked_pulls_locked(int(k))
+                        # init may race ahead of early pulls (under the
+                        # barrier, re-parking inline is lock-safe)
+                        for m in self._serve_parked_pulls_locked(int(k)):
+                            self._park_pull(m)
                 if fresh and overwrite and self.pull_comp is not None:
                     # drop ONLY the overwritten keys' tracked views and
                     # re-seed their INIT bases with the propagated value;
@@ -2145,20 +2325,33 @@ class GlobalServer:
     def _decompress_push(self, msg: Message, kvs: KVPairs) -> KVPairs:
         """Decode a compressed gradient push to dense before aggregation
         (ref: BSCDecompress gradient_compression.cc:310-336; fp16/2bit
-        decode in the server push handlers)."""
+        decode in the server push handlers).  Multi-key payloads fan
+        the per-key decodes across the shared codec pool; this server's
+        own ``DecoderBank`` keeps per-endpoint decoder affinity (its
+        LRU is internally locked), so epoch-fenced clears stay scoped
+        to this endpoint."""
         from geomx_tpu.compression import decompress_payload
 
         thr = float(self.compression.get("threshold", 0.5))
-        ks, vs, ls = [], [], []
-        with self._tr.span("codec.decode"), self._mu:
-            for k, payload in kvs.slices():
-                orig = len(self.store[k])
-                dense = decompress_payload(msg.compr, k, payload, orig, thr,
-                                           bank=self._decoders)
-                ks.append(k); vs.append(dense); ls.append(orig)
-        return KVPairs(np.array(ks, dtype=np.int64),
+        pairs = [(int(k), p) for k, p in kvs.slices()]
+        lens = []
+        for k, _ in pairs:
+            with self._mu.stripe(k):
+                lens.append(len(self.store[k]))
+        pool = codec_pool(self.config) if len(pairs) > 1 else None
+        with self._tr.span("codec.decode"):
+            if pool is None:
+                vs = [decompress_payload(msg.compr, k, p, ln, thr,
+                                         bank=self._decoders)
+                      for (k, p), ln in zip(pairs, lens)]
+            else:
+                futs = [pool.submit(decompress_payload, msg.compr, k, p,
+                                    ln, thr, self._decoders)
+                        for (k, p), ln in zip(pairs, lens)]
+                vs = [f.result() for f in futs]
+        return KVPairs(np.array([k for k, _ in pairs], dtype=np.int64),
                        vs[0] if len(vs) == 1 else np.concatenate(vs),
-                       np.array(ls, dtype=np.int64))
+                       np.array(lens, dtype=np.int64))
 
     # ---- sync tier ----------------------------------------------------------
     def _push_sync(self, msg: Message, kvs: KVPairs):
@@ -2181,8 +2374,7 @@ class GlobalServer:
             # would leave the puller waiting forever
             body = self._recent.done_body(msg)
             if body is None and msg.pull:
-                with self._mu:
-                    self._respond_pull(msg)
+                self._respond_pull(msg)
             else:
                 self.server.response(msg, body=body)
             return
@@ -2191,12 +2383,26 @@ class GlobalServer:
         num_merge = 1
         if isinstance(msg.body, dict):
             num_merge = int(msg.body.get("num_merge", 1))
-        to_ack: List[tuple] = []  # (request, error-body | None)
-        with self._mu:
-            entry = [msg, {int(k) for k in kvs.keys}]
-            completed = []
-            for k, v in kvs.slices():
-                k = int(k)
+        hfa_delta = msg.cmd == Cmd.HFA_DELTA
+        dissem_ok = msg.cmd == Cmd.DEFAULT
+        slices = [(int(k), v) for k, v in kvs.slices()]
+        entry = [msg, {k for k, _ in slices}]
+        # key-sharded merge: each key accumulates — and, the moment its
+        # round completes, runs its optimizer update — on its stripe's
+        # serial lane.  The message-level finish (ack flush, checkpoint
+        # / replication marking, overlay dissemination) runs once, on
+        # the lane that clears the last slice.
+        pending = [len(slices)]
+        acks: List[tuple] = []
+        reparks: List[Message] = []
+        completed_keys: List[int] = []
+        done_mu = threading.Lock()
+
+        def merge_one(k: int, v: np.ndarray):
+            k_acks: List[tuple] = []
+            k_reparks: List[Message] = []
+            completed = False
+            with self._mu.stripe(k):
                 st = self._keys.setdefault(k, _GlobalKeyState())
                 if st.accum is None:
                     st.accum = _adopt_or_copy(v, msg.donated)
@@ -2209,41 +2415,50 @@ class GlobalServer:
                 st.count += num_merge
                 st.parked_pushes.append(entry)
                 if st.count >= self.num_contributors:
-                    completed.append(k)
-            more_acks, dissem = self._complete_keys_locked(
-                completed, hfa_delta=(msg.cmd == Cmd.HFA_DELTA),
-                dissem_ok=(msg.cmd == Cmd.DEFAULT))
-            to_ack.extend(more_acks)
-        self._flush_completions(to_ack, dissem)
+                    completed = True
+                    self._complete_key_locked(k, hfa_delta, k_acks,
+                                              k_reparks)
+            with done_mu:
+                acks.extend(k_acks)
+                reparks.extend(k_reparks)
+                if completed:
+                    completed_keys.append(k)
+                pending[0] -= 1
+                last = pending[0] == 0
+            if last:
+                self._merge_finish(acks, reparks, completed_keys,
+                                   dissem_ok)
 
-    def _complete_keys_locked(self, completed: List[int],
-                              hfa_delta: bool, dissem_ok: bool):
-        """Run the optimizer for each completed key, collect the parked
-        pushes whose key sets emptied, serve parked pulls.  Caller holds
-        ``_mu``; returns ``(to_ack, dissem)`` for
-        :meth:`_flush_completions` outside the lock.  Shared by the push
-        handler and the party-leave fold (both decide completion)."""
-        to_ack: List[tuple] = []
-        # one optimizer span per completion batch (the per-key update
-        # loop IS the global tier's compute stage on the critical path)
-        opt_span = self._tr.span("global.opt") if completed else None
-        if opt_span is not None:
-            opt_span.__enter__()
-        for k in completed:
-            st = self._keys[k]
-            if k not in self.store:
-                # a restarted server without a checkpoint cannot host
-                # this key — fail the pushers loudly, don't hang them
-                err = {"error": f"key {k} lost across server restart "
-                                "(no checkpoint to resume from)"}
-                st.accum = None
-                st.count = 0
+        for k, v in slices:
+            self._shards.submit(k, _ctx_bound(lambda k=k, v=v: merge_one(k, v)))
+
+    def _complete_key_locked(self, k: int, hfa_delta: bool,
+                             to_ack: List[tuple],
+                             reparks: List[Message]) -> None:
+        """One completed key's update (caller holds stripe(k) or the
+        all-stripes barrier): optimizer (or additive HFA delta), parked
+        push ack collection, parked pull serving.  Appends (request,
+        error) pairs whose key sets emptied to ``to_ack`` and pulls
+        still blocked on OTHER keys to ``reparks`` — the caller
+        re-parks those via :meth:`_park_pull` OUTSIDE this stripe (a
+        re-park takes the blocking key's stripe; taking it here would
+        break the one-stripe-at-a-time lock order)."""
+        st = self._keys[k]
+        if k not in self.store:
+            # a restarted server without a checkpoint cannot host
+            # this key — fail the pushers loudly, don't hang them
+            err = {"error": f"key {k} lost across server restart "
+                            "(no checkpoint to resume from)"}
+            st.accum = None
+            st.count = 0
+            with self._ack_mu:
                 for ent in st.parked_pushes:
                     ent[1].discard(k)
                     if not ent[1]:
                         to_ack.append((ent[0], err))
-                st.parked_pushes.clear()
-                continue
+            st.parked_pushes.clear()
+            return
+        with self._tr.span("global.opt"):
             if hfa_delta:
                 # milestone deltas come pre-divided by num_global_workers;
                 # apply additively (ref: HandleHFAAccumulate :959-972)
@@ -2255,16 +2470,53 @@ class GlobalServer:
                 self.store[k] = self.optimizer.update_scaled(
                     k, self.store[k], st.accum,
                     1.0 / self.num_contributors)
-            st.accum = None
-            st.count = 0
+        st.accum = None
+        st.count = 0
+        with self._ack_mu:
             for ent in st.parked_pushes:
                 ent[1].discard(k)
                 if not ent[1]:
                     to_ack.append((ent[0], None))
-            st.parked_pushes.clear()
-            self._serve_parked_pulls_locked(k)
-        if opt_span is not None:
-            opt_span.__exit__(None, None, None)
+        st.parked_pushes.clear()
+        reparks.extend(self._serve_parked_pulls_locked(k))
+
+    def _merge_finish(self, to_ack: List[tuple],
+                      reparks: List[Message],
+                      completed_keys: List[int], dissem_ok: bool):
+        """Message-level finish of one sync push, with no stripes held:
+        re-park multi-key pulls, mark checkpoint/replication progress
+        and build the overlay dissemination under the all-stripes
+        barrier (both snapshot cross-key state), then flush acks."""
+        for m in reparks:
+            self._park_pull(m)
+        dissem = None
+        if completed_keys and (
+                self._repl is not None or self.ts_inter is not None
+                or (self.config.checkpoint_dir
+                    and self.config.auto_ckpt_updates)):
+            with self._mu:
+                self._auto_ckpt_locked(len(completed_keys))
+                if self._repl is not None:
+                    self._repl.mark_locked(len(completed_keys))
+                if self.ts_inter is not None and dissem_ok:
+                    dissem = self._build_dissem_locked(sorted(
+                        k for k in completed_keys if k in self.store))
+        self._flush_completions(to_ack, dissem)
+
+    def _complete_keys_locked(self, completed: List[int],
+                              hfa_delta: bool, dissem_ok: bool):
+        """Batch completion for the FOLD paths (party leave / crash
+        fold / overwrite-INIT): caller holds the all-stripes barrier,
+        so the per-key completions just re-enter their stripes and
+        still-blocked pulls can re-park immediately.  Returns
+        ``(to_ack, dissem)`` for :meth:`_flush_completions` outside the
+        lock."""
+        to_ack: List[tuple] = []
+        reparks: List[Message] = []
+        for k in completed:
+            self._complete_key_locked(k, hfa_delta, to_ack, reparks)
+        for m in reparks:
+            self._park_pull(m)
         if completed:
             self._auto_ckpt_locked(len(completed))
             if self._repl is not None:
@@ -2284,8 +2536,7 @@ class GlobalServer:
                 # the updated values, eliminating the ack -> pull-request
                 # chain per key (ref: server replies with values in the
                 # push response, kvstore_dist_server.h:1149-1165,1255-1267)
-                with self._mu:
-                    self._respond_pull(req)
+                self._respond_pull(req)
             else:
                 self.server.response(req, body=err)
         if dissem is not None:
@@ -2322,8 +2573,7 @@ class GlobalServer:
             # push_pull)
             body = self._recent.done_body(msg)
             if body is None and msg.pull:
-                with self._mu:
-                    self._respond_pull(msg)
+                self._respond_pull(msg)
             else:
                 self.server.response(msg, body=body)
             return
@@ -2352,8 +2602,7 @@ class GlobalServer:
                     dissem = self._build_dissem_locked(ks)
         self._recent.mark_done(msg)
         if msg.pull:
-            with self._mu:
-                self._respond_pull(msg)  # piggybacked push_pull (P3)
+            self._respond_pull(msg)  # piggybacked push_pull (P3)
         else:
             self.server.response(msg)
         if dissem is not None:
@@ -2361,32 +2610,41 @@ class GlobalServer:
 
     # ---- pulls --------------------------------------------------------------
     def _pull(self, msg: Message, kvs: KVPairs):
-        with self._mu:
-            for k in kvs.keys:
-                k = int(k)
-                if k not in self.store:
-                    self._keys.setdefault(k, _GlobalKeyState()).parked_pulls.append(msg)
-                    return
-            self._respond_pull(msg)
+        self._park_pull(msg)
 
-    def _serve_parked_pulls_locked(self, key: int):
+    def _park_pull(self, m: Message) -> None:
+        """Serve a pull, or park it under its first key that is MISSING
+        NOW (one stripe at a time).  Re-parking under a missing key
+        matters: leaving a pull under an already-present key would
+        orphan it — later INITs only rescan their own key's list
+        (advisor r1: zpull([a,b]) before INIT of both hung when a and b
+        arrived in separate INITs)."""
+        for k in m.keys:
+            k = int(k)
+            with self._mu.stripe(k):
+                if k not in self.store:
+                    self._keys.setdefault(
+                        k, _GlobalKeyState()).parked_pulls.append(m)
+                    return
+        self._respond_pull(m)
+
+    def _serve_parked_pulls_locked(self, key: int) -> List[Message]:
+        """Serve ``key``'s parked pulls that became servable; returns
+        the ones still blocked on OTHER keys.  Caller holds stripe(key)
+        (or the barrier) and re-parks the returned pulls via
+        :meth:`_park_pull` — re-parking takes the blocking key's
+        stripe, which must not nest inside this one."""
         st = self._keys.get(key)
         if not st:
-            return
+            return []
         pending, st.parked_pulls = st.parked_pulls, []
+        blocked: List[Message] = []
         for m in pending:
-            missing = next((int(k) for k in m.keys
-                            if int(k) not in self.store), None)
-            if missing is None:
+            if all(int(k) in self.store for k in m.keys):
                 self._respond_pull(m)
             else:
-                # still blocked: re-park under a key that is MISSING NOW.
-                # Leaving it under the original (now-present) key would
-                # orphan it — later INITs only rescan their own key's list
-                # (advisor r1: zpull([a,b]) before INIT of both hung when
-                # a and b arrived in separate INITs)
-                self._keys.setdefault(
-                    missing, _GlobalKeyState()).parked_pulls.append(m)
+                blocked.append(m)
+        return blocked
 
     def _respond_pull(self, req: Message):
         # HFA K2 pulls must come back dense: the subscriber's replica just
@@ -2424,7 +2682,11 @@ class GlobalServer:
         typ = self.compression.get("type")
         size_bound = (int(self.compression.get("size_bound", 200_000))
                       if typ == "mpq" else 0)
-        with self._tr.span("codec.encode"):
+        # _pc_mu: the compressor's per-subscriber tracked views, payload
+        # cache and rng are shared across keys — a leaf lock (taken
+        # under a stripe or the barrier, never the reverse) keeps them
+        # coherent now that pull serving runs outside the big lock
+        with self._tr.span("codec.encode"), self._pc_mu:
             self._respond_pull_compressed_inner(req, typ, size_bound)
 
     def _respond_pull_compressed_inner(self, req: Message, typ,
@@ -2526,11 +2788,14 @@ class GlobalServer:
                                      trust_init=trust_init)
             for k, v in self.store.items():
                 pc.ensure_base(k, v)
-            # publish only after bases are seeded (pulls run on a
-            # separate thread under this same lock)
-            self.pull_comp = pc
+            # publish only after bases are seeded, and under the
+            # compressor's own leaf lock — compressed pull serving
+            # synchronizes on _pc_mu, not the barrier
+            with self._pc_mu:
+                self.pull_comp = pc
         else:
-            self.pull_comp = None
+            with self._pc_mu:
+                self.pull_comp = None
 
     def _auto_ckpt_locked(self, n_updates: int = 0, force: bool = False):
         """Periodic background checkpoint (caller holds self._mu).
@@ -2671,7 +2936,8 @@ class GlobalServer:
                 self.promotions += 1
                 parked, self._parked_standby = self._parked_standby, []
                 for k in list(self.store):
-                    self._serve_parked_pulls_locked(k)
+                    for m in self._serve_parked_pulls_locked(k):
+                        self._park_pull(m)
                 from geomx_tpu.utils.metrics import system_counter
 
                 system_counter(f"{self.po.node}.promotions").inc()
@@ -2730,14 +2996,23 @@ class GlobalServer:
         from geomx_tpu.kvstore import checkpoint as ckpt
 
         store, opt, meta = ckpt.load_server_state(path)
+        self._shards.drain()  # pre-restore merges must not land on the
+        #                       restored state
         with self._mu:
             self._install_state_locked(store, opt, meta)
             for k in list(self.store):
-                self._serve_parked_pulls_locked(k)
+                for m in self._serve_parked_pulls_locked(k):
+                    self._park_pull(m)
 
     # ---- control ------------------------------------------------------------
     def _on_cmd(self, msg: Message):
         body = msg.body or {}
+        if msg.cmd in (Ctrl.SET_OPTIMIZER, Ctrl.SET_COMPRESSION,
+                       Ctrl.SET_SYNC_GLOBAL_MODE, Ctrl.CHECKPOINT):
+            # program order vs. the merge lanes: an optimizer/codec/mode
+            # swap (or a checkpoint snapshot) must not interleave with
+            # merges queued from earlier-arrived pushes
+            self._shards.drain()
         if msg.cmd == Ctrl.SET_OPTIMIZER:
             # ref: master worker pickles the optimizer, executes on the
             # global server (kvstore.py:452-499, kvstore_dist_server.h:357-364)
@@ -2864,4 +3139,5 @@ class GlobalServer:
             self._repl.stop()
         if self.ts_inter is not None:
             self.ts_inter.stop()
+        self._shards.stop()
         self.server.stop()

@@ -561,6 +561,12 @@ def main(argv=None):
                          "mid-training via epoch-fenced SET_WAN_POLICY "
                          "broadcasts (GEOMX_ADAPT_* tune the loop; see "
                          "docs/adaptive-wan.md)")
+    ap.add_argument("--server-shards", type=int,
+                    default=int(os.environ.get("GEOMX_SERVER_SHARDS", "0")),
+                    help="key-sharded server merge: lock stripes + "
+                         "serial merge lanes per server (0 = auto "
+                         "min(8, cpus); 1 = the single-lock server; "
+                         "see docs/perf.md)")
     ap.add_argument("--optimizer", default="adam",
                     choices=["sgd", "adam", "dcasgd"])
     args = ap.parse_args(argv)
@@ -617,6 +623,7 @@ def main(argv=None):
                               or cfg.trace_sample_every)
     cfg.trace_dir = args.trace_dir or cfg.trace_dir
     cfg.adaptive_wan = args.adaptive_wan or cfg.adaptive_wan
+    cfg.server_shards = args.server_shards or cfg.server_shards
     # CLI overrides bypass dataclass construction — re-run the invariant
     # checks so invalid combinations fail here, not as a runtime hang
     cfg.__post_init__()

@@ -49,6 +49,13 @@ class Customer:
         # keeping one global total order of all handler executions
         self._inline = bool(postoffice.config.deterministic)
         self._q: "queue.Queue[Optional[Message]]" = queue.Queue()
+        # split pull lane (ref: customer.h:91-101): pure pull REQUESTS
+        # bypass the push/command queue onto their own thread, so pull
+        # serving is never head-of-line blocked behind a long merge
+        # dispatch.  ON by default for server roles (KVServer passes
+        # split_pull_queue=True); the inline/deterministic path stays
+        # single-ordered — a second lane would break the NaiveEngine
+        # analog's global total order, so it is deliberately untouched.
         self._pull_q: Optional["queue.Queue[Optional[Message]]"] = (
             queue.Queue() if (split_pull_queue and not self._inline)
             else None

@@ -510,7 +510,10 @@ class KVServer(_App):
 
     The handle runs on the customer thread (push queue) or the dedicated
     pull thread (ref: customer.h:91-101) — handlers must therefore be
-    thread-safe across those two.
+    thread-safe across those two.  ``split_pull_queue`` defaults ON for
+    every server role: a pull must be servable while a long merge
+    dispatch occupies the push lane (the sharded servers additionally
+    stripe their key state, so the two lanes only contend per key).
     """
 
     def __init__(

@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import io
+import os
 import pickle
 import struct
 from typing import Any, Optional
@@ -20,6 +21,13 @@ from typing import Any, Optional
 import numpy as np
 
 from geomx_tpu.core.config import NodeId
+
+# Wire-format selector: v2 (raw self-describing array framing, the
+# default) vs the legacy v1 np.save frames.  ``GEOMX_WIRE_FORMAT=v1``
+# pins the ENCODER to v1 for mixed-version rollouts and for the serde
+# microbench's same-run comparison; the decoder always auto-detects, so
+# either side may upgrade first.
+WIRE_V2 = os.environ.get("GEOMX_WIRE_FORMAT", "v2").strip().lower() != "v1"
 
 
 class Control(enum.Enum):
@@ -201,19 +209,111 @@ class Message:
         return Message(**kw)
 
     # ---- binary serialization (for the TCP van) -----------------------------
+    #
+    # Wire format v2 (default): self-describing raw array framing —
+    #
+    #   int32  _V2_MAGIC (negative, so a v1 frame's positive header
+    #          length can never collide; from_bytes auto-detects)
+    #   _HDR   fixed meta fields (same struct as v1)
+    #   int32  meta_len; pickle of {sender, recipient, body, compr}
+    #          (pickle survives ONLY for this small control dict)
+    #   3 ×    array descriptor: u8 dtype-descr length (0 = None),
+    #          dtype descr ascii (np.dtype.str, e.g. "<f4"), u8 ndim,
+    #          int64 × ndim shape
+    #   raw    each present array's bytes, in (keys, vals, lens) order,
+    #          each block starting at the next 8-byte-aligned offset
+    #          (alignment keeps np.frombuffer views fast), no trailing
+    #          pad after the last block
+    #
+    # The payload crosses the encoder with ZERO copies: ``to_frames``
+    # returns [prelude, pad?, arr.view, ...] and the TCP fabric
+    # scatter-gathers them onto the socket.  ``from_bytes`` over a
+    # writeable receive buffer returns np.frombuffer VIEWS — the
+    # decoded arrays alias the buffer, stay writeable, and flow into
+    # the server's ``donated`` adopt-or-copy contract without a copy.
+    # v1 frames (np.save blobs, pre-PR-5 peers) still decode.
     _HDR = struct.Struct("<B B i i q B B B i i q q q q q B q q q q q q q")
+    _V2_MAGIC = -20206
+    _DTYPE_WHITELIST = frozenset("?bhilqBHILQefdg")  # bool/int/uint/float
 
-    def to_bytes(self) -> bytes:
-        buf = io.BytesIO()
-        meta = {
+    def _meta_blob(self) -> bytes:
+        return pickle.dumps({
             "sender": str(self.sender) if self.sender else "",
             "recipient": str(self.recipient) if self.recipient else "",
             "body": self.body,
             "compr": self.compr,
-        }
-        meta_b = pickle.dumps(meta, protocol=4)
+        }, protocol=4)
+
+    def _pack_hdr(self) -> bytes:
         flags = ((self.request << 0) | (self.push << 1) | (self.pull << 2)
                  | (self.sampled << 3))
+        return self._HDR.pack(
+            self.control.value, self.domain.value, self.app_id, self.customer_id,
+            self.timestamp, flags, 0, 0, self.cmd, self.priority,
+            self.first_key, self.seq, self.seq_begin, self.seq_end,
+            self.total_bytes, self.channel, self.val_bytes, self.msg_sig,
+            self.boot, self.trace_id, self.span_id, self.parent_span_id,
+            self.policy_epoch,
+        )
+
+    def to_frames(self) -> list:
+        """Serialize to a scatter-gather buffer list (v2): one small
+        prelude + each payload array's own memory, uncopied.  The
+        caller must finish transmitting before mutating the arrays
+        (the fabric sends synchronously, so this holds)."""
+        prelude = io.BytesIO()
+        prelude.write(struct.pack("<i", self._V2_MAGIC))
+        prelude.write(self._pack_hdr())
+        meta_b = self._meta_blob()
+        prelude.write(struct.pack("<i", len(meta_b)))
+        prelude.write(meta_b)
+        arrs = []
+        for a in (self.keys, self.vals, self.lens):
+            if a is None:
+                prelude.write(b"\x00")
+                arrs.append(None)
+                continue
+            a = np.asarray(a)
+            if not a.flags.c_contiguous:
+                # the only copy on the encode path; 0-d arrays are
+                # always contiguous (ascontiguousarray would 1-d them)
+                a = np.ascontiguousarray(a)
+            if a.dtype.char not in self._DTYPE_WHITELIST:
+                raise TypeError(
+                    f"non-plain dtype {a.dtype} cannot ride the wire")
+            descr = a.dtype.str.encode("ascii")
+            prelude.write(struct.pack("<B", len(descr)))
+            prelude.write(descr)
+            prelude.write(struct.pack("<B", a.ndim))
+            for d in a.shape:
+                prelude.write(struct.pack("<q", d))
+            arrs.append(a)
+        frames = [prelude.getvalue()]
+        off = len(frames[0])
+        for a in arrs:
+            if a is None or a.nbytes == 0:
+                continue
+            pad = -off % 8
+            if pad:
+                frames.append(b"\x00" * pad)
+                off += pad
+            frames.append(memoryview(a.reshape(-1).view(np.uint8)))
+            off += a.nbytes
+        return frames
+
+    def to_bytes(self) -> bytes:
+        if not WIRE_V2:
+            return self.to_bytes_v1()
+        return b"".join(bytes(f) if not isinstance(f, bytes) else f
+                        for f in self.to_frames())
+
+    def to_bytes_v1(self) -> bytes:
+        """Legacy (pre-PR-5) frame: np.save blobs per array.  Kept so
+        old frames can be GENERATED for compat tests and so the serde
+        microbench can measure both formats in one run
+        (``GEOMX_WIRE_FORMAT=v1`` flips to_bytes to this path)."""
+        buf = io.BytesIO()
+        meta_b = self._meta_blob()
         arrs = []
         for a in (self.keys, self.vals, self.lens):
             if a is None:
@@ -222,14 +322,7 @@ class Message:
                 with io.BytesIO() as ab:
                     np.save(ab, a, allow_pickle=False)
                     arrs.append(ab.getvalue())
-        hdr = self._HDR.pack(
-            self.control.value, self.domain.value, self.app_id, self.customer_id,
-            self.timestamp, flags, 0, 0, self.cmd, self.priority,
-            self.first_key, self.seq, self.seq_begin, self.seq_end,
-            self.total_bytes, self.channel, self.val_bytes, self.msg_sig,
-            self.boot, self.trace_id, self.span_id, self.parent_span_id,
-            self.policy_epoch,
-        )
+        hdr = self._pack_hdr()
         buf.write(struct.pack("<i", len(hdr)))
         buf.write(hdr)
         for blob in (meta_b, *arrs):
@@ -238,18 +331,104 @@ class Message:
         return buf.getvalue()
 
     @classmethod
-    def from_bytes(cls, data: bytes) -> "Message":
-        off = 0
-        (hlen,) = struct.unpack_from("<i", data, off); off += 4
-        fields = cls._HDR.unpack_from(data, off); off += hlen
+    def _unpack_hdr(cls, data, off: int) -> dict:
         (control, domain, app_id, customer_id, timestamp, flags, _, _, cmd,
          priority, first_key, seq, seq_begin, seq_end, total_bytes, channel,
          val_bytes, msg_sig, boot, trace_id, span_id, parent_span_id,
-         policy_epoch) = fields
+         policy_epoch) = cls._HDR.unpack_from(data, off)
+        return dict(
+            control=Control(control), domain=Domain(domain), app_id=app_id,
+            customer_id=customer_id, timestamp=timestamp,
+            request=bool(flags & 1), push=bool(flags & 2),
+            pull=bool(flags & 4), sampled=bool(flags & 8),
+            cmd=cmd, priority=priority,
+            first_key=first_key, seq=seq, seq_begin=seq_begin,
+            seq_end=seq_end, channel=channel, total_bytes=total_bytes,
+            val_bytes=val_bytes, msg_sig=msg_sig, boot=boot,
+            trace_id=trace_id, span_id=span_id,
+            parent_span_id=parent_span_id, policy_epoch=policy_epoch,
+        )
+
+    @classmethod
+    def from_bytes(cls, data) -> "Message":
+        """Decode a frame (v2 or legacy v1, auto-detected).
+
+        ``data`` may be bytes, bytearray or memoryview.  v2 payload
+        arrays are ZERO-COPY views of ``data``: pass the receive
+        buffer itself (a writeable bytearray on the TCP path) and the
+        decoded arrays alias it, writeable, satisfying the ``donated``
+        adopt contract with no memcpy.  Read-only input (a UDP
+        datagram's bytes) yields read-only views; the adopt gate then
+        takes its defensive copy."""
+        (first,) = struct.unpack_from("<i", data, 0)
+        if first != cls._V2_MAGIC:
+            return cls._from_bytes_v1(data, first)
+        off = 4
+        fields = cls._unpack_hdr(data, off)
+        off += cls._HDR.size
+        (meta_len,) = struct.unpack_from("<i", data, off)
+        off += 4
+        if meta_len < 0 or off + meta_len > len(data):
+            raise ValueError("truncated v2 frame (meta)")
+        meta = pickle.loads(bytes(data[off:off + meta_len]))
+        off += meta_len
+        descrs = []
+        for _ in range(3):
+            (dlen,) = struct.unpack_from("<B", data, off)
+            off += 1
+            if dlen == 0:
+                descrs.append(None)
+                continue
+            if off + dlen + 1 > len(data):
+                raise ValueError("truncated v2 frame (descriptor)")
+            dt = np.dtype(bytes(data[off:off + dlen]).decode("ascii"))
+            off += dlen
+            (ndim,) = struct.unpack_from("<B", data, off)
+            off += 1
+            shape = struct.unpack_from(f"<{ndim}q", data, off)
+            off += 8 * ndim
+            descrs.append((dt, tuple(shape)))
+        arrs = []
+        for d in descrs:
+            if d is None:
+                arrs.append(None)
+                continue
+            dt, shape = d
+            count = 1
+            for s in shape:
+                count *= s
+            if count:
+                off += -off % 8
+                if off + count * dt.itemsize > len(data):
+                    raise ValueError("truncated v2 frame (payload)")
+            a = np.frombuffer(data, dtype=dt, count=count, offset=off)
+            off += count * dt.itemsize
+            if len(shape) != 1:
+                a = a.reshape(shape)
+            arrs.append(a)
+        return cls(
+            sender=NodeId.parse(meta["sender"]) if meta["sender"] else None,
+            recipient=(NodeId.parse(meta["recipient"])
+                       if meta["recipient"] else None),
+            body=meta["body"], compr=meta["compr"],
+            keys=arrs[0], vals=arrs[1], lens=arrs[2],
+            donated=True,  # deserialized buffers are exclusively ours
+            **fields,
+        )
+
+    @classmethod
+    def _from_bytes_v1(cls, data, hlen: int) -> "Message":
+        if not 0 < hlen <= 4096:
+            raise ValueError(f"bad frame header length {hlen}")
+        off = 4
+        fields = cls._unpack_hdr(data, off)
+        off += hlen
         blobs = []
         for _ in range(4):
             (blen,) = struct.unpack_from("<q", data, off); off += 8
-            blobs.append(data[off:off + blen]); off += blen
+            if blen < 0 or off + blen > len(data):
+                raise ValueError("truncated v1 frame")
+            blobs.append(bytes(data[off:off + blen])); off += blen
         meta = pickle.loads(blobs[0])
         arrs = []
         for blob in blobs[1:]:
@@ -259,17 +438,10 @@ class Message:
                 arrs.append(np.load(io.BytesIO(blob), allow_pickle=False))
         return cls(
             sender=NodeId.parse(meta["sender"]) if meta["sender"] else None,
-            recipient=NodeId.parse(meta["recipient"]) if meta["recipient"] else None,
-            control=Control(control), domain=Domain(domain), app_id=app_id,
-            customer_id=customer_id, timestamp=timestamp,
-            request=bool(flags & 1), push=bool(flags & 2), pull=bool(flags & 4),
-            cmd=cmd, priority=priority, body=meta["body"],
+            recipient=(NodeId.parse(meta["recipient"])
+                       if meta["recipient"] else None),
+            body=meta["body"], compr=meta["compr"],
             keys=arrs[0], vals=arrs[1], lens=arrs[2],
-            first_key=first_key, seq=seq, seq_begin=seq_begin, seq_end=seq_end,
-            channel=channel, total_bytes=total_bytes, val_bytes=val_bytes,
-            compr=meta["compr"], msg_sig=msg_sig, boot=boot,
-            policy_epoch=policy_epoch,
-            trace_id=trace_id, span_id=span_id,
-            parent_span_id=parent_span_id, sampled=bool(flags & 8),
-            donated=True,  # deserialized buffers are exclusively ours
+            donated=True,
+            **fields,
         )

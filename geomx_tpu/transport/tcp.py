@@ -27,6 +27,7 @@ import time
 from typing import Dict, Optional, Tuple
 
 from geomx_tpu.core.config import Config, NodeId, Topology
+from geomx_tpu.transport import message as _message
 from geomx_tpu.transport.message import Message
 from geomx_tpu.transport.van import FaultPolicy, _Mailbox
 
@@ -230,10 +231,15 @@ class TcpFabric:
                 hdr = self._recv_exact(conn, 8)
                 if hdr is None:
                     return
-                (n,) = struct.unpack("<q", hdr)
+                (n,) = struct.unpack("<q", bytes(hdr))
                 data = self._recv_exact(conn, n)
                 if data is None:
                     return
+                # the frame buffer is a WRITEABLE bytearray this loop
+                # never touches again: from_bytes returns zero-copy
+                # np.frombuffer views over it, and the message's
+                # ``donated`` contract lets the server adopt them as
+                # its accumulators without a defensive copy
                 box.q.put(Message.from_bytes(data))
         except OSError:
             return  # connection torn down (peer reset or fabric shutdown)
@@ -249,13 +255,18 @@ class TcpFabric:
                     pass
 
     @staticmethod
-    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
-        buf = b""
-        while len(buf) < n:
-            chunk = conn.recv(n - len(buf))
-            if not chunk:
+    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytearray]:
+        """Read exactly ``n`` bytes into a fresh writeable buffer via
+        recv_into — no per-chunk bytes objects, no quadratic b"" +=
+        reassembly, and the result can back zero-copy array views."""
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
+            r = conn.recv_into(view[got:], n - got)
+            if not r:
                 return None
-            buf += chunk
+            got += r
         return buf
 
     # ---- send side ----------------------------------------------------------
@@ -282,10 +293,20 @@ class TcpFabric:
             return True
         if dest not in self.plan:
             raise KeyError(f"no mailbox for {msg.recipient}")
-        data = msg.to_bytes()
-        if msg.channel >= 1 and len(data) <= self.UDP_MAX:
+        # scatter-gather: the payload arrays go onto the socket as their
+        # own iovecs — no getvalue()/concat copy of a multi-hundred-MB
+        # frame anywhere on the send path (the length prefix and prelude
+        # share the first small buffer)
+        if _message.WIRE_V2:
+            frames = msg.to_frames()
+            total = sum(memoryview(f).nbytes for f in frames)
+        else:  # v1-pinned encoder (GEOMX_WIRE_FORMAT=v1)
+            frames = [msg.to_bytes()]
+            total = len(frames[0])
+        if msg.channel >= 1 and total <= self.UDP_MAX:
             # lossy DGT channel: one best-effort datagram, no dial, no
             # retransmit; send failures are losses by design
+            data = b"".join(bytes(f) for f in frames)
             host, port = self.plan[dest]
             try:
                 self._udp_sock(msg.channel).sendto(data, (host, port))
@@ -296,7 +317,7 @@ class TcpFabric:
             with self._registry_mu:
                 self.udp_datagrams_sent += 1
             return True
-        frame = struct.pack("<q", len(data)) + data
+        frames.insert(0, struct.pack("<q", total))
         with self._registry_mu:
             mu = self._conn_mus.setdefault(dest, threading.Lock())
         with mu:
@@ -304,16 +325,34 @@ class TcpFabric:
             if conn is None:
                 conn = self._dial(dest)
             try:
-                conn.sendall(frame)
+                self._sendmsg_all(conn, frames)
             except OSError:
                 # peer restarted: redial once; drop the dead socket from
                 # the registry first so a failed redial doesn't leave it
-                # there for every later send to trip over
+                # there for every later send to trip over.  Resending
+                # from frame 0 on the FRESH stream is safe — the broken
+                # connection dies with whatever partial frame it carried
                 conn.close()
                 self._conns.pop(dest, None)
                 conn = self._dial(dest)
-                conn.sendall(frame)
+                self._sendmsg_all(conn, frames)
         return True
+
+    @staticmethod
+    def _sendmsg_all(conn: socket.socket, frames) -> None:
+        """sendall for a buffer list: one sendmsg gathers every iovec;
+        short writes advance into the list without copying."""
+        bufs = [memoryview(f).cast("B") for f in frames]
+        while bufs:
+            sent = conn.sendmsg(bufs)
+            while sent > 0 and bufs:
+                n = bufs[0].nbytes
+                if sent >= n:
+                    sent -= n
+                    bufs.pop(0)
+                else:
+                    bufs[0] = bufs[0][sent:]
+                    sent = 0
 
     # connect errors worth waiting out during bring-up; anything else
     # (DNS failure, ENETUNREACH, …) is a config error and raises at once

@@ -10,7 +10,11 @@ responsibilities are split:
   van.cc:497-499,871-877), used by tests and single-host simulation of a
   multi-party deployment (the reference tests the same way via
   pseudo-distributed scripts, ref: docs/source/pseudo-distributed-deployment.rst).
-- ``TcpFabric`` (transport/tcp.py) — real sockets for multi-host runs.
+- ``TcpFabric`` (transport/tcp.py) — real sockets for multi-host runs,
+  wire format v2: scatter-gather sends (payload arrays go out as their
+  own iovecs, no frame-assembly copy) and zero-copy receive (decoded
+  arrays are np.frombuffer views over the writeable receive buffer,
+  flowing into the servers' ``Message.donated`` adopt contract).
 - ``Van``           — per-node endpoint: send/recv threads, priority queue
   drain (ref: van.cc:851-860), ACK/resend (ref: resender.h), byte counters
   (ref: van.h:180-181 send_bytes_/recv_bytes_).

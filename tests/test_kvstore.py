@@ -331,12 +331,18 @@ def test_multikey_pull_across_separate_inits():
         assert served == []
         with gs._mu:
             gs.store[5] = np.zeros(4, np.float32)
-            gs._serve_parked_pulls_locked(5)
+            # the sharded server returns still-blocked pulls; callers
+            # re-park them under a key that is missing NOW (the same
+            # no-orphaning invariant, split so the re-park can take the
+            # blocking key's stripe outside this one)
+            for m in gs._serve_parked_pulls_locked(5):
+                gs._park_pull(m)
         assert served == []  # key 9 still missing; must now be parked on 9
         with gs._mu:
             assert any(m is msg for m in gs._keys[9].parked_pulls)
             gs.store[9] = np.zeros(4, np.float32)
-            gs._serve_parked_pulls_locked(9)
+            for m in gs._serve_parked_pulls_locked(9):
+                gs._park_pull(m)
         assert served == [msg]
     finally:
         sim.shutdown()

@@ -1,0 +1,122 @@
+"""Wire format v2: self-describing raw array framing (PR 5 tentpole).
+
+Pins the three properties the zero-copy wire path rests on:
+(1) property-style roundtrip over dtypes/shapes (0-d, empty,
+    non-contiguous included) — bit-exact values, preserved shape/dtype;
+(2) v1↔v2 compatibility — the decoder auto-detects legacy np.save
+    frames, and GEOMX_WIRE_FORMAT=v1 pins the encoder for mixed-version
+    rollouts;
+(3) the zero-copy guard — decoding a WRITEABLE receive buffer yields
+    np.frombuffer VIEWS of it (aligned, writeable) that the servers'
+    ``donated`` adopt gate takes WITHOUT a copy.  A regression back to
+    copying fails here loudly, long before it shows up as wall-clock.
+"""
+
+import numpy as np
+import pytest
+
+from geomx_tpu.core.config import NodeId, Role
+from geomx_tpu.transport import message as message_mod
+from geomx_tpu.transport.message import Control, Domain, Message
+
+
+def _msg(vals, keys=None, lens=None, **kw):
+    vals = np.asarray(vals)
+    if keys is None:
+        keys = np.array([7], np.int64)
+    if lens is None:
+        lens = np.array([vals.size], np.int64)
+    kw.setdefault("sender", NodeId(Role.WORKER, 1, 0))
+    kw.setdefault("recipient", NodeId(Role.SERVER, 0, 0))
+    return Message(keys=np.asarray(keys, np.int64), vals=vals,
+                   lens=np.asarray(lens, np.int64), push=True,
+                   request=True, **kw)
+
+
+PAYLOADS = [
+    np.arange(17, dtype=np.float32),
+    np.arange(17, dtype=np.float16),
+    np.arange(17, dtype=np.uint8),
+    np.arange(17, dtype=np.int64),
+    np.ones((), np.float32) * 2.5,          # 0-d
+    np.empty(0, np.float32),                # empty
+    np.arange(64, dtype=np.float32)[::2],   # non-contiguous view
+    np.asfortranarray(np.arange(24, dtype=np.float32).reshape(4, 6)),
+]
+
+
+@pytest.mark.parametrize("arr", PAYLOADS,
+                         ids=lambda a: f"{a.dtype}-{a.shape}")
+def test_roundtrip_property(arr):
+    m = _msg(arr, body={"num_merge": 2}, compr="fp16")
+    for raw in (m.to_bytes(), m.to_bytes_v1()):
+        m2 = Message.from_bytes(raw)
+        assert m2.vals.dtype == arr.dtype
+        assert m2.vals.shape == arr.shape
+        np.testing.assert_array_equal(np.ascontiguousarray(m2.vals),
+                                      np.ascontiguousarray(arr))
+        assert m2.body == {"num_merge": 2} and m2.compr == "fp16"
+        assert m2.sender == m.sender and m2.donated
+
+
+def test_v1_frame_decodes_and_v1_pin_roundtrips(monkeypatch):
+    """Old frames still decode (auto-detect), and the compat flag pins
+    the ENCODER to v1 so a mixed-version rollout can upgrade either
+    side first."""
+    m = _msg(np.arange(9, dtype=np.float32))
+    old = Message.from_bytes(m.to_bytes_v1())
+    np.testing.assert_array_equal(old.vals, m.vals)
+    monkeypatch.setattr(message_mod, "WIRE_V2", False)
+    pinned = m.to_bytes()
+    # a v1 frame leads with the positive header length, not the magic
+    import struct
+
+    (first,) = struct.unpack_from("<i", pinned, 0)
+    assert first > 0
+    back = Message.from_bytes(pinned)
+    np.testing.assert_array_equal(back.vals, m.vals)
+
+
+def test_zero_copy_deserialization_guard():
+    """THE tier-1 zero-copy guard: decoded ``vals`` must be a view of
+    the receive buffer — writeable (when the buffer is), 8-byte
+    aligned, and adopted as-is by the server's adopt-or-copy gate."""
+    from geomx_tpu.kvstore.server import _adopt_or_copy
+
+    vals = np.arange(4096, dtype=np.float32)
+    buf = bytearray(_msg(vals).to_bytes())  # the TCP recv path's buffer
+    m = Message.from_bytes(buf)
+    assert np.shares_memory(m.vals, np.frombuffer(buf, np.uint8)), (
+        "decode copied: vals no longer aliases the receive buffer")
+    assert m.vals.flags.writeable
+    assert m.vals.ctypes.data % 8 == 0, "payload lost its alignment pad"
+    assert m.donated
+    adopted = _adopt_or_copy(m.vals, m.donated)
+    assert adopted is m.vals, (
+        "adopt gate copied a donated writeable wire view")
+    # read-only input (a UDP datagram's bytes) must yield read-only
+    # views and force the defensive copy instead
+    m_ro = Message.from_bytes(bytes(buf))
+    assert not m_ro.vals.flags.writeable
+    assert _adopt_or_copy(m_ro.vals, m_ro.donated) is not m_ro.vals
+
+
+def test_scatter_gather_frames_are_uncopied_views():
+    """to_frames must hand the payload array's own memory to the
+    socket layer (the no-getvalue()-copy half of the wire path)."""
+    vals = np.arange(1 << 16, dtype=np.float32)
+    m = _msg(vals)
+    frames = m.to_frames()
+    assert any(np.shares_memory(np.frombuffer(f, np.uint8), vals)
+               for f in frames if not isinstance(f, bytes)), (
+        "payload was copied into the frame list")
+    # and the joined frames ARE the to_bytes() encoding
+    joined = b"".join(bytes(f) for f in frames)
+    np.testing.assert_array_equal(Message.from_bytes(joined).vals, vals)
+
+
+def test_non_plain_dtypes_are_refused():
+    m = _msg(np.array([object()], dtype=object),
+             lens=np.array([1], np.int64))
+    with pytest.raises(TypeError):
+        m.to_bytes()
