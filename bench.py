@@ -1429,6 +1429,68 @@ def child_parity():
     }))
 
 
+def child_shards():
+    """``flagship_50m_round_wall_s`` vs global shard count (1/2/4): the
+    horizontally-sharded global tier's scaling axis — near-linear
+    round-wall scaling with shard count at high party counts is the win
+    condition every subsequent scale claim is measured against.  Same
+    50M-element (200 MB fp32) BSC workload as the wan child's flagship
+    ledger, swept over ``global_shards``, plus the per-shard
+    replication-lag/promotion registry counters next to the wall
+    times."""
+    import numpy as np
+
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.kvstore import Simulation
+    from geomx_tpu.utils.metrics import system_snapshot
+
+    N_FLAG = int(os.environ.get("BENCH_SHARDS_ELEMS", "50000000"))
+    sweep = {}
+    for shards in (1, 2, 4):
+        sim = Simulation(Config(
+            topology=Topology(num_parties=2, workers_per_party=1),
+            global_shards=shards))
+        try:
+            ws = sim.all_workers()
+            for w in ws:
+                w.init(0, np.zeros(N_FLAG, np.float32))
+            ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+            for p in range(2):
+                sim.worker(p, 0).set_gradient_compression(
+                    {"type": "bsc", "ratio": 0.01})
+            g = np.abs(np.random.default_rng(1)
+                       .standard_normal(N_FLAG)).astype(np.float32)
+
+            def one_round() -> float:
+                t0 = time.perf_counter()
+                for w in ws:
+                    w.push(0, g)
+                for w in ws:
+                    w.pull_sync(0)
+                    w.wait_all()
+                return time.perf_counter() - t0
+
+            # round 1 pays one-time costs + a dense pull resync (see the
+            # wan child's flagship ledger); steady = best of two
+            cold = one_round()
+            dt = min(one_round(), one_round())
+            sweep[str(shards)] = {"round_wall_s": round(dt, 3),
+                                  "round_wall_s_cold": round(cold, 3)}
+        finally:
+            sim.shutdown()
+    base = sweep["1"]["round_wall_s"]
+    print(json.dumps({
+        "tensor_elems": N_FLAG,
+        "flagship_50m_round_wall_s": {k: v["round_wall_s"]
+                                      for k, v in sweep.items()},
+        "speedup_vs_1shard": {
+            k: round(base / max(v["round_wall_s"], 1e-9), 2)
+            for k, v in sweep.items()},
+        "sweep": sweep,
+        "per_shard_registry": system_snapshot("global_shard"),
+    }))
+
+
 def child_stress():
     """Server merge throughput at scale (VERDICT r1 item 5): one party of
     4 workers pushing a 50M-element tensor (200 MB) through the two-tier
@@ -1769,7 +1831,8 @@ def _build_record() -> dict:
                       ("flash_autotune", "flash_autotune"),
                       ("stress", "stress"), ("lm", "lm"),
                       ("scaling", "scaling"), ("parity", "parity"),
-                      ("serde", "serde"), ("probe", "probe")):
+                      ("serde", "serde"), ("shards", "shards"),
+                      ("probe", "probe")):
         if name in _results:
             record[key] = _results[name]
         elif name in TPU_CHILDREN and name in lkg:
@@ -1823,6 +1886,9 @@ def _compact(record: dict) -> dict:
     par = record.get("parity") or {}
     if par.get("worst_delta"):
         out["parity_worst_accuracy_delta"] = par["worst_delta"]
+    sh = record.get("shards") or {}
+    if sh.get("flagship_50m_round_wall_s"):
+        out["shards_round_wall_s"] = sh["flagship_50m_round_wall_s"]
     sd = record.get("serde") or {}
     if sd.get("speedup_encode"):
         out["serde_speedup"] = {"encode": sd["speedup_encode"],
@@ -1978,7 +2044,7 @@ def main():
                     choices=["cnn", "mfu", "mfu_sweep", "quant", "wan",
                              "overlap", "overlap_tpu", "stress", "probe",
                              "flash_autotune", "lm", "scaling", "parity",
-                             "serde"])
+                             "serde", "shards"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -2003,6 +2069,7 @@ def main():
          "overlap_tpu": child_overlap_tpu, "stress": child_stress,
          "probe": child_probe, "lm": child_lm, "scaling": child_scaling,
          "parity": child_parity, "serde": child_serde,
+         "shards": child_shards,
          "flash_autotune": child_flash_autotune}[args.child]()
         return
 
@@ -2101,6 +2168,7 @@ def main():
         _do("scaling", 260, scaling_env)
         _do("parity", 280, cpu_env)
         _do("stress", 180, cpu_env)
+        _do("shards", 240, cpu_env)
 
     cpu_thread = threading.Thread(target=cpu_chain, daemon=True)
     cpu_thread.start()

@@ -102,12 +102,16 @@ class AdaptiveWanController:
             f"{postoffice.node}.wan_policy_{a}s")
             for a in ("downshift", "upshift", "manual")}
         self.refused = 0   # servers that rejected a policy (constraint)
-        # global-tier failover: a promoted standby replaces its primary
-        # in the broadcast target set (tracked from the NEW_PRIMARY
-        # broadcasts the failover monitor — on this same postoffice —
-        # sends everyone); _broadcast_missing then reaches the new node
-        self._gs_replaced: Dict[str, str] = {}
-        postoffice.add_control_hook(self._on_new_primary)
+        # global-tier failover / key-range reassignment: a promoted
+        # standby (or a drain's merge target) replaces the old holder in
+        # the broadcast target set.  ShardTargets is the shared
+        # NEW_PRIMARY tracker every shard-addressing component uses (the
+        # failover monitor self-delivers its broadcasts so this hook
+        # fires even though both live on the same postoffice);
+        # _broadcast_missing then reaches the new node
+        from geomx_tpu.kvstore.replication import ShardTargets
+
+        self._shard_targets = ShardTargets(postoffice)
         self._app = _CmdEndpoint(APP_PS, _CTRL_CUSTOMER, postoffice)
         self._stop = threading.Event()
         self._thread = None
@@ -218,34 +222,17 @@ class AdaptiveWanController:
         body["compression"] = {**defaults, **body["compression"]}
         return body
 
-    def _on_new_primary(self, msg: Message) -> bool:
-        from geomx_tpu.transport.message import Control
-
-        if msg.control is Control.NEW_PRIMARY and not msg.request:
-            b = msg.body if isinstance(msg.body, dict) else {}
-            if b.get("old") and b.get("new"):
-                with self._mu:
-                    self._gs_replaced[str(b["old"])] = str(b["new"])
-        return False  # observe only — every other hook still sees it
-
     def _targets(self) -> List:
-        """Receivers FIRST (global servers adopt immediately), then the
+        """Receivers FIRST (the CURRENT holder of every global shard —
+        failover- and reassignment-aware — adopts immediately), then the
         senders (local servers, apply at their next round boundary) —
         the ordering that makes an in-flight old-epoch push the rare
-        case rather than the common one."""
-        from geomx_tpu.core.config import NodeId
-
-        with self._mu:
-            replaced = dict(self._gs_replaced)
-        gs = []
-        for n in self.topology.global_servers():
-            s = str(n)
-            for _ in range(8):  # chained failovers resolve transitively
-                if s not in replaced:
-                    break
-                s = replaced[s]
-            gs.append(NodeId.parse(s))
-        return gs + list(self.topology.servers())
+        case rather than the common one.  One policy epoch covers every
+        shard: the broadcast walks all holders under the same epoch
+        number, so cross-shard pushes of one round can never straddle
+        two codecs."""
+        return (self._shard_targets.global_servers()
+                + list(self.topology.servers()))
 
     def _broadcast(self, epoch: int, compression: dict):
         body = self._policy_body(epoch, compression)

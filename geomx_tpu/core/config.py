@@ -289,6 +289,19 @@ class Config:
 
     # --- sharding (ref: kvstore_dist.h:69 MXNET_KVSTORE_BIGARRAY_BOUND)
     bigarray_bound: int = 1_000_000
+    # --- horizontal global tier (MultiGPS, ref: README.md:40 /
+    # Postoffice::GetServerKeyRanges postoffice.cc:246-259).  The
+    # first-class knob for "how many independent global servers shard
+    # the key space": 0 = follow topology.num_global_servers.  A
+    # positive value (field or GEOMX_GLOBAL_SHARDS) re-shards an
+    # UNSHARDED topology (num_global_servers == 1) to M shards, each
+    # with its own key range, standby chain and failure domain — a
+    # topology constructed with an explicit num_global_servers > 1
+    # always wins.  The env fallback mirrors GEOMX_SERVER_SHARDS: a
+    # whole test suite can be shaken under a sharded global tier
+    # (GEOMX_GLOBAL_SHARDS=2 pytest ...) without threading the knob
+    # through every fixture (scripts/run_shard_smoke.sh).
+    global_shards: int = 0
 
     # --- P3 (ref: van.cc:539-549 ENABLE_P3; kvstore_dist.h:763-799)
     enable_p3: bool = False
@@ -340,6 +353,21 @@ class Config:
     #                               requests after this many seconds
     #                               (application-level replay; servers
     #                               dedup by (sender, ts))
+    retry_backoff_cap: int = 8    # replay backoff multiplier cap: the
+    #                               n-th unanswered replay waits
+    #                               request_retry_s * min(2**n, cap).
+    #                               Chaos soaks tighten it so a killed
+    #                               shard's replays land inside the test
+    #                               window (GEOMX_RETRY_BACKOFF_CAP)
+    retry_jitter: float = 0.1     # random extra fraction [0, jitter)
+    #                               added to each replay backoff so a
+    #                               whole party's replays don't
+    #                               stampede a freshly promoted shard
+    #                               in lockstep.  Deterministic mode
+    #                               forces 0 (GEOMX_RETRY_JITTER)
+    policy_fence_max_retries: int = 5  # adaptive-WAN fence retries per
+    #                               push group before the loud drop
+    #                               (GEOMX_POLICY_FENCE_MAX_RETRIES)
     checkpoint_dir: str = ""      # where global servers save/resume state
     auto_ckpt_updates: int = 0    # 0 = off; else checkpoint every N
     #                               optimizer updates (key-rounds)
@@ -425,6 +453,36 @@ class Config:
     verbose: int = 0
 
     def __post_init__(self):
+        # resolve the global-shard count: explicit field, else env
+        # (GEOMX_GLOBAL_SHARDS shakes directly-constructed configs too),
+        # applied only to an UNSHARDED topology — a test or launcher
+        # that spelled out num_global_servers keeps exactly that shape
+        shards = int(self.global_shards or 0)
+        if shards <= 0:
+            shards = _env_int("GEOMX_GLOBAL_SHARDS", 0)
+        if shards < 0:
+            raise ValueError("global_shards must be >= 0 (0 = follow "
+                             "topology.num_global_servers)")
+        if shards >= 1 and self.topology.num_global_servers == 1 \
+                and shards != self.topology.num_global_servers:
+            self.topology = dataclasses.replace(
+                self.topology, num_global_servers=shards)
+        self.global_shards = self.topology.num_global_servers
+        # env overrides for the replay/backoff tuning knobs (the chaos
+        # soaks tighten these without editing source; env wins so one
+        # shell line covers directly-constructed Configs too)
+        self.retry_backoff_cap = _env_int(
+            "GEOMX_RETRY_BACKOFF_CAP", self.retry_backoff_cap)
+        self.retry_jitter = _env_float(
+            "GEOMX_RETRY_JITTER", self.retry_jitter)
+        self.policy_fence_max_retries = _env_int(
+            "GEOMX_POLICY_FENCE_MAX_RETRIES", self.policy_fence_max_retries)
+        if self.retry_backoff_cap < 1:
+            raise ValueError("retry_backoff_cap must be >= 1")
+        if self.retry_jitter < 0.0:
+            raise ValueError("retry_jitter must be >= 0")
+        if self.policy_fence_max_retries < 0:
+            raise ValueError("policy_fence_max_retries must be >= 0")
         if not 0.0 <= self.drop_rate <= 1.0:
             raise ValueError(
                 f"drop_rate must be a fraction in [0,1], got {self.drop_rate} "
@@ -492,7 +550,9 @@ class Config:
                 "GEOMX_WORKERS_PER_PARTY", _env_int("DMLC_NUM_WORKER", 1)
             ),
             num_global_servers=_env_int(
-                "GEOMX_NUM_GLOBAL_SERVERS", _env_int("DMLC_NUM_GLOBAL_SERVER", 1)
+                "GEOMX_GLOBAL_SHARDS",
+                _env_int("GEOMX_NUM_GLOBAL_SERVERS",
+                         _env_int("DMLC_NUM_GLOBAL_SERVER", 1)),
             ),
             num_standby_globals=_env_int("GEOMX_NUM_STANDBY_GLOBALS", 0),
             central_worker=_env_bool(
@@ -546,6 +606,10 @@ class Config:
                 _env_int("PS_RESEND_TIMEOUT", 1000) if _env_bool("PS_RESEND") else 0,
             ),
             request_retry_s=_env_float("GEOMX_REQUEST_RETRY_S", 0.0),
+            retry_backoff_cap=_env_int("GEOMX_RETRY_BACKOFF_CAP", 8),
+            retry_jitter=_env_float("GEOMX_RETRY_JITTER", 0.1),
+            policy_fence_max_retries=_env_int(
+                "GEOMX_POLICY_FENCE_MAX_RETRIES", 5),
             checkpoint_dir=os.environ.get("GEOMX_CHECKPOINT_DIR", ""),
             auto_ckpt_updates=_env_int("GEOMX_AUTO_CKPT_UPDATES", 0),
             replicate_every=_env_int("GEOMX_REPLICATE_EVERY", 1),

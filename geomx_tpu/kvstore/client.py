@@ -661,10 +661,30 @@ class WorkerKVStore:
             self.po.barrier(Group.WORKERS)
 
     # ---- control plane (master-worker commands) -----------------------------
+    def global_targets(self) -> List[NodeId]:
+        """Current primary of every global shard, deduplicated (a
+        key-range drain can merge two shards onto one holder).  Control
+        commands are fire-once — no replay layer covers them — so they
+        must address each shard's LIVE holder (the NEW_PRIMARY-tracked
+        view from ``_failover_hook``), not the static plan primary: a
+        worker configuring right after a shard failed over would
+        otherwise hang on a corpse."""
+        with self._mu:
+            prim = dict(self.global_primaries)
+        out: List[NodeId] = []
+        seen = set()
+        for gs in self.po.topology.global_servers():
+            cur = prim.get(gs.rank)
+            node = NodeId.parse(cur) if cur else gs
+            if str(node) not in seen:
+                seen.add(str(node))
+                out.append(node)
+        return out
+
     def set_optimizer(self, opt_config: dict):
         """Ship the optimizer to every global server (ref:
         kvstore.py:452-499 set_optimizer pickles to the servers)."""
-        for gs in self.po.topology.global_servers():
+        for gs in self.global_targets():
             self.worker.send_cmd(gs, Ctrl.SET_OPTIMIZER, body=opt_config,
                                  domain=Domain.GLOBAL)
 
@@ -673,7 +693,7 @@ class WorkerKVStore:
         worker sends kSyncGlobalMode."""
         self.worker.send_cmd(self.po.topology.server(self.party),
                              Ctrl.SET_SYNC_MODE, body={"sync": local_sync})
-        for gs in self.po.topology.global_servers():
+        for gs in self.global_targets():
             self.worker.send_cmd(gs, Ctrl.SET_SYNC_GLOBAL_MODE,
                                  body={"sync": global_sync}, domain=Domain.GLOBAL)
 
@@ -698,7 +718,7 @@ class WorkerKVStore:
         }
         comp_config = {**defaults, **comp_config}
         targets = [(self.po.topology.server(self.party), Domain.LOCAL)]
-        targets += [(gs, Domain.GLOBAL) for gs in self.po.topology.global_servers()]
+        targets += [(gs, Domain.GLOBAL) for gs in self.global_targets()]
         for node, domain in targets:
             reply = self.worker.send_cmd(node, Ctrl.SET_COMPRESSION,
                                          body=comp_config, domain=domain)
@@ -737,7 +757,7 @@ class WorkerKVStore:
         targets = [(self.po.topology.server(self.party), Domain.LOCAL)]
         if include_global:
             targets += [(gs, Domain.GLOBAL)
-                        for gs in self.po.topology.global_servers()]
+                        for gs in self.global_targets()]
         # overlap the round-trips: send all, then collect
         tss = [self.worker.send_cmd(n, Ctrl.PROFILER, body=body,
                                     domain=d, wait=False)
@@ -758,12 +778,19 @@ class WorkerKVStore:
         self._checkpoint_cmd("load", directory)
 
     def _checkpoint_cmd(self, action: str, directory: str) -> List[str]:
-        """One overlapped round-trip to every global server."""
+        """One overlapped round-trip to every global server.  Paths stay
+        keyed by SHARD rank (the relaunch contract) while the command
+        addresses the shard's current holder."""
+        with self._mu:
+            prim = dict(self.global_primaries)
         jobs = []
         for gs in self.po.topology.global_servers():
             path = f"{directory}/global_server_{gs.rank}.npz"
+            node = (NodeId.parse(prim[gs.rank])
+                    if gs.rank in prim else gs)
             ts = self.worker.send_cmd(
-                gs, Ctrl.CHECKPOINT, body={"action": action, "path": path},
+                node, Ctrl.CHECKPOINT,
+                body={"action": action, "path": path},
                 domain=Domain.GLOBAL, wait=False)
             jobs.append((ts, path))
         paths = []
@@ -848,16 +875,26 @@ class MasterWorker:
         self.worker.retarget(NodeId.parse(b["old"]), NodeId.parse(b["new"]))
         return True
 
+    def _global_targets(self) -> List[NodeId]:
+        """Current holder of every shard: the KVWorker's target slots
+        track NEW_PRIMARY retargets; dedup covers drain-merged shards."""
+        out, seen = [], set()
+        for n in list(self.worker.targets):
+            if str(n) not in seen:
+                seen.add(str(n))
+                out.append(n)
+        return out
+
     def set_optimizer(self, opt_config: dict):
         """Ship the optimizer to every global server (the master worker's
         defining job, ref: kvstore.py:452-499 → kController command)."""
-        for gs in self.po.topology.global_servers():
+        for gs in self._global_targets():
             self.worker.send_cmd(gs, Ctrl.SET_OPTIMIZER, body=opt_config,
                                  domain=Domain.GLOBAL)
 
     def set_sync_global_mode(self, sync: bool):
         """ref: kvstore.cc:56-63 — the master worker sends kSyncGlobalMode."""
-        for gs in self.po.topology.global_servers():
+        for gs in self._global_targets():
             self.worker.send_cmd(gs, Ctrl.SET_SYNC_GLOBAL_MODE,
                                  body={"sync": sync}, domain=Domain.GLOBAL)
 
@@ -875,7 +912,7 @@ class MasterWorker:
         comp_config = {**defaults, **comp_config}
         targets = [(s, Domain.GLOBAL) for s in self.po.topology.servers()]
         targets += [(gs, Domain.GLOBAL)
-                    for gs in self.po.topology.global_servers()]
+                    for gs in self._global_targets()]
         for node, domain in targets:
             reply = self.worker.send_cmd(node, Ctrl.SET_COMPRESSION,
                                          body=comp_config, domain=domain)
@@ -887,7 +924,7 @@ class MasterWorker:
         sum; boolean stats AND (``optimizer_configured`` must mean EVERY
         shard is configured, or MultiGPS would silently mix optimizers)."""
         out: Dict[str, object] = {}
-        for gs in self.po.topology.global_servers():
+        for gs in self._global_targets():
             stats = self.worker.send_cmd(gs, Ctrl.QUERY_STATS,
                                          domain=Domain.GLOBAL) or {}
             for k, v in stats.items():

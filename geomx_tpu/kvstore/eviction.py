@@ -252,6 +252,14 @@ class LocalServerRecoveryMonitor(_HeartbeatActuator):
     def __init__(self, postoffice: Postoffice,
                  check_interval_s: Optional[float] = None):
         assert postoffice.node.role is Role.GLOBAL_SCHEDULER
+        # failover/reassignment-aware addressing: a party fold/unfold
+        # after a shard failed over must reach the shard's CURRENT
+        # holder, not the dead plan primary (a fold RPC the promoted
+        # standby never hears would leave its round targets wrong and
+        # stall every key of that shard)
+        from geomx_tpu.kvstore.replication import ShardTargets
+
+        self._shards = ShardTargets(postoffice)
         self._folded: Dict[int, int] = {}  # party -> boot at fold
         self._busy: set = set()
         self.party_folds = 0
@@ -305,7 +313,7 @@ class LocalServerRecoveryMonitor(_HeartbeatActuator):
 
     def _fold(self, party: int, boot: int):
         node_s = str(self.topology.server(party))
-        for gs in self.topology.global_servers():
+        for gs in self._shards.global_servers():
             self._rpc(gs, Control.EVICT,
                       {"action": "party_fold", "node": node_s},
                       Domain.GLOBAL)
@@ -329,7 +337,7 @@ class LocalServerRecoveryMonitor(_HeartbeatActuator):
         if reply is None or not reply.get("ok"):
             return  # not ready yet — the next sweep retries
         # 2. the party counts toward global rounds again
-        for gs in self.topology.global_servers():
+        for gs in self._shards.global_servers():
             self._rpc(gs, Control.EVICT,
                       {"action": "party_unfold", "node": str(node)},
                       Domain.GLOBAL)

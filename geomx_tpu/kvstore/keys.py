@@ -18,6 +18,16 @@ can push each key straight to its owning global shard.
 
 ps-key layout: ``shard * step + tensor_id * CHUNK_SPACE + chunk_idx`` where
 ``step = MAX_KEY // num_shards``.
+
+The shard count is config-driven: ``Config.global_shards`` /
+``GEOMX_GLOBAL_SHARDS`` / ``launch.py --global-shards`` set
+``Topology.num_global_servers``, and the assignment here is a pure
+deterministic function of (tensor_id, size, num_shards) — every node
+computes the identical plan with no coordination.  The range → SERVER
+binding is the dynamic half: ``split_range`` (ps/postoffice.py) maps
+range k to global server rank k at plan time, and per-shard failover /
+live key-range reassignment move a range's CURRENT holder at runtime
+(kvstore/replication.py) without touching the key encoding.
 """
 
 from __future__ import annotations

@@ -51,6 +51,67 @@ from geomx_tpu.utils.metrics import system_counter, system_gauge
 # customer id of the replication endpoint on a primary global server
 # (0 = the KVServer; local servers use 1 for their up-link worker)
 REPL_CUSTOMER_ID = 7
+# customer id of a draining holder's handoff ship endpoint (key-range
+# reassignment; distinct from REPL_CUSTOMER_ID — a primary may be
+# replicating to its standby AND draining at once)
+HANDOFF_CUSTOMER_ID = 8
+
+
+class ShardTargets:
+    """Failover-aware view of *who currently serves each global shard*.
+
+    The static plan says shard ``k`` is ``global_server:k``, but after a
+    promotion (PR 1) or a live key-range reassignment the current holder
+    differs.  Every component on a postoffice that must ADDRESS the
+    global tier by shard — the recovery monitor's party folds, the
+    adaptive-WAN controller's policy broadcasts, operator tooling —
+    shares this tracker instead of each re-implementing NEW_PRIMARY
+    bookkeeping.  The hook observes only (returns False), so every other
+    NEW_PRIMARY consumer on the node still fires."""
+
+    def __init__(self, postoffice: Postoffice):
+        self.po = postoffice
+        self._mu = threading.Lock()
+        self._replaced: dict = {}  # old node str -> new node str
+        postoffice.add_control_hook(self._on_new_primary)
+
+    def _on_new_primary(self, msg: Message) -> bool:
+        if msg.control is Control.NEW_PRIMARY and not msg.request:
+            b = msg.body if isinstance(msg.body, dict) else {}
+            if b.get("old") and b.get("new") and b["old"] != b["new"]:
+                with self._mu:
+                    self._replaced[str(b["old"])] = str(b["new"])
+        return False  # observe-only
+
+    def record(self, old, new) -> None:
+        """Local fast path for components on the SAME postoffice as the
+        failover monitor (its own broadcast loops back eventually, but
+        the mapping must be current the moment promote() returns)."""
+        old, new = str(old), str(new)
+        if old != new:
+            with self._mu:
+                self._replaced[old] = new
+
+    def resolve(self, node) -> NodeId:
+        s = str(node)
+        with self._mu:
+            for _ in range(8):  # chained failovers resolve transitively
+                nxt = self._replaced.get(s)
+                if nxt is None:
+                    break
+                s = nxt
+        return NodeId.parse(s)
+
+    def global_servers(self):
+        """Current holder of every shard's key range, deduplicated (a
+        drain can merge two ranges onto one server) in shard order."""
+        out, seen = [], set()
+        for n in self.po.topology.global_servers():
+            cur = self.resolve(n)
+            if str(cur) not in seen:
+                seen.add(str(cur))
+                out.append(cur)
+        return out
 
 
 class Replicator:
@@ -72,6 +133,13 @@ class Replicator:
         self._busy = False
         self._pending = False
         self._lag = system_gauge(f"{gserver.po.node}.replication_lag_s")
+        # per-SHARD twin of the per-node gauge: shard rank k is this
+        # node's rank whether it is the plan primary (global_server:k)
+        # or its promoted standby (standby_global:k) — bench's shards
+        # sweep and the chaos soaks read the shard-keyed series so a
+        # failover doesn't break the metric's continuity
+        self._shard_lag = system_gauge(
+            f"global_shard{gserver.po.node.rank}.replication_lag_s")
         # baseline ship shortly after startup: a primary that dies before
         # its first completed round must still leave the standby with the
         # key set (and a restarted zombie announces itself to the fence)
@@ -140,7 +208,9 @@ class Replicator:
                     self.gs._fence("replication rejected by newer primary")
                 else:
                     self.acked_seq = max(self.acked_seq, seq)
-                    self._lag.set(time.monotonic() - t_snap)
+                    lag = time.monotonic() - t_snap
+                    self._lag.set(lag)
+                    self._shard_lag.set(lag)
                 with self.gs._mu:
                     self._busy = False
                     if self._pending and not self.stopped:
@@ -195,8 +265,15 @@ class GlobalFailoverMonitor:
         self.po = postoffice
         topo = postoffice.topology
         self.topology = topo
-        self._terms = {r: 0 for r in range(topo.num_standby_globals)}
+        self._terms = {r: 0 for r in range(topo.num_global_servers)}
+        # current holder of each shard's key range (promotion and
+        # key-range reassignment both move it); the shared ShardTargets
+        # view on this postoffice serves every other component
+        self._holders = {r: NodeId(Role.GLOBAL_SERVER, r)
+                         for r in range(topo.num_global_servers)}
+        self.shard_targets = ShardTargets(postoffice)
         self._promoted: set = set()
+        self.reassignments = 0  # completed live key-range handoffs
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
         self._replies: dict = {}  # token -> body
@@ -225,7 +302,8 @@ class GlobalFailoverMonitor:
                     if str(primary) in dead:
                         # keep fencing: a zombie restarting at any later
                         # point must hear who owns the shard now
-                        self._broadcast_new_primary(rank, repeats=1)
+                        self._broadcast_new_primary(
+                            rank, old=primary, repeats=1)
                     continue
                 if str(primary) in dead:
                     self.promote(rank)
@@ -234,10 +312,13 @@ class GlobalFailoverMonitor:
     def promote(self, rank: int, reason: str = "heartbeat timeout") -> bool:
         """Promote ``standby_global:rank``.  Also the operator-forced
         entry point (runbook: docs/deployment.md) — callable directly
-        with the primary still alive, e.g. for planned maintenance."""
+        with the primary still alive, e.g. for planned maintenance.
+        Per-shard: shard ``rank``'s term moves alone; every other
+        shard's primary, standby chain and term are untouched."""
         standby = self.topology.standby_for(rank)
         if standby is None or rank in self._promoted:
             return False
+        old = self._holders[rank]
         term = self._terms[rank] + 1
         if not self._rpc_promote(standby, term, rank):
             import logging
@@ -246,10 +327,10 @@ class GlobalFailoverMonitor:
                 "%s: standby %s did not acknowledge promotion (term %d)",
                 self.po.node, standby, term)
             return False
-        self._terms[rank] = term
-        self._promoted.add(rank)
+        self._record_move(rank, old, standby, term)
         self.failover_events += 1
         self._counter.inc()
+        system_counter(f"global_shard{rank}.promotions").inc()
         from geomx_tpu.trace.recorder import get_tracer
 
         # failover lands on the merged trace timeline as a control event
@@ -257,8 +338,93 @@ class GlobalFailoverMonitor:
             "failover.promoted", rank=rank, term=term, reason=reason)
         print(f"{self.po.node}: promoted {standby} to primary of shard "
               f"{rank} (term={term}, {reason})", flush=True)
-        self._broadcast_new_primary(rank, repeats=3)
+        self._broadcast_new_primary(rank, old=old, repeats=3)
         return True
+
+    def _record_move(self, rank: int, old: NodeId, new: NodeId, term: int):
+        """Shared bookkeeping for a shard's key range changing hands
+        (promotion or reassignment): term, holder, shared resolver, and
+        the per-shard registry gauges next to the PR 1 per-node ones."""
+        self._terms[rank] = term
+        self._holders[rank] = new
+        self._promoted.add(rank)
+        self.shard_targets.record(old, new)
+        system_gauge(f"global_shard{rank}.term").set(term)
+
+    # ---- live key-range reassignment (shard drain) --------------------------
+    def reassign(self, rank: int, target: Optional[NodeId] = None,
+                 reason: str = "operator reassignment") -> bool:
+        """Move shard ``rank``'s key range onto ``target`` — the shard's
+        standby by default, or ANY live global server (drain: the old
+        holder retires and the target serves both ranges).  Epoch-fenced
+        by the shard's term exactly like failover, but exercised with
+        the old holder still alive:
+
+        1. term[rank] += 1;
+        2. ``Control.HANDOFF {term, target}`` to the current holder —
+           it quiesces, ships its final state snapshot (store +
+           optimizer + replay-dedup window) straight to the target as a
+           ``Cmd.REPLICATE {handoff}`` push, then fences itself and
+           silently drops any straggling data requests (to the data
+           plane it is now "dead", so the failover replay path applies);
+        3. ``Control.NEW_PRIMARY`` broadcast — every local server
+           retargets the range and replays its un-ACKed requests at the
+           target; the replicated dedup window keeps that exactly-once.
+        """
+        with self._mu:
+            old = self._holders.get(rank)
+        if old is None:
+            return False
+        if target is None:
+            target = self.topology.standby_for(rank)
+        if target is None or str(target) == str(old):
+            return False
+        term = self._terms[rank] + 1
+        reply = self._rpc(old, Control.HANDOFF,
+                          {"term": term, "rank": rank,
+                           "target": str(target)},
+                          attempts=8, per_try_s=5.0)
+        if reply is None or not reply.get("ok"):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s: shard %d handoff %s -> %s failed (%s)",
+                self.po.node, rank, old, target, reply)
+            return False
+        self._record_move(rank, old, target, term)
+        self.reassignments += 1
+        system_counter(f"global_shard{rank}.reassignments").inc()
+        from geomx_tpu.trace.recorder import get_tracer
+
+        get_tracer(str(self.po.node)).instant(
+            "reassign.moved", rank=rank, term=term, old=str(old),
+            new=str(target), reason=reason)
+        print(f"{self.po.node}: reassigned shard {rank} key range "
+              f"{old} -> {target} (term={term}, "
+              f"{reply.get('keys', 0)} keys, {reason})", flush=True)
+        self._broadcast_new_primary(rank, old=old, repeats=3)
+        return True
+
+    def _rpc(self, target: NodeId, control: Control, body: dict,
+             attempts: int = 5, per_try_s: float = 2.0) -> Optional[dict]:
+        """Token-matched retried control RPC (the eviction monitors'
+        helper, local to this monitor's reply table)."""
+        token = f"{self.po.node}#{uuid.uuid4().hex[:8]}"
+        body = dict(body, token=token)
+        for _ in range(attempts):
+            if self._stop.is_set():
+                return None
+            try:
+                self.po.van.send(Message(
+                    recipient=target, control=control,
+                    domain=Domain.GLOBAL, request=True, body=dict(body)))
+            except (KeyError, OSError):
+                pass  # peer not dialable yet — retry
+            with self._cv:
+                if self._cv.wait_for(lambda: token in self._replies,
+                                     timeout=per_try_s):
+                    return self._replies.pop(token)
+        return None
 
     def _rpc_promote(self, standby: NodeId, term: int, rank: int,
                      attempts: int = 5, per_try_s: float = 2.0) -> bool:
@@ -278,7 +444,8 @@ class GlobalFailoverMonitor:
         return False
 
     def _on_control(self, msg: Message) -> bool:
-        if msg.control is Control.PROMOTE and not msg.request:
+        if (msg.control in (Control.PROMOTE, Control.HANDOFF)
+                and not msg.request):
             body = msg.body if isinstance(msg.body, dict) else {}
             with self._cv:
                 self._replies[body.get("token")] = body
@@ -286,17 +453,33 @@ class GlobalFailoverMonitor:
             return True
         return False
 
-    def _broadcast_new_primary(self, rank: int, repeats: int = 1):
+    def _broadcast_new_primary(self, rank: int,
+                               old: Optional[NodeId] = None,
+                               repeats: int = 1):
         topo = self.topology
-        standby = topo.standby_for(rank)
         primary = NodeId(Role.GLOBAL_SERVER, rank)
-        body = {"rank": rank, "old": str(primary), "new": str(standby),
+        if old is None:
+            old = primary
+        body = {"rank": rank, "old": str(old),
+                "new": str(self._holders[rank]),
                 "term": self._terms[rank]}
         targets = list(topo.servers()) + list(topo.all_workers())
         mw = topo.master_worker()
         if mw is not None:
             targets.append(mw)
-        targets.append(primary)  # the zombie fence
+        targets.append(old)    # the zombie / drained-holder fence
+        if str(old) != str(primary):
+            targets.append(primary)  # a plan-primary zombie too
+        # the NEW holder too: a reassignment target that is a standby
+        # adopts the promotion from this broadcast (the failover path
+        # sends it a direct PROMOTE first; the reassign path relies on
+        # the new==me branch of _on_new_primary)
+        targets.append(self._holders[rank])
+        # self-delivery: components on THIS scheduler's postoffice (the
+        # adaptive-WAN controller, ShardTargets consumers) track holders
+        # through the same control hook as everyone else — without it a
+        # locally-originated broadcast is the one they never hear
+        targets.append(self.po.node)
         for i in range(repeats):
             if i:
                 time.sleep(0.3)
@@ -304,7 +487,8 @@ class GlobalFailoverMonitor:
                 try:
                     self.po.van.send(Message(
                         recipient=n, control=Control.NEW_PRIMARY,
-                        domain=Domain.GLOBAL, request=False, body=body))
+                        domain=Domain.GLOBAL, request=False,
+                        body=dict(body)))
                 except (KeyError, OSError):
                     pass  # down peers hear a later rebroadcast
 

@@ -1445,7 +1445,7 @@ class LocalServer:
             ent = self._policy_stash.pop(msg.timestamp, None)
             if ent is not None:
                 self.policy_fence_retries += 1
-                if ent["attempts"] < 5:
+                if ent["attempts"] < self.config.policy_fence_max_retries:
                     ent["fenced"] = True  # guard defers done to the retry
                     retry = ent
                 else:
@@ -1996,6 +1996,16 @@ class GlobalServer:
         self._repl_seq = 0         # last applied replication snapshot
         self._parked_standby: List[tuple] = []  # (msg, kvs) pre-promotion
         self._repl = None          # Replicator on a primary with a standby
+        # live key-range reassignment (shard drain): once this holder
+        # ships its final snapshot to the new holder it DROPS data
+        # requests silently — to clients it looks exactly like the dead
+        # primary of a failover, so the proven retarget+replay path
+        # moves their traffic; the fence answers any control stragglers
+        self._draining = False
+        self._handoff_kw = None    # lazily-built ship endpoint (one per
+        #                            lifetime; Customer ids don't recycle)
+        self.drains = 0            # completed handoffs (observability)
+        self.merged_handoffs = 0   # key ranges adopted from a drain
         self.optimizer: ServerOptimizer = Sgd()
         self._optimizer_configured = False  # flips on SET_OPTIMIZER; a
         #                                     central-worker deployment
@@ -2058,6 +2068,7 @@ class GlobalServer:
         postoffice.add_control_hook(self._on_evict)
         postoffice.add_control_hook(self._on_promote)
         postoffice.add_control_hook(self._on_new_primary)
+        postoffice.add_control_hook(self._on_handoff)
         self.server = KVServer(APP_PS, 0, postoffice, self._handle)
         self.server.cmd_handler = self._on_cmd
         # the axpy-vs-numpy calibration must never run inside the locked
@@ -2186,6 +2197,13 @@ class GlobalServer:
                       server: KVServer):
         if msg.cmd == Cmd.REPLICATE:
             self._on_replicate(msg, kvs)
+            return
+        if self._draining and msg.request and (msg.push or msg.pull):
+            # drained holder: to the data plane this node is DEAD — the
+            # request is dropped without a response so the sender's
+            # replay machinery re-issues it at the new holder after the
+            # NEW_PRIMARY retarget (an error reply here would surface as
+            # a failure instead of riding the proven failover path)
             return
         if self._fenced and msg.request:
             # deposed ex-primary: accepting pushes here would fork the
@@ -2881,6 +2899,178 @@ class GlobalServer:
         if rd:
             self._recent.seed_done(rd)
 
+    def _merge_state_locked(self, store: dict, opt: dict, meta: dict):
+        """Adopt a drained shard's key range NEXT TO this server's own
+        (key-range reassignment onto a live primary).  Unlike
+        :meth:`_install_state_locked` nothing of this server's own shard
+        is touched: the shipped keys and their optimizer state are added,
+        the drained holder's replay-dedup window is seeded ADDITIVELY
+        (so a client replay of a request the old holder already applied
+        is re-acked, not re-applied — the same exactly-once contract as
+        failover), and pulls parked on the new keys are served.  Caller
+        holds ``_mu``."""
+        shipped_opt = opt.get("optimizer")
+        for k, v in store.items():
+            k = int(k)
+            self.store[k] = np.array(v)
+            st = self._keys.setdefault(k, _GlobalKeyState())
+            # any aggregation state this server somehow held for a
+            # foreign key is stale by definition
+            st.accum = None
+            st.count = 0
+            if shipped_opt is not None and k in getattr(
+                    shipped_opt, "state", {}):
+                # per-key optimizer state (momentum/Adam moments) moves
+                # with the range; this server's own keys keep theirs
+                self.optimizer.state[k] = shipped_opt.state[k]
+            if self.pull_comp is not None:
+                self.pull_comp.ensure_base(k, self.store[k])
+            for m in self._serve_parked_pulls_locked(k):
+                self._park_pull(m)
+        if not self._optimizer_configured and shipped_opt is not None \
+                and meta.get("optimizer_configured"):
+            # an unconfigured target adopts the drained shard's
+            # optimizer wholesale — MultiGPS must never mix a configured
+            # shard with a default-SGD one
+            self.optimizer = shipped_opt
+            self._optimizer_configured = True
+        rd = meta.get("recent_done")
+        if rd:
+            self._recent.seed_done(rd)
+        if self._repl is not None:
+            # the adopted range replicates with THIS holder's standby
+            # chain from now on — ship a fresh snapshot that includes it
+            self._repl.mark_locked(force=True)
+
+    # ---- live key-range reassignment (shard drain) --------------------------
+    def _on_handoff(self, msg: Message) -> bool:
+        """Control.HANDOFF from the global scheduler: drain this
+        holder's key range onto ``body["target"]`` under a bumped term.
+        The ship blocks on a WAN round trip, so it runs off the hook
+        thread; the scheduler retries until a reply lands (idempotent —
+        an already-drained holder re-acks)."""
+        if msg.control is not Control.HANDOFF or not msg.request:
+            return False
+        body = msg.body if isinstance(msg.body, dict) else {}
+        term = int(body.get("term", 0))
+        target = body.get("target")
+        with self._mu:
+            if self._draining or self._fenced:
+                # replayed (or raced) handoff: the drain already
+                # happened — re-ack with the recorded outcome
+                self.po.van.send(msg.reply_to(
+                    control=Control.HANDOFF,
+                    body={"ok": term <= self.term and self.drains > 0,
+                          "keys": len(self.store),
+                          "token": body.get("token")}))
+                return True
+            if term <= self.term or target is None:
+                self.po.van.send(msg.reply_to(
+                    control=Control.HANDOFF,
+                    body={"ok": False, "term": self.term,
+                          "error": f"stale handoff term {term} <= "
+                                   f"{self.term}",
+                          "token": body.get("token")}))
+                return True
+        threading.Thread(
+            target=self._drain_thread,
+            args=(msg, term, NodeId.parse(str(target))),
+            daemon=True, name=f"handoff-{self.po.node}").start()
+        return True
+
+    def _drain_thread(self, msg: Message, term: int, target: NodeId):
+        import copy
+
+        from geomx_tpu.kvstore import checkpoint as ckpt
+        from geomx_tpu.kvstore.replication import HANDOFF_CUSTOMER_ID
+
+        ok = False
+        nkeys = 0
+        try:
+            # stop the regular replication stream FIRST and wait out any
+            # in-flight ship: a pre-quiesce snapshot landing at a standby
+            # target AFTER the handoff install would roll it back to a
+            # state missing the final rounds
+            if self._repl is not None:
+                self._repl.stopped = True
+                deadline = time.monotonic() + 10
+                while self._repl._busy and time.monotonic() < deadline:
+                    time.sleep(0.05)
+            # program order: merges queued from already-arrived pushes
+            # land before the snapshot; requests arriving after the
+            # _draining flip below are dropped (clients replay them at
+            # the new holder post-retarget)
+            self._shards.drain()
+            with self._mu:
+                self._draining = True
+                store_snap = {k: v.copy() for k, v in self.store.items()}
+                opt_snap = copy.deepcopy(self.optimizer)
+                meta = {
+                    "sync_mode": self.sync_mode,
+                    "compression": dict(self.compression),
+                    "recent_done": self._recent.export_done(),
+                    "optimizer_configured": self._optimizer_configured,
+                }
+                nkeys = len(store_snap)
+            blob = np.frombuffer(
+                ckpt.dumps_server_state(store_snap, {"optimizer": opt_snap},
+                                        meta), dtype=np.uint8)
+            if self._handoff_kw is None:
+                self._handoff_kw = KVWorker(
+                    APP_PS, HANDOFF_CUSTOMER_ID, self.po,
+                    targets=[target], key_ranges=split_range(1),
+                    domain=Domain.GLOBAL)
+            else:
+                self._handoff_kw.targets[0] = target
+            kw = self._handoff_kw
+            kw.zpush(
+                KVPairs(np.array([0], dtype=np.int64), blob,
+                        np.array([len(blob)], dtype=np.int64)),
+                cmd=Cmd.REPLICATE, wait=True, donated=True,
+                body={"term": term, "seq": self._repl_seq + 1,
+                      "handoff": True})
+            with kw._mu:
+                errs, kw.errors[:] = list(kw.errors), []
+            ok = not errs
+            if ok:
+                self.drains += 1  # single drain thread per lifetime
+                from geomx_tpu.utils.metrics import system_counter
+
+                system_counter(f"{self.po.node}.drains").inc()
+                self._tr.instant("reassign.drained", term=term,
+                                 target=str(target), keys=nkeys)
+                self._fence(f"key range drained to {target}", term)
+            else:
+                # aborted ship: the range is still ours — resume serving
+                # (replication stream included) rather than wedging the
+                # shard half-drained
+                with self._mu:
+                    self._draining = False
+                    if self._repl is not None:
+                        self._repl.stopped = False
+                import logging
+
+                logging.getLogger(__name__).error(
+                    "%s: handoff to %s failed (%s); resuming as holder",
+                    self.po.node, target, "; ".join(errs))
+        except Exception:
+            with self._mu:
+                self._draining = False
+                if self._repl is not None:
+                    self._repl.stopped = False
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "%s: handoff to %s failed; resuming as holder",
+                self.po.node, target)
+        try:
+            self.po.van.send(msg.reply_to(
+                control=Control.HANDOFF,
+                body={"ok": ok, "keys": nkeys,
+                      "token": (msg.body or {}).get("token")}))
+        except (KeyError, OSError):
+            pass  # the scheduler re-asks; the idempotent re-ack answers
+
     # ---- hot-standby replication + promotion (kvstore/replication.py) ------
     def _on_replicate(self, msg: Message, kvs: Optional[KVPairs]):
         """Apply one streamed state snapshot from the shard's primary —
@@ -2895,6 +3085,7 @@ class GlobalServer:
             return
         body = msg.body if isinstance(msg.body, dict) else {}
         term, seq = int(body.get("term", 0)), int(body.get("seq", 0))
+        handoff = bool(body.get("handoff"))
         err = None
         with self._mu:
             if term < self.term:
@@ -2905,6 +3096,25 @@ class GlobalServer:
                     f"{self.po.node}.replication_fenced_rejects").inc()
                 err = {"error": f"fenced: stale replication term {term} < "
                                 f"{self.term}", "term": self.term}
+            elif handoff and kvs is not None:
+                # key-range reassignment: the draining holder's final
+                # snapshot.  A live primary MERGES the shipped range
+                # next to its own (it keeps serving its own shard
+                # mid-adopt); a standby target full-installs — both
+                # idempotent, so the scheduler's handoff retries are
+                # safe.  Ordering vs. our own primary's replication
+                # stream is by term: the drain bumped the shipped
+                # range's term past anything the old stream carries.
+                from geomx_tpu.kvstore import checkpoint as ckpt
+
+                store, opt, meta = ckpt.loads_server_state(
+                    np.ascontiguousarray(kvs.vals).tobytes())
+                if self.is_standby:
+                    self._install_state_locked(store, opt, meta)
+                else:
+                    self._merge_state_locked(store, opt, meta)
+                self.merged_handoffs += 1
+                self._repl_seq = max(self._repl_seq, seq)
             elif seq > self._repl_seq and kvs is not None:
                 from geomx_tpu.kvstore import checkpoint as ckpt
                 from geomx_tpu.utils.metrics import system_gauge
@@ -3097,6 +3307,10 @@ class GlobalServer:
                 "policy_epoch": self._policy_epoch,
                 "policy_fenced_pushes": self.policy_fenced_pushes,
                 "rejected_compr_tags": self.rejected_compr_tags,
+                # key-range reassignment (shard drain) observables
+                "drains": self.drains,
+                "merged_handoffs": self.merged_handoffs,
+                "draining": self._draining,
             })
             return
         elif msg.cmd == Ctrl.LIST_KEYS:
@@ -3137,6 +3351,8 @@ class GlobalServer:
     def stop(self):
         if self._repl is not None:
             self._repl.stop()
+        if self._handoff_kw is not None:
+            self._handoff_kw.stop()
         if self.ts_inter is not None:
             self.ts_inter.stop()
         self._shards.stop()

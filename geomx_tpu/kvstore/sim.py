@@ -213,6 +213,29 @@ class Simulation:
     def all_workers(self) -> List[WorkerKVStore]:
         return [self.workers[str(w)] for w in self.topology.all_workers()]
 
+    # ---- targeted fault injection ---------------------------------------
+    def partition(self, a, b="*", symmetric: bool = True):
+        """Cut the link a→b (both directions unless ``symmetric=False``)
+        at the fabric, CONTROL TRAFFIC INCLUDED — heartbeats starve, so
+        the failure detectors actually fire.  ``a``/``b`` are NodeIds or
+        node strings; ``"*"`` wildcards.  ``partition(gs)`` with a
+        single argument isolates exactly that node's links — what the
+        shard-failure and split-brain soaks use instead of approximating
+        with a global drop_rate."""
+        self.fabric.fault.partition(str(a), str(b), symmetric=symmetric)
+
+    def heal(self, a=None, b=None):
+        """Undo :meth:`partition` cuts (all of them with no args)."""
+        self.fabric.fault.heal(None if a is None else str(a),
+                               None if b is None else str(b))
+
+    def set_duplicate_rate(self, rate: float):
+        """Message-duplication injection: each data message is
+        re-delivered (a copy, ahead of the original) with probability
+        ``rate`` — the at-least-once failure mode the replay-dedup
+        windows must absorb."""
+        self.fabric.fault.duplicate_rate = float(rate)
+
     def kill_global_server(self, rank: int = 0) -> GlobalServer:
         """Thread-level kill of a primary global server (SIGKILL-free):
         stop its postoffice — the van's receive loop and heartbeat
@@ -246,6 +269,24 @@ class Simulation:
         ls.po.van.kill()
         ls.po.stop()
         return ls
+
+    def reassign_shard(self, rank: int, target=None,
+                       reason: str = "sim reassignment") -> bool:
+        """Live key-range reassignment: move global shard ``rank``'s
+        range onto ``target`` (its standby by default, or any live
+        global server for a drain) through the epoch-fenced handoff
+        protocol (``GlobalFailoverMonitor.reassign``).  Blocks until the
+        handoff completed and the retarget broadcast went out."""
+        if self.failover_monitor is None:
+            from geomx_tpu.kvstore.replication import GlobalFailoverMonitor
+
+            self.failover_monitor = GlobalFailoverMonitor(
+                self.offices[str(self.topology.global_scheduler())])
+        t = None
+        if target is not None:
+            t = (target if isinstance(target, NodeId)
+                 else NodeId.parse(str(target)))
+        return self.failover_monitor.reassign(rank, t, reason=reason)
 
     def restart_local_server(self, party: int) -> LocalServer:
         """Stand up a REPLACEMENT local-server process for the party:

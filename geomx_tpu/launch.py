@@ -232,6 +232,41 @@ def shutdown_cluster(po: Postoffice):
                 pass
 
 
+def _wait_servers_up(kv, timeout: float = 90.0):
+    """Ping the party server and every global shard until each answers
+    a QUERY_STATS round trip.  Control commands are fire-once (the
+    replay layer covers only data traffic), so configuration must not
+    race a still-binding server process — with a sharded global tier
+    the last shard to bind loses that race reliably."""
+    from geomx_tpu.kvstore.common import Ctrl
+    from geomx_tpu.transport.message import Domain as _Domain
+
+    deadline = time.monotonic() + timeout
+    for i in range(-1, len(kv.po.topology.global_servers())):
+        while True:
+            # re-resolve the shard's CURRENT holder on every retry: a
+            # shard that dies during bring-up answers through its
+            # promoted standby once the NEW_PRIMARY broadcast lands
+            if i < 0:
+                node, domain = kv.po.topology.server(kv.party), _Domain.LOCAL
+            else:
+                gts = kv.global_targets()
+                if i >= len(gts):  # shards merged by a reassignment
+                    break
+                node, domain = gts[i], _Domain.GLOBAL
+            ts = kv.worker.send_cmd(node, Ctrl.QUERY_STATS,
+                                    domain=domain, wait=False)
+            try:
+                kv.worker.customer.wait(ts, timeout=2.0)
+                kv.worker.cmd_response(ts)  # drop the stats body
+                break
+            except TimeoutError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"{kv.po.node}: {node} never answered a "
+                        "configuration ping")
+
+
 def _configure_worker(po, kv, args):
     """Shared worker-side setup for every demo workload: either gate on
     the central master worker's configuration or (rank 0) push optimizer
@@ -240,6 +275,8 @@ def _configure_worker(po, kv, args):
     the requested compression and reintroduces the first-round race
     against the default optimizer."""
     topo = po.topology
+    if kv.rank == 0:
+        _wait_servers_up(kv)
     if topo.central_worker:
         # central-worker deployment: the MASTER drives configuration
         # (ref: DMLC_ENABLE_CENTRAL_WORKER); workers only gate training
@@ -255,7 +292,7 @@ def _configure_worker(po, kv, args):
             ok = all((kv.worker.send_cmd(gs, Ctrl.QUERY_STATS,
                                          domain=Domain.GLOBAL) or {}
                       ).get("optimizer_configured")
-                     for gs in topo.global_servers())
+                     for gs in kv.global_targets())
             if ok:
                 break
             time.sleep(0.2)
@@ -337,6 +374,10 @@ def _worker_demo(po, kv, args, join_advertise=None):
         _configure_worker(po, kv, args)
         widx, num_all = kv.party * kv.num_workers + kv.rank, \
             kv.num_all_workers
+        # chaos harnesses key their kill timing off this marker: a
+        # SIGKILL before configuration completes tests the bring-up
+        # race, after it the mid-training failover path
+        print(f"{po.node}: configured — training begins", flush=True)
     it = ShardedIterator(x, y, args.batch, widx, num_all)
     hist = train(kv, params, it, args.steps, barrier_init=not joining)
     if joining:
@@ -504,6 +545,15 @@ def main(argv=None):
                     default=int(os.environ.get("GEOMX_WORKERS_PER_PARTY", "1")))
     ap.add_argument("--global-servers", type=int,
                     default=int(os.environ.get("GEOMX_NUM_GLOBAL_SERVERS", "1")))
+    ap.add_argument("--global-shards", type=int,
+                    default=int(os.environ.get("GEOMX_GLOBAL_SHARDS", "0")),
+                    help="shard the global tier horizontally into M "
+                         "independent key-range servers (alias of "
+                         "--global-servers; wins when both are given). "
+                         "Each shard is its own failure domain: run each "
+                         "as --role global_server:K, optionally backed "
+                         "by --role standby_global:K (per-shard "
+                         "failover; see docs/deployment.md)")
     ap.add_argument("--standby-globals", type=int,
                     default=int(os.environ.get("GEOMX_NUM_STANDBY_GLOBALS",
                                                "0")),
@@ -604,7 +654,8 @@ def main(argv=None):
                or node.role is Role.MASTER_WORKER)
     cfg.topology = Topology(num_parties=args.parties,
                             workers_per_party=args.workers,
-                            num_global_servers=args.global_servers,
+                            num_global_servers=(args.global_shards
+                                                or args.global_servers),
                             num_standby_globals=args.standby_globals,
                             central_worker=central)
     cfg.compression = args.compression
@@ -717,6 +768,10 @@ def main(argv=None):
     for attr, tag in (("failover_events", "failover_events"),
                       ("promotions", "promotions"),
                       ("fenced_rejects", "fenced_rejects"),
+                      # sharded global tier: key-range drains shipped /
+                      # adopted (live reassignment)
+                      ("drains", "drains"),
+                      ("merged_handoffs", "merged_handoffs"),
                       # crash-tolerant membership observables: evictions
                       # actuated (schedulers), fenced zombies + warm
                       # boots (local servers), party folds (global tier),

@@ -190,6 +190,14 @@ class KVWorker(_App):
         # crash+restart — transport resend only covers lost *delivery*,
         # not state lost with a dead process.
         self._retry_s = float(postoffice.config.request_retry_s or 0.0)
+        # backoff shape from Config (chaos soaks tighten these via env —
+        # GEOMX_RETRY_BACKOFF_CAP / GEOMX_RETRY_JITTER — instead of
+        # editing source); deterministic mode forces jitter off so the
+        # replay schedule reproduces run-to-run
+        cfg = postoffice.config
+        self._retry_cap = max(1, int(getattr(cfg, "retry_backoff_cap", 8)))
+        self._retry_jitter = (0.0 if getattr(cfg, "deterministic", False)
+                              else float(getattr(cfg, "retry_jitter", 0.0)))
         self._inflight: Dict[int, dict] = {}  # ts -> {deadline, attempts,
         #                                       msgs: {target_str: Message}}
         self._retry_stop = threading.Event()
@@ -264,6 +272,7 @@ class KVWorker(_App):
         return len(resend)
 
     def _retry_loop(self):
+        import random
         import time
 
         while not self._retry_stop.wait(min(self._retry_s / 4, 1.0)):
@@ -273,7 +282,13 @@ class KVWorker(_App):
                 for ent in self._inflight.values():
                     if now >= ent["deadline"]:
                         ent["attempts"] += 1
-                        backoff = min(2 ** ent["attempts"], 8)
+                        backoff = min(2 ** ent["attempts"], self._retry_cap)
+                        if self._retry_jitter > 0.0:
+                            # desynchronize: a whole party's replays must
+                            # not stampede a freshly promoted shard in
+                            # lockstep
+                            backoff *= 1.0 + random.uniform(
+                                0.0, self._retry_jitter)
                         ent["deadline"] = now + self._retry_s * backoff
                         resend.extend(ent["msgs"].values())
             for m in resend:
@@ -283,9 +298,19 @@ class KVWorker(_App):
                     pass  # peer still down — the next sweep retries
 
     # ---- slicing ------------------------------------------------------------
-    def _slice(self, kvs: KVPairs) -> Dict[int, KVPairs]:
-        """Partition KVPairs by target server. Keys must be sorted."""
-        out: Dict[int, List] = {}
+    def _slice(self, kvs: KVPairs) -> List[tuple]:
+        """Partition KVPairs by the server CURRENTLY holding each key
+        range; returns ``[(target NodeId, KVPairs), ...]``.  Keys must
+        be sorted.
+
+        Grouped by target NODE, not by range slot: after a key-range
+        reassignment (shard drain) or chained failovers, two ranges may
+        be held by one server — one message (and one response) per
+        server keeps the response tracker's per-target accounting
+        correct (two same-recipient messages under one timestamp would
+        make the dedup filter eat the second real response)."""
+        groups: Dict[str, list] = {}  # target-str -> [node, ks, vs, ls]
+        targets = list(self.targets)  # retarget() swaps slots in place
         off = 0
         for k, ln in zip(kvs.keys, kvs.lens):
             k = int(k)
@@ -296,24 +321,25 @@ class KVWorker(_App):
                     break
             if sid is None:
                 raise KeyError(f"key {k} outside all server ranges")
-            ent = out.setdefault(sid, [[], [], []])
-            ent[0].append(k)
-            ent[1].append(kvs.vals[off:off + ln])
-            ent[2].append(int(ln))
+            node = targets[sid]
+            ent = groups.setdefault(str(node), [node, [], [], []])
+            ent[1].append(k)
+            ent[2].append(kvs.vals[off:off + ln])
+            ent[3].append(int(ln))
             off += ln
-        return {
-            sid: KVPairs(
-                keys=np.array(e[0], dtype=np.int64),
+        return [
+            (e[0], KVPairs(
+                keys=np.array(e[1], dtype=np.int64),
                 # single-slice parts stay views of the caller's payload —
                 # concatenate([one]) would be a full copy, which at the
                 # big-tensor scale regime is ~0.2 s per hop
-                vals=(e[1][0] if len(e[1]) == 1
-                      else np.concatenate(e[1]) if e[1]
+                vals=(e[2][0] if len(e[2]) == 1
+                      else np.concatenate(e[2]) if e[2]
                       else np.empty(0, kvs.vals.dtype)),
-                lens=np.array(e[2], dtype=np.int64),
-            )
-            for sid, e in out.items()
-        }
+                lens=np.array(e[3], dtype=np.int64),
+            ))
+            for e in groups.values()
+        ]
 
     # ---- public API ---------------------------------------------------------
     def zpush(
@@ -329,9 +355,9 @@ class KVWorker(_App):
         parts = self._slice(kvs)
         ts = self.customer.new_request(len(parts), on_complete=on_complete)
         sends: List[tuple] = []
-        for sid, part in parts.items():
+        for target, part in parts:
             m = Message(
-                recipient=self.targets[sid], domain=self.domain,
+                recipient=target, domain=self.domain,
                 app_id=self.customer.app_id, customer_id=self.customer.customer_id,
                 timestamp=ts, request=True, push=True, cmd=cmd, priority=priority,
                 keys=part.keys, vals=part.vals, lens=part.lens, **msg_fields,
@@ -391,12 +417,12 @@ class KVWorker(_App):
 
         def _send():
             msgs = [Message(
-                recipient=self.targets[sid], domain=self.domain,
+                recipient=target, domain=self.domain,
                 app_id=self.customer.app_id,
                 customer_id=self.customer.customer_id,
                 timestamp=ts, request=True, pull=True, cmd=cmd,
                 priority=priority, keys=part.keys, **msg_fields,
-            ) for sid, part in parts.items()]
+            ) for target, part in parts]
             self._track(ts, msgs)  # before sending (response could race)
             for m in msgs:
                 self.postoffice.van.send(m)
@@ -420,12 +446,12 @@ class KVWorker(_App):
             if cb is not None:
                 self._pull_cbs[ts] = cb
         msgs = [Message(
-            recipient=self.targets[sid], domain=self.domain,
+            recipient=target, domain=self.domain,
             app_id=self.customer.app_id, customer_id=self.customer.customer_id,
             timestamp=ts, request=True, push=True, pull=True, cmd=cmd,
             priority=priority, keys=part.keys, vals=part.vals, lens=part.lens,
             **msg_fields,
-        ) for sid, part in parts.items()]
+        ) for target, part in parts]
         self._track(ts, msgs)  # before sending (response could race)
         for m in msgs:
             self.postoffice.van.send(m)

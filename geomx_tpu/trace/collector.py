@@ -56,6 +56,20 @@ def _party_of(node: str) -> str:
     return node.rsplit("@", 1)[1] if "@" in node else "central"
 
 
+def _shard_of(node: str):
+    """Global-tier shard rank of a node, or None.  The shard identity
+    survives failover: ``standby_global:k`` serves exactly shard k's
+    key range once promoted, so its spans bill to the same shard as the
+    primary it replaced."""
+    for role in ("global_server:", "standby_global:"):
+        if node.startswith(role):
+            try:
+                return int(node[len(role):].split("@", 1)[0])
+            except ValueError:
+                return None
+    return None
+
+
 class TraceCollector:
     """One per deployment, on the global scheduler's postoffice."""
 
@@ -208,6 +222,15 @@ class TraceCollector:
             if dur > st["worst_us"]:
                 st["worst_us"] = dur
                 st["worst_node"] = node
+            # sharded global tier: bill global-server work (and WAN
+            # transit INTO a shard — the recv side of the matched pair)
+            # to its shard, so the report names the slowest shard the
+            # way it names the straggler party
+            shard = _shard_of(str(ev.get("pid", node))
+                              if name == "wan.recv" else node)
+            if shard is not None:
+                bs = r.setdefault("by_shard", {})
+                bs[shard] = bs.get(shard, 0.0) + dur
         out = []
         for tid in sorted(rounds):
             r = rounds.pop(tid)
@@ -222,6 +245,11 @@ class TraceCollector:
                             st["by_party"], key=st["by_party"].get)
             else:
                 r["dominant_stage"] = None
+            if r.get("by_shard"):
+                # the first place to look when shard-count scaling is
+                # sublinear: which key range's server bounded the round
+                r["slowest_shard"] = max(r["by_shard"],
+                                         key=r["by_shard"].get)
             out.append(r)
         return {"rounds": out,
                 "num_events": len(events),
@@ -237,9 +265,11 @@ class TraceCollector:
                 + (f"(worst {st['worst_node']})" if st["worst_node"] else "")
                 for s, st in sorted(r["stages"].items(),
                                     key=lambda kv: -kv[1]["busy_us"]))
+            shard = (f" slowest_shard={r['slowest_shard']}"
+                     if "slowest_shard" in r else "")
             lines.append(
                 f"round {r['round']}: wall={r['wall_us'] / 1e3:.1f}ms "
-                f"dominant={r['dominant_stage']} [{stages}]")
+                f"dominant={r['dominant_stage']}{shard} [{stages}]")
         return "\n".join(lines)
 
     def stop(self):

@@ -67,6 +67,15 @@ class Control(enum.Enum):
     #                    broadcast (scheduler -> party workers, body:
     #                    {event: "server_back"}): the party server
     #                    recovered — replay un-ACKed requests at it now
+    HANDOFF = 16       # global scheduler -> a live global shard holder:
+    #                    drain your key range onto {target} under a
+    #                    bumped term (live key-range reassignment).  The
+    #                    holder quiesces, ships a final state snapshot
+    #                    (Cmd.REPLICATE {handoff: true}) to the target,
+    #                    fences itself, and the scheduler broadcasts
+    #                    NEW_PRIMARY so every client retargets + replays
+    #                    — the same epoch-fence machinery as failover,
+    #                    exercised with the old holder still alive
 
 
 class Domain(enum.Enum):

@@ -270,7 +270,7 @@ class TcpFabric:
         return buf
 
     # ---- send side ----------------------------------------------------------
-    def deliver(self, msg: Message) -> bool:
+    def deliver(self, msg: Message, _dup_ok: bool = True) -> bool:
         if self.fault.should_drop(msg):
             with self._registry_mu:
                 # separate ledger: DGT acceptance metrics must not
@@ -286,6 +286,12 @@ class TcpFabric:
                     and str(msg.recipient) not in self._boxes
                     and msg.nbytes <= self.UDP_MAX - 4096))
             return False
+        if _dup_ok and self.fault.should_duplicate(msg):
+            # at-least-once injection (mirrors InProcFabric): a copy of
+            # the frame goes out ahead of the original
+            import copy
+
+            self.deliver(copy.copy(msg), _dup_ok=False)
         dest = str(msg.recipient)
         box = self._boxes.get(dest)
         if box is not None:  # local shortcut (several roles per process)

@@ -44,12 +44,25 @@ _wire_bootstrapped = False
 
 
 class FaultPolicy:
-    """Programmable message loss & latency.
+    """Programmable message loss, latency, link cuts and duplication.
 
     ``drop_rate`` applies to reliable-channel messages (channel 0);
     ``channel_drop_rate`` to DGT's lossy channels (>=1).  Latency is a
     fixed delay or a callable ``(msg) -> seconds``; WAN (GLOBAL domain)
     latency can be set separately to model the DC/WAN asymmetry.
+
+    ``partition``/``heal`` cut exact links: a cut ``(a, b)`` drops every
+    message a→b — CONTROL TRAFFIC INCLUDED (unlike the random
+    drop_rate, which spares control messages): a partition must starve
+    heartbeats too, or the failure detectors the chaos soaks exercise
+    would never fire.  ``"*"`` on either side wildcards, so
+    ``partition("global_server:1", "*")`` isolates exactly one shard's
+    links instead of approximating with a global drop_rate.
+
+    ``duplicate_rate`` re-delivers a copy of a data message with that
+    probability — the at-least-once failure mode real networks and the
+    replay machinery produce, injected deterministically (tests assert
+    the dedup windows absorb it).
     """
 
     def __init__(
@@ -60,6 +73,7 @@ class FaultPolicy:
         wan_latency_s: Optional[float] = None,
         lan_bandwidth_bps: float = 0.0,
         wan_bandwidth_bps: float = 0.0,
+        duplicate_rate: float = 0.0,
         seed: int = 0,
     ):
         self.drop_rate = drop_rate
@@ -72,12 +86,62 @@ class FaultPolicy:
         # with latency alone, concurrent messages never contend
         self.lan_bandwidth_bps = lan_bandwidth_bps
         self.wan_bandwidth_bps = wan_bandwidth_bps
+        self.duplicate_rate = duplicate_rate
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
+        # directed link cuts: (sender, recipient) node strings, "*" wild
+        self._cuts: set = set()
+        self.cut_dropped = 0  # messages eaten by a partition
+
+    # ---- targeted partition injection ------------------------------------
+    def partition(self, a: str, b: str = "*", symmetric: bool = True):
+        """Cut the link a→b (and b→a when ``symmetric``).  ``a``/``b``
+        are node strings (``str(NodeId)``) or ``"*"``.  One-way cuts
+        (``symmetric=False``) model asymmetric failures: a can still
+        hear b while b never hears a."""
+        a, b = str(a), str(b)
+        with self._lock:
+            self._cuts.add((a, b))
+            if symmetric:
+                self._cuts.add((b, a))
+
+    def heal(self, a: Optional[str] = None, b: Optional[str] = None):
+        """Remove cuts.  No arguments heals everything; ``heal(a)``
+        heals every cut naming ``a`` on either side; ``heal(a, b)``
+        heals that pair (both directions)."""
+        with self._lock:
+            if a is None:
+                self._cuts.clear()
+                return
+            a = str(a)
+            if b is None:
+                self._cuts = {c for c in self._cuts if a not in c}
+            else:
+                b = str(b)
+                self._cuts.discard((a, b))
+                self._cuts.discard((b, a))
+
+    def is_cut(self, msg: Message) -> bool:
+        if not self._cuts:
+            return False
+        s, r = str(msg.sender), str(msg.recipient)
+        with self._lock:
+            return ((s, r) in self._cuts or (s, "*") in self._cuts
+                    or ("*", r) in self._cuts)
+
+    def should_duplicate(self, msg: Message) -> bool:
+        if self.duplicate_rate <= 0.0 or msg.control is not Control.EMPTY:
+            return False
+        with self._lock:
+            return self._rng.random() < self.duplicate_rate
 
     def should_drop(self, msg: Message) -> bool:
+        if self.is_cut(msg):
+            # partitions cut EVERYTHING on the link, heartbeats included
+            self.cut_dropped += 1
+            return True
         if msg.control is not Control.EMPTY:
-            return False  # never drop control traffic in sim
+            return False  # never randomly drop control traffic in sim
         rate = self.channel_drop_rate if msg.channel >= 1 else self.drop_rate
         if rate <= 0.0:
             return False
@@ -134,6 +198,7 @@ class InProcFabric:
         self._timer: Optional[threading.Thread] = None
         self._link_free: Dict[tuple, float] = {}  # (sender, domain) -> t
         self.dropped = 0  # observability for loss-injection tests
+        self.duplicated = 0  # messages re-delivered by duplicate_rate
         self._serial_q: "queue.Queue" = queue.Queue()
         self._serial_receivers: Dict[str, Callable[[Message], None]] = {}
         self._serial_thread: Optional[threading.Thread] = None
@@ -182,6 +247,20 @@ class InProcFabric:
         if self.fault.should_drop(msg):
             self.dropped += 1
             return False
+        if self.fault.should_duplicate(msg):
+            # at-least-once injection: a shallow copy rides the same
+            # path (in-proc payloads are by-reference anyway; the copy
+            # keeps the two deliveries' mutable header fields apart).
+            # The copy is routed FIRST so the duplicate can also arrive
+            # ahead of the original — the reordered-duplicate case the
+            # dedup windows must absorb.
+            import copy
+
+            self.duplicated += 1
+            self._route(copy.copy(msg))
+        return self._route(msg)
+
+    def _route(self, msg: Message) -> bool:
         if self.serial:
             if (msg.control is Control.TERMINATE
                     and msg.sender == msg.recipient):

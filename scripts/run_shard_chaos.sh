@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Sharded-global-tier chaos demo: a real OS-process topology over TCP
+# with TWO global shards, each backed by a hot standby; SIGKILL shard
+# 1's primary mid-training and assert — from the logs alone — that
+# (a) shard 1's standby was promoted under term 1,
+# (b) shard 0 never moved (no promotion, no fence — failure-domain
+#     isolation), and
+# (c) the local server retargeted exactly the killed shard and training
+#     ran to completion.
+#
+# The pytest soak (tests/test_sharded_global.py::test_shard_chaos_e2e_
+# processes) additionally asserts loss parity vs an uninterrupted
+# control; this script is the 60-second operator-facing version.
+#
+# Env: GEOMX_BASE_PORT (default 9400), STEPS (default 80)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export JAX_PLATFORM_NAME=cpu
+export GEOMX_GLOBAL_SHARDS=2
+export GEOMX_NUM_STANDBY_GLOBALS=2
+export GEOMX_HEARTBEAT_INTERVAL=0.2
+export GEOMX_HEARTBEAT_TIMEOUT=1.5
+export GEOMX_REQUEST_RETRY_S=1.0
+export GEOMX_RETRY_BACKOFF_CAP=2
+
+BASE=${GEOMX_BASE_PORT:-9400}
+STEPS=${STEPS:-80}
+OUT=$(mktemp -d)
+trap 'kill $(jobs -p) 2>/dev/null || true; rm -rf "$OUT"' EXIT
+
+launch() { # role
+  python -m geomx_tpu.launch --role "$1" --parties 1 --workers 1 \
+    --global-shards 2 --standby-globals 2 --base-port "$BASE" \
+    --steps "$STEPS" >"$OUT/${1//[:@]/_}.log" 2>&1 &
+}
+
+launch global_scheduler:0
+launch global_server:0
+launch global_server:1
+launch standby_global:0
+launch standby_global:1
+launch scheduler:0@p0
+launch server:0@p0
+launch worker:0@p0
+WORKER_PID=$!
+
+# kill only once training is demonstrably underway (the worker's
+# bring-up — jax import included — can outlast any fixed sleep on a
+# loaded host); then give replication a few rounds to ship
+for _ in $(seq 1 240); do
+  grep -q "training begins" "$OUT/worker_0_p0.log" 2>/dev/null && break
+  sleep 0.5
+done
+grep -q "training begins" "$OUT/worker_0_p0.log" \
+  || { echo "FAIL: worker never started training"; tail "$OUT/worker_0_p0.log"; exit 1; }
+sleep 3  # several rounds + replication snapshots shipped
+
+VICTIM=$(pgrep -f "geomx_tpu.launch --role global_server:1 .*--base-port $BASE" | head -1)
+echo "== SIGKILL shard 1 primary (pid $VICTIM) =="
+kill -9 "$VICTIM"
+
+wait "$WORKER_PID" || true
+sleep 1
+
+echo "== log assertions =="
+grep -q "promoted to primary" "$OUT/standby_global_1.log" \
+  || { echo "FAIL: shard 1 standby never promoted"; exit 1; }
+grep -q "term=1" "$OUT/standby_global_1.log" \
+  || { echo "FAIL: promotion not under term 1"; exit 1; }
+if grep -q "promoted to primary" "$OUT/standby_global_0.log"; then
+  echo "FAIL: shard 0's standby was promoted (isolation broken)"; exit 1
+fi
+if grep -q "fenced" "$OUT/global_server_0.log"; then
+  echo "FAIL: shard 0's primary was fenced (isolation broken)"; exit 1
+fi
+grep -q "global shard 1 failed over to" "$OUT/server_0_p0.log" \
+  || { echo "FAIL: local server never retargeted shard 1"; exit 1; }
+grep -q "steps=$STEPS" "$OUT/worker_0_p0.log" \
+  || { echo "FAIL: training did not finish all steps"; exit 1; }
+echo "OK: shard 1 failed over (term=1), shard 0 untouched, training completed"

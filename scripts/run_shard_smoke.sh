@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Sharded-global-tier smoke lane: run the kvstore/failover/eviction test
+# subset with the global tier forced to 2 key-range shards
+# (GEOMX_GLOBAL_SHARDS shakes directly-constructed Configs too, the way
+# GEOMX_SERVER_SHARDS does for the striped-merge path), so the sharded
+# code path cannot silently rot while tier-1 runs single-global.
+#
+# Env: PYTEST_ARGS (extra pytest flags), GEOMX_GLOBAL_SHARDS (default 2)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export JAX_PLATFORM_NAME=cpu
+export GEOMX_GLOBAL_SHARDS=${GEOMX_GLOBAL_SHARDS:-2}
+
+exec python -m pytest -q -m 'not slow' -p no:cacheprovider \
+  tests/test_kvstore.py tests/test_failover.py tests/test_eviction.py \
+  tests/test_sharded_global.py tests/test_recovery.py \
+  ${PYTEST_ARGS:-}

@@ -1,0 +1,560 @@
+"""Horizontally sharded global tier (PR 6 tentpole): key-range
+assignment, per-shard term fencing/failover isolation, targeted
+partition/duplication injection, and epoch-fenced live key-range
+reassignment (shard drain).
+
+The reference ships multi-global-server load balancing via
+``Postoffice::GetServerKeyRanges`` (PAPER.md L1); here each shard is
+additionally its own FAILURE DOMAIN: killing one global shard stalls
+only its key range while every other shard's pushes keep completing,
+its standby is promoted under that shard's own term, and a zombie of
+shard k can never fence or corrupt shard j.  The fast tests run on the
+in-proc fabric; the OS-process SIGKILL soak is marked slow.
+"""
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from geomx_tpu.core.config import Config, NodeId, Topology
+from geomx_tpu.kvstore import Simulation
+from geomx_tpu.kvstore.keys import encode_tensor
+from geomx_tpu.ps.postoffice import MAX_KEY, split_range
+from geomx_tpu.transport.van import FaultPolicy
+
+pytestmark = pytest.mark.failover
+
+
+def _key(tid: int, size: int, shards: int = 2) -> int:
+    """The wire ps-key of a small (single-part) tensor."""
+    parts = encode_tensor(tid, size, shards)
+    assert len(parts) == 1
+    return parts[0].ps_key
+
+
+def _wait_for(pred, timeout=15.0, every=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+def _sharded_config(parties=2, shards=2, standbys=None, **kw):
+    kw.setdefault("request_retry_s", 0.4)
+    kw.setdefault("heartbeat_interval_s", 0.05)
+    kw.setdefault("heartbeat_timeout_s", 0.4)
+    kw.setdefault("replicate_every", 1)
+    # the knob the soaks tighten (satellite): replays land inside the
+    # test window instead of backing off past it
+    kw.setdefault("retry_backoff_cap", 2)
+    return Config(
+        topology=Topology(num_parties=parties, workers_per_party=1,
+                          num_global_servers=shards,
+                          num_standby_globals=(
+                              shards if standbys is None else standbys)),
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# key-range assignment
+# ---------------------------------------------------------------------------
+
+def test_key_range_assignment_deterministic_and_even():
+    """The GetServerKeyRanges analog: the encoding is a pure function of
+    (tensor_id, size, num_shards) — two independent encodes agree — and
+    a big tensor's parts cover EVERY shard with near-even element
+    counts; every emitted ps_key falls inside its claimed shard's
+    range."""
+    for shards in (1, 2, 4, 7):
+        ranges = split_range(shards)
+        assert ranges[0].begin == 0 and ranges[-1].end == MAX_KEY
+        for i in range(1, shards):
+            assert ranges[i].begin == ranges[i - 1].end  # no gap/overlap
+        per_shard = {s: 0 for s in range(shards)}
+        for tid in range(40):
+            a = encode_tensor(tid, 10_000_000, shards)
+            b = encode_tensor(tid, 10_000_000, shards)
+            assert [(p.ps_key, p.start, p.length, p.shard) for p in a] \
+                == [(p.ps_key, p.start, p.length, p.shard) for p in b]
+            assert sum(p.length for p in a) == 10_000_000
+            assert {p.shard for p in a} == set(range(shards))  # all covered
+            for p in a:
+                assert ranges[p.shard].contains(p.ps_key)
+                per_shard[p.shard] += p.length
+        spread = max(per_shard.values()) / min(per_shard.values())
+        assert spread < 1.01, f"uneven shard coverage: {per_shard}"
+        # small tensors hash whole onto one deterministic shard
+        small = {tid: encode_tensor(tid, 64, shards) for tid in range(64)}
+        for tid, parts in small.items():
+            assert len(parts) == 1
+            assert parts[0].shard == (tid * 9973) % shards
+        if shards > 1:
+            used = {p[0].shard for p in small.values()}
+            assert len(used) == shards, "hash never reaches some shards"
+
+
+def test_global_shards_config_knob(monkeypatch):
+    """``global_shards`` (field and GEOMX_GLOBAL_SHARDS) re-shards an
+    unsharded topology; an explicit num_global_servers always wins."""
+    monkeypatch.delenv("GEOMX_GLOBAL_SHARDS", raising=False)
+    assert Config().topology.num_global_servers == 1
+    assert Config(global_shards=4).topology.num_global_servers == 4
+    explicit = Config(global_shards=4, topology=Topology(
+        num_global_servers=3))
+    assert explicit.topology.num_global_servers == 3  # explicit wins
+    monkeypatch.setenv("GEOMX_GLOBAL_SHARDS", "2")
+    assert Config().topology.num_global_servers == 2
+    assert Config(topology=Topology(
+        num_global_servers=3)).topology.num_global_servers == 3
+    monkeypatch.setenv("GEOMX_GLOBAL_SHARDS", "-1")
+    with pytest.raises(ValueError):
+        Config()
+
+
+def test_shard_count_invariant_bit_identical_deterministic(monkeypatch):
+    """Acceptance: ``global_shards=1`` under deterministic mode is
+    bit-identical to today's single-global path — and because sharding
+    only moves whole ps-keys between servers (never splitting a key's
+    arithmetic), the trained weights are bit-identical across shard
+    counts too."""
+    monkeypatch.delenv("GEOMX_GLOBAL_SHARDS", raising=False)
+
+    def run(**cfg_kw):
+        cfg = Config(topology=Topology(num_parties=2, workers_per_party=1),
+                     deterministic=True, **cfg_kw)
+        sim = Simulation(cfg)
+        try:
+            ws = sim.all_workers()
+            rng = np.random.default_rng(7)
+            grads = {tid: rng.standard_normal(33).astype(np.float32)
+                     for tid in range(5)}
+            for w in ws:
+                for tid in grads:
+                    w.init(tid, np.zeros(33, np.float32))
+            ws[0].set_optimizer({"type": "adam", "lr": 0.05})
+            for _ in range(3):
+                for w in ws:
+                    for tid, g in grads.items():
+                        w.push(tid, g.copy())
+                for w in ws:
+                    for tid in grads:
+                        w.pull_sync(tid)
+            return {tid: ws[0].pull_sync(tid) for tid in grads}
+        finally:
+            sim.shutdown()
+
+    legacy = run()                    # today's single-global path
+    one = run(global_shards=1)        # the knob, explicitly 1
+    four = run(global_shards=4)       # sharded
+    for tid in legacy:
+        assert np.array_equal(legacy[tid], one[tid])
+        assert np.array_equal(legacy[tid], four[tid])
+
+
+# ---------------------------------------------------------------------------
+# per-shard failover isolation
+# ---------------------------------------------------------------------------
+
+def test_shard_kill_promotes_only_that_shard():
+    """SIGKILL-analog of one global shard mid-training: its standby is
+    promoted under THAT shard's term, pushes whose keys live on the
+    surviving shard complete while the killed shard is still dark, the
+    killed shard's in-flight round replays exactly-once at the standby,
+    and the surviving shard's term/primary are untouched."""
+    sim = Simulation(_sharded_config())
+    try:
+        ws = sim.all_workers()
+        # tid 0 -> shard 0, tid 1 -> shard 1 ((tid*9973) % 2)
+        for w in ws:
+            w.init(0, np.zeros(16, np.float32))
+            w.init(1, np.zeros(16, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        for w in ws:
+            w.push(0, np.ones(16, np.float32))
+            w.push(1, np.ones(16, np.float32))
+        for w in ws:
+            np.testing.assert_allclose(w.pull_sync(0), -1.0)
+            np.testing.assert_allclose(w.pull_sync(1), -1.0)
+            w.wait_all()
+        sb0, sb1 = sim.standby_globals
+        k1 = _key(1, 16)
+        assert _wait_for(lambda: k1 in sb1.store
+                         and np.allclose(sb1.store[k1], -1.0)), \
+            "shard 1 replication stalled"
+
+        sim.kill_global_server(1)
+        # the surviving shard keeps completing rounds while shard 1 is
+        # dark (detection has not even fired yet)
+        for w in ws:
+            w.push(0, np.ones(16, np.float32))
+        for w in ws:
+            np.testing.assert_allclose(w.pull_sync(0), -2.0)
+            w.wait_all()
+        # shard 1's round replays at its promoted standby, exactly-once
+        for w in ws:
+            w.push(1, np.ones(16, np.float32))
+        for w in ws:
+            np.testing.assert_allclose(w.pull_sync(1), -2.0)
+            w.wait_all()
+        # per-shard mechanism: only shard 1 moved
+        assert not sb1.is_standby and sb1.term == 1 and sb1.promotions == 1
+        assert sb0.is_standby and sb0.term == 0 and sb0.promotions == 0
+        gs0 = sim.global_servers[0]
+        assert not gs0._fenced and gs0.term == 0
+        assert sim.failover_monitor.failover_events == 1
+        from geomx_tpu.utils.metrics import system_snapshot
+
+        snap = system_snapshot("global_shard1.")
+        assert snap.get("global_shard1.promotions") >= 1
+        assert snap.get("global_shard1.term") == 1
+    finally:
+        sim.shutdown()
+
+
+def test_zombie_of_one_shard_cannot_fence_others():
+    """A revived zombie ex-primary of shard 1 is fenced by shard 1's
+    term — while shard 0's primary keeps serving, unfenced, at term 0
+    (the failure-domain isolation half of the split-brain guard)."""
+    sim = Simulation(_sharded_config(parties=1))
+    try:
+        w = sim.all_workers()[0]
+        w.init(0, np.zeros(8, np.float32))
+        w.init(1, np.zeros(8, np.float32))
+        w.set_optimizer({"type": "sgd", "lr": 1.0})
+        w.push(0, np.ones(8, np.float32))
+        w.push(1, np.ones(8, np.float32))
+        w.pull_sync(0)
+        w.pull_sync(1)
+        w.wait_all()
+        sb1 = sim.standby_globals[1]
+        k1 = _key(1, 8)
+        assert _wait_for(lambda: k1 in sb1.store
+                         and np.allclose(sb1.store[k1], -1.0))
+        gs1 = sim.kill_global_server(1)
+        assert _wait_for(lambda: not sb1.is_standby), "promotion stalled"
+        gs1.po.start()  # the zombie returns at its old identity
+        with gs1._mu:
+            gs1._repl.mark_locked(force=True)  # stale-term replication
+        assert _wait_for(lambda: gs1._fenced), "zombie never fenced"
+        assert gs1.term == sb1.term == 1
+        # shard 0 is a different failure domain: untouched
+        gs0 = sim.global_servers[0]
+        assert not gs0._fenced and gs0.term == 0
+        w.push(0, np.ones(8, np.float32))
+        np.testing.assert_allclose(w.pull_sync(0), -2.0)
+        w.wait_all()
+    finally:
+        sim.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# targeted fault injection (satellite)
+# ---------------------------------------------------------------------------
+
+def test_fault_policy_partition_and_heal_unit():
+    """FaultPolicy link cuts: exact pairs, wildcards, one-way cuts,
+    heal-by-node and heal-all — and unlike drop_rate, a cut eats
+    CONTROL traffic too (that's what starves heartbeats)."""
+    from geomx_tpu.transport.message import Control, Message
+
+    fp = FaultPolicy()
+
+    def msg(src, dst, control=Control.EMPTY):
+        m = Message(recipient=NodeId.parse(dst), control=control)
+        m.sender = NodeId.parse(src)
+        return m
+
+    a, b, c = "global_server:0", "global_server:1", "server:0@p0"
+    fp.partition(a, b)
+    assert fp.should_drop(msg(a, b)) and fp.should_drop(msg(b, a))
+    assert fp.should_drop(msg(a, b, Control.HEARTBEAT))  # control too
+    assert not fp.should_drop(msg(a, c))
+    fp.heal(a, b)
+    assert not fp.should_drop(msg(a, b))
+    fp.partition(a, b, symmetric=False)  # one-way: a->b dies, b->a lives
+    assert fp.should_drop(msg(a, b)) and not fp.should_drop(msg(b, a))
+    fp.partition(b, "*")  # isolate b entirely
+    assert fp.should_drop(msg(b, c)) and fp.should_drop(msg(c, b))
+    assert fp.cut_dropped > 0
+    fp.heal(b)  # heal everything naming b (the wildcard cuts included)
+    assert not fp.should_drop(msg(b, c)) and not fp.should_drop(msg(c, b))
+    fp.heal()
+    assert not fp.should_drop(msg(a, b))
+
+
+def test_partition_one_shard_triggers_its_failover_only():
+    """The soak-grade use: cut exactly ONE shard's links (heartbeats
+    included) instead of approximating with a global drop_rate — the
+    detector promotes that shard's standby; healing the cut turns the
+    old primary into a fenced zombie; the other shard never notices."""
+    sim = Simulation(_sharded_config(parties=1))
+    try:
+        w = sim.all_workers()[0]
+        w.init(0, np.zeros(8, np.float32))
+        w.init(1, np.zeros(8, np.float32))
+        w.set_optimizer({"type": "sgd", "lr": 1.0})
+        w.push(0, np.ones(8, np.float32))
+        w.push(1, np.ones(8, np.float32))
+        w.pull_sync(0)
+        w.pull_sync(1)
+        w.wait_all()
+        sb1 = sim.standby_globals[1]
+        k1 = _key(1, 8)
+        assert _wait_for(lambda: k1 in sb1.store
+                         and np.allclose(sb1.store[k1], -1.0))
+        gs1 = sim.global_servers[1]
+        sim.partition(gs1.po.node)  # one shard's links, cut exactly
+        assert _wait_for(lambda: not sb1.is_standby), \
+            "partitioned shard never failed over"
+        for w_ in sim.all_workers():
+            w_.push(1, np.ones(8, np.float32))
+        np.testing.assert_allclose(w.pull_sync(1), -2.0)
+        w.wait_all()
+        sim.heal()
+        # reachable again, the deposed primary hears the fencing
+        # broadcast (or its own rejected replication) and self-fences
+        with gs1._mu:
+            gs1._repl.mark_locked(force=True)
+        assert _wait_for(lambda: gs1._fenced), "healed zombie not fenced"
+        assert sim.global_servers[0].term == 0
+    finally:
+        sim.shutdown()
+
+
+def test_duplicate_injection_absorbed_exactly_once():
+    """Message-duplication injection: with duplicate_rate=1 every data
+    message is delivered twice, yet FSA arithmetic stays exact — the
+    replay-dedup windows absorb the duplicates (the at-least-once
+    failure mode the wire and replay layers must survive)."""
+    fault = FaultPolicy(duplicate_rate=1.0)
+    cfg = Config(topology=Topology(num_parties=2, workers_per_party=1),
+                 request_retry_s=5.0)
+    sim = Simulation(cfg, fault=fault)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(16, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        for step in range(1, 4):
+            for w in ws:
+                w.push(0, np.ones(16, np.float32))
+            for w in ws:
+                np.testing.assert_allclose(w.pull_sync(0), -float(step))
+                w.wait_all()
+        assert sim.fabric.duplicated > 0, "injection never fired"
+    finally:
+        sim.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# epoch-fenced live key-range reassignment (stretch tentpole)
+# ---------------------------------------------------------------------------
+
+def test_reassign_shard_to_standby_live():
+    """Planned maintenance: move shard 1's key range onto its standby
+    with the primary ALIVE.  The handoff ships the final state snapshot
+    (term-fenced), the old holder drains (silently drops stragglers so
+    the replay path retargets them), and arithmetic continues exactly."""
+    sim = Simulation(_sharded_config(parties=2))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(8, np.float32))
+            w.init(1, np.zeros(8, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        for w in ws:
+            w.push(0, np.ones(8, np.float32))
+            w.push(1, np.ones(8, np.float32))
+        for w in ws:
+            w.pull_sync(0)
+            w.pull_sync(1)
+            w.wait_all()
+        gs1, sb1 = sim.global_servers[1], sim.standby_globals[1]
+        assert sim.reassign_shard(1), "handoff failed"
+        assert gs1._fenced and gs1.drains == 1
+        assert _wait_for(lambda: not sb1.is_standby)
+        for w in ws:
+            w.push(1, np.ones(8, np.float32))
+        for w in ws:
+            np.testing.assert_allclose(w.pull_sync(1), -2.0)
+            w.wait_all()
+        assert sb1.term == 1
+        assert sim.failover_monitor.reassignments == 1
+    finally:
+        sim.shutdown()
+
+
+def test_reassign_shard_drain_onto_live_primary():
+    """Shard DRAIN: shard 1's key range moves onto shard 0's primary,
+    which then serves BOTH ranges (merged state, optimizer trajectory
+    included: post-drain arithmetic continues the pre-drain SGD run
+    exactly).  The drained holder is term-fenced; the dedup window
+    travels, so replays stay exactly-once."""
+    sim = Simulation(_sharded_config(parties=2, standbys=0,
+                                     heartbeat_interval_s=0.0))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(8, np.float32))
+            w.init(1, np.zeros(8, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        for w in ws:
+            w.push(0, np.ones(8, np.float32))
+            w.push(1, np.ones(8, np.float32))
+        for w in ws:
+            np.testing.assert_allclose(w.pull_sync(0), -1.0)
+            np.testing.assert_allclose(w.pull_sync(1), -1.0)
+            w.wait_all()
+        gs0, gs1 = sim.global_servers
+        keys_before = set(gs0.store)
+        assert sim.reassign_shard(1, target=gs0.po.node), "drain failed"
+        # the target adopted the drained range next to its own
+        assert gs1._fenced and gs1._draining and gs1.drains == 1
+        assert gs0.merged_handoffs == 1
+        assert set(gs0.store) > keys_before
+        assert not gs0._fenced  # the target is not deposed by the move
+        # both ranges now complete rounds on the one holder — and the
+        # SGD trajectory continues exactly (optimizer state traveled)
+        for w in ws:
+            w.push(0, np.ones(8, np.float32))
+            w.push(1, np.ones(8, np.float32))
+        for w in ws:
+            np.testing.assert_allclose(w.pull_sync(0), -2.0)
+            np.testing.assert_allclose(w.pull_sync(1), -2.0)
+            w.wait_all()
+        # the zombie fence holds: pushing straight at the drained holder
+        # is silently dropped (dead to the data plane), never merged
+        np.testing.assert_allclose(gs0.store[_key(1, 8)],
+                                   -2 * np.ones(8, np.float32))
+    finally:
+        sim.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# slow: OS-process SIGKILL chaos soak
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_shard_chaos_e2e_processes(tmp_path):
+    """Acceptance: full OS-process topology over TCP with TWO global
+    shards, each with a hot standby; SIGKILL shard 1's primary
+    mid-training.  Training finishes every step with loss parity vs an
+    uninterrupted control, shard 1's standby reports the promotion
+    under term 1, shard 0's primary reports term 0 (never fenced), and
+    the local servers log the per-shard retarget."""
+    import tests.test_tcp as ttcp
+
+    cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    topo = Topology(num_parties=1, workers_per_party=1,
+                    num_global_servers=2, num_standby_globals=2)
+
+    def run_cluster(base, kill_shard):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu", "JAX_PLATFORM_NAME": "cpu",
+            "GEOMX_GLOBAL_SHARDS": "2",
+            "GEOMX_NUM_STANDBY_GLOBALS": "2",
+            "GEOMX_HEARTBEAT_INTERVAL": "0.2",
+            "GEOMX_HEARTBEAT_TIMEOUT": "1.5",
+            "GEOMX_REQUEST_RETRY_S": "1.0",
+            "GEOMX_RETRY_BACKOFF_CAP": "2",
+            # small bound so the model's big leaves split across shards
+            "GEOMX_BIGARRAY_BOUND": "2000",
+        })
+
+        def spawn(role):
+            return subprocess.Popen(
+                [sys.executable, "-m", "geomx_tpu.launch", "--role", role,
+                 "--parties", "1", "--workers", "1",
+                 "--global-shards", "2", "--standby-globals", "2",
+                 "--base-port", str(base), "--steps", "120"],
+                cwd=cwd, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+
+        import threading
+
+        roles = [str(n) for n in topo.all_nodes()]
+        procs = {r: spawn(r) for r in roles}
+        victim = str(topo.global_servers()[1])
+        wrole = str(topo.workers(0)[0])
+        # stream the worker's stdout live: the kill is keyed off its
+        # "training begins" marker, not wall-clock (process bring-up on
+        # a loaded host can outlast any fixed sleep)
+        wlines: list = []
+        threading.Thread(
+            target=lambda: [wlines.append(ln)
+                            for ln in procs[wrole].stdout],
+            daemon=True).start()
+        try:
+            if kill_shard:
+                deadline = time.monotonic() + 120
+                while (time.monotonic() < deadline
+                       and not any("training begins" in ln
+                                   for ln in wlines)):
+                    time.sleep(0.2)
+                assert any("training begins" in ln for ln in wlines), (
+                    "worker never started training:\n" + "".join(wlines))
+                time.sleep(3.0)  # several rounds + replication shipped
+                procs[victim].send_signal(signal.SIGKILL)
+                procs[victim].wait(timeout=10)
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                live = [p for r, p in procs.items()
+                        if r != victim or not kill_shard]
+                if all(p.poll() is not None for p in live):
+                    break
+                time.sleep(0.5)
+            outputs = {}
+            for r, p in procs.items():
+                if p.poll() is None:
+                    p.kill()
+                if r == wrole:
+                    p.wait(timeout=10)
+                    time.sleep(0.2)  # let the tail thread drain
+                    outputs[r] = "".join(wlines)
+                else:
+                    outputs[r] = ("" if (r == victim and kill_shard)
+                                  else p.communicate()[0])
+            return outputs
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+
+    def last_loss(out):
+        m = re.search(r"last_loss=([0-9.]+)", out)
+        assert m, out[-2000:]
+        return float(m.group(1))
+
+    ctrl = run_cluster(ttcp.free_base_port(), kill_shard=False)
+    wrole = str(topo.workers(0)[0])
+    assert "steps=120" in ctrl[wrole], ctrl[wrole][-2000:]
+
+    outs = run_cluster(ttcp.free_base_port(), kill_shard=True)
+    assert "steps=120" in outs[wrole], outs[wrole][-2000:]
+    # per-shard promotion: standby 1 took shard 1 under term 1...
+    sb1 = outs[str(topo.standby_globals()[1])]
+    assert "promoted to primary" in sb1 and "term=1" in sb1, sb1[-2000:]
+    # ...while shard 0's primary never moved or fenced
+    gs0 = outs[str(topo.global_servers()[0])]
+    assert "fenced" not in gs0, gs0[-2000:]
+    assert "term=1" not in gs0, gs0[-2000:]
+    sb0 = outs[str(topo.standby_globals()[0])]
+    assert "promoted to primary" not in sb0, sb0[-2000:]
+    # the local server retargeted exactly the killed shard
+    srv = outs[str(topo.server(0))]
+    assert re.search(r"global shard 1 failed over to", srv), srv[-2000:]
+    # loss parity vs the uninterrupted control (same tolerance band as
+    # the single-global failover soak)
+    assert abs(last_loss(outs[wrole]) - last_loss(ctrl[wrole])) < 0.35, (
+        last_loss(outs[wrole]), last_loss(ctrl[wrole]))
