@@ -1491,6 +1491,60 @@ def child_shards():
     }))
 
 
+def child_obs():
+    """Metrics-pump overhead guard (ISSUE 7 satellite): enabled-vs-
+    disabled round wall on the flagship-shaped 2-party push/pull
+    workload, mirroring the trace overhead guard — the telemetry plane
+    must ride along at ~zero cost to the round pipeline.  Also reports
+    the collected-report count so a 'cheap because dead' pump is
+    distinguishable from a cheap live one."""
+    import numpy as np
+
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.kvstore import Simulation
+
+    N = int(os.environ.get("BENCH_OBS_ELEMS", "5000000"))
+
+    def run(obs: bool):
+        cfg = Config(topology=Topology(num_parties=2, workers_per_party=1),
+                     enable_obs=obs,
+                     obs_interval_s=(0.05 if obs else 0.0))
+        sim = Simulation(cfg)
+        try:
+            ws = sim.all_workers()
+            for w in ws:
+                w.init(0, np.zeros(N, np.float32))
+            ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+            g = np.ones(N, np.float32)
+
+            def one_round() -> float:
+                t0 = time.perf_counter()
+                for w in ws:
+                    w.push(0, g)
+                for w in ws:
+                    w.pull_sync(0)
+                    w.wait_all()
+                return time.perf_counter() - t0
+
+            one_round()  # cold: one-time costs
+            dt = min(one_round(), one_round())
+            reports = (sim.metrics_collector.reports_received
+                       if obs else 0)
+            return dt, reports
+        finally:
+            sim.shutdown()
+
+    base, _ = run(False)
+    obs_dt, reports = run(True)
+    print(json.dumps({
+        "tensor_elems": N,
+        "round_wall_s_disabled": round(base, 4),
+        "round_wall_s_enabled": round(obs_dt, 4),
+        "overhead_pct": round(100.0 * (obs_dt - base) / max(base, 1e-9), 2),
+        "reports_received": reports,
+    }))
+
+
 def child_stress():
     """Server merge throughput at scale (VERDICT r1 item 5): one party of
     4 workers pushing a 50M-element tensor (200 MB) through the two-tier
@@ -1889,6 +1943,9 @@ def _compact(record: dict) -> dict:
     sh = record.get("shards") or {}
     if sh.get("flagship_50m_round_wall_s"):
         out["shards_round_wall_s"] = sh["flagship_50m_round_wall_s"]
+    ob = record.get("obs") or {}
+    if ob.get("overhead_pct") is not None:
+        out["obs_overhead_pct"] = ob["overhead_pct"]
     sd = record.get("serde") or {}
     if sd.get("speedup_encode"):
         out["serde_speedup"] = {"encode": sd["speedup_encode"],
@@ -2044,7 +2101,7 @@ def main():
                     choices=["cnn", "mfu", "mfu_sweep", "quant", "wan",
                              "overlap", "overlap_tpu", "stress", "probe",
                              "flash_autotune", "lm", "scaling", "parity",
-                             "serde", "shards"])
+                             "serde", "shards", "obs"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -2069,7 +2126,7 @@ def main():
          "overlap_tpu": child_overlap_tpu, "stress": child_stress,
          "probe": child_probe, "lm": child_lm, "scaling": child_scaling,
          "parity": child_parity, "serde": child_serde,
-         "shards": child_shards,
+         "shards": child_shards, "obs": child_obs,
          "flash_autotune": child_flash_autotune}[args.child]()
         return
 
@@ -2169,6 +2226,7 @@ def main():
         _do("parity", 280, cpu_env)
         _do("stress", 180, cpu_env)
         _do("shards", 240, cpu_env)
+        _do("obs", 180, cpu_env)
 
     cpu_thread = threading.Thread(target=cpu_chain, daemon=True)
     cpu_thread.start()

@@ -75,13 +75,21 @@ class AdaptiveWanController:
     """One per deployment, on the global scheduler's postoffice."""
 
     def __init__(self, postoffice: Postoffice,
-                 config: Optional[Config] = None, collector=None):
+                 config: Optional[Config] = None, collector=None,
+                 metrics=None):
         assert postoffice.node.role is Role.GLOBAL_SCHEDULER, \
             "the adaptive WAN controller runs on the global scheduler"
         self.po = postoffice
         self.config = config or postoffice.config
         self.topology = postoffice.topology
         self.collector = collector  # TraceCollector (optional)
+        self.metrics = metrics      # MetricsCollector (optional): when
+        #                             the telemetry plane already pumps
+        #                             QUERY_STATS-equivalent samples on
+        #                             an interval, the controller reads
+        #                             those instead of issuing its own
+        #                             per-server QUERY_STATS sweeps
+        self.metrics_samples = 0    # sweeps served from collected series
         cfg = self.config
         base = self._base_compression(cfg)
         self.engine = WanPolicyEngine(
@@ -167,7 +175,19 @@ class AdaptiveWanController:
 
     def _sample_servers(self) -> Dict[str, dict]:
         out: Dict[str, dict] = {}
+        max_age = max(2.0 * self.config.adapt_interval_s,
+                      2.0 * getattr(self.config, "obs_interval_s", 0.0),
+                      2.0)
         for s in self.topology.servers():
+            if self.metrics is not None:
+                # collected-series fast path: the pump's sample IS the
+                # QUERY_STATS body, so a fresh ring entry replaces one
+                # RPC round trip per server per sweep
+                stats = self.metrics.latest_stats(str(s), max_age_s=max_age)
+                if stats is not None:
+                    out[str(s)] = stats
+                    self.metrics_samples += 1
+                    continue
             reply = self._app.rpc(s, Ctrl.QUERY_STATS, timeout=2.0)
             if reply is not None:
                 out[str(s)] = reply

@@ -80,6 +80,7 @@ class SignalEstimator:
         self._rounds: Dict[str, _Window] = {}    # per local server
         self._bytes: Dict[str, _Window] = {}     # per codec tag
         self._rtt: Dict[str, float] = {}
+        self._boots: Dict[str, int] = {}
         self._rounds_total = 0
 
     # ---- ingestion ----------------------------------------------------------
@@ -91,6 +92,17 @@ class SignalEstimator:
         report (``TraceCollector.critical_path()``)."""
         total_rounds = 0
         for node, stats in server_stats.items():
+            # boot fence: a warm-booted replacement reports from zero —
+            # restart this node's windows so the reset neither reads as
+            # "no rounds completing" (Δ <= 0 forever against the old
+            # totals) nor as a goodput collapse
+            boot = int(stats.get("boot", 0) or 0)
+            if boot and self._boots.get(node, boot) != boot:
+                self._rounds.pop(node, None)
+                self._bytes.pop(node, None)
+                self._rtt.pop(node, None)
+            if boot:
+                self._boots[node] = boot
             r = float(stats.get("wan_push_rounds", 0) or 0)
             total_rounds += int(r)
             self._rounds.setdefault(node, _Window(self.window)).push(now, r)

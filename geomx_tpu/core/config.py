@@ -450,6 +450,29 @@ class Config:
     #                                 budget*(1±deadband)
     adapt_cooldown_s: float = 5.0   # min seconds between policy changes
     adapt_window: int = 8           # sliding-window length (samples)
+    # --- cluster telemetry plane (geomx_tpu/obs; beyond the reference,
+    # whose monitoring is per-process profiler dumps).  When on, every
+    # node runs a MetricsPump shipping registry + role-stats samples as
+    # METRICS_REPORT frames to a MetricsCollector on the global
+    # scheduler, and a HealthEngine evaluates SLO rules (round stall,
+    # replication lag, goodput collapse, RTT outliers, fence spikes)
+    # over the collected series.  Off (default) = no pump, no collector,
+    # no threads, no frames — one flag check at construction time.  The
+    # Ctrl.CLUSTER_STATE console is independent of this flag (it costs
+    # nothing until queried).  See docs/observability.md.
+    enable_obs: bool = False
+    obs_interval_s: float = 1.0     # pump/health cadence; 0 = no sweep
+    #                                 threads (manual ship()/tick() only —
+    #                                 what deterministic tests use)
+    obs_window: int = 256           # ring-buffered samples kept per node
+    obs_alert_log: str = ""         # JSONL alert/recovery record log path
+    obs_stall_factor: float = 4.0   # round-stall: k x rolling-median gap
+    obs_stall_min_s: float = 2.0    # round-stall floor (seconds)
+    obs_repl_lag_s: float = 60.0    # replication-lag alert ceiling
+    obs_rtt_s: float = 1.0          # heartbeat-RTT alert ceiling
+    obs_goodput_frac: float = 0.1   # goodput-collapse fraction of peak
+    obs_fence_spike: int = 8        # fenced/evicted events per window
+    obs_imbalance_factor: float = 4.0  # slowest-shard busy vs peer mean
     verbose: int = 0
 
     def __post_init__(self):
@@ -528,6 +551,16 @@ class Config:
             raise ValueError("adapt_deadband must be in [0, 1)")
         if self.adapt_window < 2:
             raise ValueError("adapt_window must be >= 2")
+        if self.obs_interval_s < 0:
+            raise ValueError("obs_interval_s must be >= 0 (0 = manual)")
+        if self.obs_window < 8:
+            raise ValueError("obs_window must be >= 8 (rate math needs "
+                             "a real ring)")
+        if self.obs_stall_factor < 1.0 or self.obs_stall_min_s < 0:
+            raise ValueError("round-stall thresholds must be "
+                             "obs_stall_factor >= 1, obs_stall_min_s >= 0")
+        if not 0.0 < self.obs_goodput_frac < 1.0:
+            raise ValueError("obs_goodput_frac must be in (0, 1)")
         if self.replicate_every < 1:
             raise ValueError("replicate_every must be >= 1")
         if self.server_shards < 0:
@@ -638,5 +671,16 @@ class Config:
             adapt_deadband=_env_float("GEOMX_ADAPT_DEADBAND", 0.25),
             adapt_cooldown_s=_env_float("GEOMX_ADAPT_COOLDOWN", 5.0),
             adapt_window=_env_int("GEOMX_ADAPT_WINDOW", 8),
+            enable_obs=_env_bool("GEOMX_OBS"),
+            obs_interval_s=_env_float("GEOMX_OBS_INTERVAL", 1.0),
+            obs_window=_env_int("GEOMX_OBS_WINDOW", 256),
+            obs_alert_log=os.environ.get("GEOMX_OBS_ALERT_LOG", ""),
+            obs_stall_factor=_env_float("GEOMX_OBS_STALL_FACTOR", 4.0),
+            obs_stall_min_s=_env_float("GEOMX_OBS_STALL_MIN", 2.0),
+            obs_repl_lag_s=_env_float("GEOMX_OBS_REPL_LAG", 60.0),
+            obs_rtt_s=_env_float("GEOMX_OBS_RTT", 1.0),
+            obs_goodput_frac=_env_float("GEOMX_OBS_GOODPUT_FRAC", 0.1),
+            obs_fence_spike=_env_int("GEOMX_OBS_FENCE_SPIKE", 8),
+            obs_imbalance_factor=_env_float("GEOMX_OBS_IMBALANCE", 4.0),
             verbose=_env_int("GEOMX_VERBOSE", _env_int("PS_VERBOSE", 0)),
         )

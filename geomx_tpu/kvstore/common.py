@@ -327,3 +327,15 @@ class Ctrl(enum.IntEnum):
     #                            pushes then carry Message.policy_epoch and
     #                            cross-epoch payloads are fenced with a
     #                            retryable error (geomx_tpu/control)
+    METRICS_REPORT = 24        # node -> global scheduler (fire-and-forget,
+    #                            no response slot, same contract as
+    #                            TRACE_REPORT): one time-series sample of
+    #                            the sender's system-metrics registry +
+    #                            QUERY_STATS-style role stats, ring-
+    #                            buffered by the MetricsCollector
+    #                            (geomx_tpu/obs)
+    CLUSTER_STATE = 25         # operator query -> global scheduler: reply
+    #                            with the merged live cluster state (shard
+    #                            holders/terms, party fold state, per-node
+    #                            heartbeat freshness, WAN policy epoch,
+    #                            active health alerts — geomx_tpu/obs/state)

@@ -341,6 +341,16 @@ class GlobalFailoverMonitor:
         self._broadcast_new_primary(rank, old=old, repeats=3)
         return True
 
+    def shard_table(self) -> dict:
+        """Operator/console view of the shard map: rank ->
+        {holder, term, promoted} (the cluster-state service merges this
+        with heartbeat freshness and per-shard registry counters)."""
+        with self._mu:
+            return {r: {"holder": str(self._holders[r]),
+                        "term": int(self._terms[r]),
+                        "promoted": r in self._promoted}
+                    for r in self._holders}
+
     def _record_move(self, rank: int, old: NodeId, new: NodeId, term: int):
         """Shared bookkeeping for a shard's key range changing hands
         (promotion or reassignment): term, holder, shared resolver, and

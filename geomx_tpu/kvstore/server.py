@@ -1815,40 +1815,7 @@ class LocalServer:
             self.hfa_enabled = bool(body["enabled"])
             self.hfa_k2 = int(body.get("k2", 1))
         elif msg.cmd == Ctrl.QUERY_STATS:
-            van = self.po.van
-            with self._mu:
-                # memory accounting (the reference profiler's memory
-                # stats, ref: src/profiler/profiler.h:256-304): resident
-                # weight replicas + in-flight aggregation buffers
-                store_b = sum(a.nbytes for a in self.store.values())
-                accum_b = sum(st.accum.nbytes for st in self._keys.values()
-                              if st.accum is not None)
-            self.server.reply_cmd(msg, body={
-                "wan_send_bytes": van.wan_send_bytes,
-                "wan_recv_bytes": van.wan_recv_bytes,
-                "send_bytes": van.send_bytes,
-                "recv_bytes": van.recv_bytes,
-                "store_bytes": store_b,
-                "accum_bytes": accum_b,
-                "hfa_gated_key_rounds": self.hfa_gated_key_rounds,
-                "ts_deliveries": self.ts_deliveries,
-                "stale_pull_skips": self.stale_pull_skips,
-                # crash-tolerant membership observability
-                "evicted_workers": self.evicted_workers,
-                "eviction_fenced_pushes": self.eviction_fenced_pushes,
-                "warm_boots": self.warm_boots,
-                "mpq_bsc_picks": getattr(self.push_codec, "bsc_picks", 0),
-                "mpq_fp16_picks": getattr(self.push_codec, "fp16_picks", 0),
-                "pq_overtakes": van.pq_overtakes,
-                # adaptive-WAN controller signals: round rate + link RTT
-                # + this sender's applied policy epoch
-                "wan_push_rounds": self.wan_push_rounds,
-                "policy_epoch": self._policy_epoch,
-                "policy_fence_retries": self.policy_fence_retries,
-                "policy_drops": self.policy_drops,
-                "hb_rtt_s": max(self.po.heartbeat_rtts().values(),
-                                default=None),
-            })
+            self.server.reply_cmd(msg, body=self.stats())
             return
         elif msg.cmd == Ctrl.ESYNC:
             # state server (ESync, ref README.md:45 "to be integrated"):
@@ -1876,6 +1843,51 @@ class LocalServer:
             _handle_profiler_cmd(self.po, msg, self.server)
             return
         self.server.reply_cmd(msg)
+
+    def stats(self) -> dict:
+        """The QUERY_STATS body — also sampled on an interval by the
+        telemetry plane's MetricsPump (geomx_tpu/obs), so the wire
+        query and the shipped time series can never disagree."""
+        van = self.po.van
+        with self._mu:
+            # memory accounting (the reference profiler's memory
+            # stats, ref: src/profiler/profiler.h:256-304): resident
+            # weight replicas + in-flight aggregation buffers
+            store_b = sum(a.nbytes for a in self.store.values())
+            accum_b = sum(st.accum.nbytes for st in self._keys.values()
+                          if st.accum is not None)
+        return {
+            "wan_send_bytes": van.wan_send_bytes,
+            "wan_recv_bytes": van.wan_recv_bytes,
+            "send_bytes": van.send_bytes,
+            "recv_bytes": van.recv_bytes,
+            "store_bytes": store_b,
+            "accum_bytes": accum_b,
+            "hfa_gated_key_rounds": self.hfa_gated_key_rounds,
+            "ts_deliveries": self.ts_deliveries,
+            "stale_pull_skips": self.stale_pull_skips,
+            # crash-tolerant membership observability
+            "evicted_workers": self.evicted_workers,
+            "eviction_fenced_pushes": self.eviction_fenced_pushes,
+            "warm_boots": self.warm_boots,
+            "mpq_bsc_picks": getattr(self.push_codec, "bsc_picks", 0),
+            "mpq_fp16_picks": getattr(self.push_codec, "fp16_picks", 0),
+            "pq_overtakes": van.pq_overtakes,
+            # adaptive-WAN controller signals: round rate + link RTT
+            # + this sender's applied policy epoch
+            "wan_push_rounds": self.wan_push_rounds,
+            "policy_epoch": self._policy_epoch,
+            "policy_fence_retries": self.policy_fence_retries,
+            "policy_drops": self.policy_drops,
+            "hb_rtt_s": max(self.po.heartbeat_rtts().values(),
+                            default=None),
+            # restart discrimination: a warm-booted replacement's zeroed
+            # counters carry a fresh boot nonce + near-zero uptime, so a
+            # collector can fence its rate windows instead of reading
+            # the reset as a rate collapse
+            "uptime_s": self.po.uptime_s(),
+            "boot": van.boot,
+        }
 
     def leave_global(self, timeout: float = 30.0) -> dict:
         """Gracefully withdraw this PARTY from the global tier (VERDICT
@@ -2006,6 +2018,10 @@ class GlobalServer:
         #                            lifetime; Customer ids don't recycle)
         self.drains = 0            # completed handoffs (observability)
         self.merged_handoffs = 0   # key ranges adopted from a drain
+        self.key_rounds = 0        # completed (key, round) optimizer
+        #                            updates — the telemetry plane's
+        #                            per-shard round-progress series
+        #                            (a stalled shard stops counting)
         self.optimizer: ServerOptimizer = Sgd()
         self._optimizer_configured = False  # flips on SET_OPTIMIZER; a
         #                                     central-worker deployment
@@ -2507,6 +2523,7 @@ class GlobalServer:
         barrier (both snapshot cross-key state), then flush acks."""
         for m in reparks:
             self._park_pull(m)
+        self.key_rounds += len(completed_keys)  # GIL-atomic int add
         dissem = None
         if completed_keys and (
                 self._repl is not None or self.ts_inter is not None
@@ -2536,6 +2553,7 @@ class GlobalServer:
         for m in reparks:
             self._park_pull(m)
         if completed:
+            self.key_rounds += len(completed)
             self._auto_ckpt_locked(len(completed))
             if self._repl is not None:
                 self._repl.mark_locked(len(completed))
@@ -2606,6 +2624,7 @@ class GlobalServer:
                 else:
                     self.store[k] = self.optimizer.update_scaled(
                         k, self.store[k], grad, 1.0)
+            self.key_rounds += len(kvs.keys)
             self._auto_ckpt_locked(len(kvs.keys))
             if self._repl is not None:
                 self._repl.mark_locked(len(kvs.keys))
@@ -3270,48 +3289,7 @@ class GlobalServer:
                 return
             self.sync_mode = bool(body["sync"])
         elif msg.cmd == Ctrl.QUERY_STATS:
-            van = self.po.van
-            with self._mu:
-                store_b = sum(a.nbytes for a in self.store.values())
-                accum_b = sum(st.accum.nbytes for st in self._keys.values()
-                              if st.accum is not None)
-            self.server.reply_cmd(msg, body={
-                "wan_send_bytes": van.wan_send_bytes,
-                "wan_recv_bytes": van.wan_recv_bytes,
-                "store_bytes": store_b,
-                "accum_bytes": accum_b,
-                # lets a central-worker deployment confirm configuration
-                # landed before training starts (the reference sequences
-                # this through the master worker finishing first)
-                "optimizer": type(self.optimizer).__name__.lower(),
-                "optimizer_configured": self._optimizer_configured,
-                # forced dense resyncs of the BSC pull compressor: a
-                # nonzero steady-state rate means the pull direction is
-                # degrading to uncompressed (e.g. sustained overlapping
-                # rounds of one key) — observability for finding that
-                "pull_resyncs": (self.pull_comp.resyncs
-                                 if self.pull_comp is not None else 0),
-                # failover observability: term fencing + replication
-                "term": self.term,
-                "is_standby": self.is_standby,
-                "promotions": self.promotions,
-                "fenced_rejects": self.fenced_rejects,
-                "replication_seq": self._repl_seq,
-                "replication_acked_seq": (self._repl.acked_seq
-                                          if self._repl is not None else 0),
-                # crash-tolerant membership: reversible party folds
-                "party_folds": self.party_folds,
-                "party_unfolds": self.party_unfolds,
-                "num_global_workers": self.num_contributors,
-                # adaptive WAN: receiver-side epoch + fence observables
-                "policy_epoch": self._policy_epoch,
-                "policy_fenced_pushes": self.policy_fenced_pushes,
-                "rejected_compr_tags": self.rejected_compr_tags,
-                # key-range reassignment (shard drain) observables
-                "drains": self.drains,
-                "merged_handoffs": self.merged_handoffs,
-                "draining": self._draining,
-            })
+            self.server.reply_cmd(msg, body=self.stats())
             return
         elif msg.cmd == Ctrl.LIST_KEYS:
             # a replacement local server's warm boot asks for the hosted
@@ -3347,6 +3325,58 @@ class GlobalServer:
                 self.server.reply_cmd(msg, body={"error": repr(e)})
             return
         self.server.reply_cmd(msg)
+
+    def stats(self) -> dict:
+        """The QUERY_STATS body — also sampled on an interval by the
+        telemetry plane's MetricsPump (geomx_tpu/obs)."""
+        van = self.po.van
+        with self._mu:
+            store_b = sum(a.nbytes for a in self.store.values())
+            accum_b = sum(st.accum.nbytes for st in self._keys.values()
+                          if st.accum is not None)
+        return {
+            "wan_send_bytes": van.wan_send_bytes,
+            "wan_recv_bytes": van.wan_recv_bytes,
+            "store_bytes": store_b,
+            "accum_bytes": accum_b,
+            # lets a central-worker deployment confirm configuration
+            # landed before training starts (the reference sequences
+            # this through the master worker finishing first)
+            "optimizer": type(self.optimizer).__name__.lower(),
+            "optimizer_configured": self._optimizer_configured,
+            # forced dense resyncs of the BSC pull compressor: a
+            # nonzero steady-state rate means the pull direction is
+            # degrading to uncompressed (e.g. sustained overlapping
+            # rounds of one key) — observability for finding that
+            "pull_resyncs": (self.pull_comp.resyncs
+                             if self.pull_comp is not None else 0),
+            # failover observability: term fencing + replication
+            "term": self.term,
+            "is_standby": self.is_standby,
+            "promotions": self.promotions,
+            "fenced_rejects": self.fenced_rejects,
+            "replication_seq": self._repl_seq,
+            "replication_acked_seq": (self._repl.acked_seq
+                                      if self._repl is not None else 0),
+            # crash-tolerant membership: reversible party folds
+            "party_folds": self.party_folds,
+            "party_unfolds": self.party_unfolds,
+            "num_global_workers": self.num_contributors,
+            # adaptive WAN: receiver-side epoch + fence observables
+            "policy_epoch": self._policy_epoch,
+            "policy_fenced_pushes": self.policy_fenced_pushes,
+            "rejected_compr_tags": self.rejected_compr_tags,
+            # key-range reassignment (shard drain) observables
+            "drains": self.drains,
+            "merged_handoffs": self.merged_handoffs,
+            "draining": self._draining,
+            # round progress: completed (key, round) pairs — the health
+            # engine's per-shard round-stall input
+            "key_rounds": self.key_rounds,
+            # restart discrimination (see LocalServer.stats)
+            "uptime_s": self.po.uptime_s(),
+            "boot": van.boot,
+        }
 
     def stop(self):
         if self._repl is not None:

@@ -43,6 +43,21 @@ class Simulation:
             po.start()
             self.offices[str(n)] = po
             self._attach_tracer(po, fresh=True)
+        # cluster telemetry plane (geomx_tpu/obs): collector + health
+        # engine on the global scheduler, constructed BEFORE any pump so
+        # no METRICS_REPORT can beat the endpoint registration
+        self.metrics_collector = None
+        self.health = None
+        self.metrics_pumps: Dict[str, "MetricsPump"] = {}
+        if config.enable_obs:
+            from geomx_tpu.obs import HealthEngine, MetricsCollector
+
+            self.metrics_collector = MetricsCollector(
+                self.offices[gsched], config,
+                trace_collector=self.trace_collector)
+            self.health = HealthEngine(
+                self.metrics_collector, config,
+                trace_collector=self.trace_collector)
         self.ts_schedulers = []
         if config.enable_intra_ts:
             from geomx_tpu.sched.ts_push import TsPushScheduler
@@ -128,7 +143,36 @@ class Simulation:
 
             self.wan_controller = AdaptiveWanController(
                 self.offices[str(self.topology.global_scheduler())],
-                config, collector=self.trace_collector)
+                config, collector=self.trace_collector,
+                metrics=self.metrics_collector)
+        # per-node metrics pumps (telemetry plane): server roles ship
+        # their QUERY_STATS-equivalent stats dict, everyone ships their
+        # registry slice; frames ride the wire like every other node's
+        # traffic (the gsched's own pump short-circuits in-proc)
+        if config.enable_obs:
+            from geomx_tpu.obs import MetricsPump
+
+            stats_fns = {str(ls.po.node): ls.stats
+                         for ls in self.local_servers}
+            stats_fns.update({str(gs.po.node): gs.stats for gs in
+                              self.global_servers + self.standby_globals})
+            for s, po in self.offices.items():
+                self.metrics_pumps[s] = MetricsPump(
+                    po, config, stats_fn=stats_fns.get(s),
+                    collector=(self.metrics_collector
+                               if s == gsched else None))
+        # live cluster-state console: always on (costs nothing until
+        # queried); Simulation.cluster_state() and the Ctrl.CLUSTER_STATE
+        # wire query share compose()
+        from geomx_tpu.obs import ClusterStateService
+
+        self.state_service = ClusterStateService(
+            self.offices[gsched], config,
+            failover_monitor=self.failover_monitor,
+            recovery_monitor=self.recovery_monitor,
+            wan_controller=self.wan_controller,
+            collector=self.metrics_collector,
+            health=self.health)
 
     def _attach_tracer(self, po: Postoffice, fresh: bool = False) -> None:
         """Bind the node's tracer to its (possibly replacement)
@@ -180,6 +224,35 @@ class Simulation:
             "tracing off: set Config.trace_sample_every"
         self.flush_traces()
         return self.trace_collector.critical_path()
+
+    def pump_metrics(self, timeout: float = 5.0) -> int:
+        """Ship one sample from every node's pump and wait for the
+        collector to have ingested them; returns reports_received.
+        The deterministic driver for ``obs_interval_s == 0`` tests."""
+        assert self.metrics_collector is not None, \
+            "telemetry off: set Config.enable_obs"
+        import time as _time
+
+        before = self.metrics_collector.reports_received
+        sent = sum(1 for p in self.metrics_pumps.values() if p.ship())
+        deadline = _time.monotonic() + timeout
+        while (_time.monotonic() < deadline
+               and self.metrics_collector.reports_received < before + sent):
+            # a killed node's ship() can claim success into a dead van —
+            # settle on "no growth" rather than the exact count
+            cur = self.metrics_collector.reports_received
+            _time.sleep(0.02)
+            if self.metrics_collector.reports_received == cur >= before:
+                _time.sleep(0.05)
+                if self.metrics_collector.reports_received == cur:
+                    break
+        return self.metrics_collector.reports_received
+
+    def cluster_state(self) -> dict:
+        """The merged live cluster state (same composition the
+        Ctrl.CLUSTER_STATE wire query and ``python -m geomx_tpu.status``
+        render — see docs/observability.md)."""
+        return self.state_service.compose()
 
     def worker(self, party: int, rank: int) -> WorkerKVStore:
         return self.workers[str(NodeId.parse(f"worker:{rank}@p{party}"))]
@@ -282,6 +355,7 @@ class Simulation:
 
             self.failover_monitor = GlobalFailoverMonitor(
                 self.offices[str(self.topology.global_scheduler())])
+            self.state_service.failover_monitor = self.failover_monitor
         t = None
         if target is not None:
             t = (target if isinstance(target, NodeId)
@@ -302,6 +376,16 @@ class Simulation:
         self.offices[str(n)] = po
         self.local_servers[party] = ls
         self._attach_tracer(po)
+        if self.config.enable_obs:
+            # the replacement ships under the same node name but a new
+            # boot nonce — the collector fences its ring on the switch
+            from geomx_tpu.obs import MetricsPump
+
+            old = self.metrics_pumps.pop(str(n), None)
+            if old is not None:
+                old.stop()
+            self.metrics_pumps[str(n)] = MetricsPump(
+                po, self.config, stats_fn=ls.stats)
         return ls
 
     def set_wan_policy(self, compression: dict,
@@ -326,6 +410,13 @@ class Simulation:
         return {"wan_send_bytes": send, "wan_recv_bytes": recv}
 
     def shutdown(self):
+        for p in self.metrics_pumps.values():
+            p.stop()
+        if self.health is not None:
+            self.health.stop()
+        self.state_service.stop()
+        if self.metrics_collector is not None:
+            self.metrics_collector.stop()
         if self.wan_controller is not None:
             self.wan_controller.stop()
         if self.trace_collector is not None:

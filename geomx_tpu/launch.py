@@ -129,6 +129,8 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
         from geomx_tpu.kvstore.eviction import WorkerEvictionMonitor
 
         role_obj = role_obj or WorkerEvictionMonitor(po)
+    po.recovery_monitor = None
+    po.failover_monitor = None
     if (node.role is Role.GLOBAL_SCHEDULER
             and config.heartbeat_interval_s > 0
             and config.enable_eviction):
@@ -136,7 +138,21 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
         # warm-booted replacement folds back in (kvstore/eviction.py)
         from geomx_tpu.kvstore.eviction import LocalServerRecoveryMonitor
 
-        role_obj = role_obj or LocalServerRecoveryMonitor(po)
+        po.recovery_monitor = LocalServerRecoveryMonitor(po)
+        role_obj = role_obj or po.recovery_monitor
+    if node.role is Role.GLOBAL_SCHEDULER and config.enable_obs:
+        # cluster telemetry plane (geomx_tpu/obs): the metrics collector
+        # + SLO health engine live here, registered BEFORE po.start so
+        # no METRICS_REPORT frame beats the endpoint
+        from geomx_tpu.obs import HealthEngine, MetricsCollector
+
+        po.metrics_collector = MetricsCollector(
+            po, config, trace_collector=po.trace_collector)
+        po.health = HealthEngine(po.metrics_collector, config,
+                                 trace_collector=po.trace_collector)
+    else:
+        po.metrics_collector = None
+        po.health = None
     if node.role is Role.GLOBAL_SCHEDULER and config.adaptive_wan:
         # closed-loop WAN codec autotuning (geomx_tpu/control): the
         # controller samples server stats + the trace report and
@@ -144,7 +160,8 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
         from geomx_tpu.control import AdaptiveWanController
 
         po.wan_controller = AdaptiveWanController(
-            po, config, collector=po.trace_collector)
+            po, config, collector=po.trace_collector,
+            metrics=po.metrics_collector)
         role_obj = role_obj or po.wan_controller
     if (node.role is Role.GLOBAL_SCHEDULER
             and config.topology.num_standby_globals
@@ -153,9 +170,23 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
         # detector + promotion coordinator lives on this scheduler
         from geomx_tpu.kvstore.replication import GlobalFailoverMonitor
 
-        monitor = GlobalFailoverMonitor(po)
-        role_obj = role_obj or monitor
-    elif node.role is Role.WORKER:
+        po.failover_monitor = GlobalFailoverMonitor(po)
+        role_obj = role_obj or po.failover_monitor
+    if node.role is Role.GLOBAL_SCHEDULER:
+        # live cluster-state console (always on — costs nothing until
+        # queried): Ctrl.CLUSTER_STATE merges shard holders/terms, party
+        # folds, heartbeat freshness, policy epoch and health alerts
+        from geomx_tpu.obs import ClusterStateService
+
+        po.state_service = ClusterStateService(
+            po, config,
+            failover_monitor=po.failover_monitor,
+            recovery_monitor=po.recovery_monitor,
+            wan_controller=getattr(po, "wan_controller", None),
+            collector=po.metrics_collector,
+            health=po.health)
+        role_obj = role_obj or po.state_service
+    if node.role is Role.WORKER:
         from geomx_tpu.kvstore.client import WorkerKVStore
 
         role_obj = WorkerKVStore(po, config)
@@ -164,6 +195,19 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
 
         role_obj = MasterWorker(po, config)
     po.start()
+    po.metrics_pump = None
+    if config.enable_obs:
+        # every role ships time-series samples; server roles attach
+        # their QUERY_STATS-equivalent stats dict
+        from geomx_tpu.kvstore.server import GlobalServer, LocalServer
+        from geomx_tpu.obs import MetricsPump
+
+        stats_fn = (role_obj.stats
+                    if isinstance(role_obj, (LocalServer, GlobalServer))
+                    else None)
+        po.metrics_pump = MetricsPump(
+            po, config, stats_fn=stats_fn,
+            collector=getattr(po, "metrics_collector", None))
     if advertise is not None:
         announce_address(po, *advertise)
     return po, role_obj, stop_ev
@@ -340,6 +384,15 @@ def _worker_demo(po, kv, args, join_advertise=None):
     x, y = synthetic_classification(n=512, shape=(12, 12, 1), seed=0)
     _, params, grad_fn = create_cnn_state(
         jax.random.PRNGKey(0), input_shape=(1, 12, 12, 1))
+    sleep_s = _test_step_sleep_s(po.node)
+    if sleep_s > 0:
+        # deterministic pacing for harnesses that must outlive a fault
+        # window (run_status_demo.sh) — same knob the ESync matrix uses
+        inner = grad_fn
+
+        def grad_fn(p, xb, yb):  # noqa: F811 — deliberate wrap
+            time.sleep(sleep_s)
+            return inner(p, xb, yb)
 
     def train(kv, params, it, steps, barrier_init):
         # HFA servers average WEIGHTS — pushing gradients at them (the
@@ -605,6 +658,18 @@ def main(argv=None):
                          "+ critical-path report to --trace-dir")
     ap.add_argument("--trace-dir",
                     default=os.environ.get("GEOMX_TRACE_DIR", ""))
+    ap.add_argument("--obs", action="store_true",
+                    help="cluster telemetry plane: per-node metrics "
+                         "pumps ship time-series samples to a collector "
+                         "+ SLO health engine on the global scheduler; "
+                         "query live state with python -m "
+                         "geomx_tpu.status (GEOMX_OBS_* tune it; see "
+                         "docs/observability.md)")
+    ap.add_argument("--obs-interval", type=float,
+                    default=float(os.environ.get("GEOMX_OBS_INTERVAL",
+                                                 "0") or 0),
+                    help="pump/health cadence in seconds (implies --obs "
+                         "when > 0)")
     ap.add_argument("--adaptive-wan", action="store_true",
                     help="closed-loop WAN codec autotuning: a controller "
                          "on the global scheduler retunes compression "
@@ -674,6 +739,9 @@ def main(argv=None):
                               or cfg.trace_sample_every)
     cfg.trace_dir = args.trace_dir or cfg.trace_dir
     cfg.adaptive_wan = args.adaptive_wan or cfg.adaptive_wan
+    cfg.enable_obs = args.obs or args.obs_interval > 0 or cfg.enable_obs
+    if args.obs_interval > 0:
+        cfg.obs_interval_s = args.obs_interval
     cfg.server_shards = args.server_shards or cfg.server_shards
     # CLI overrides bypass dataclass construction — re-run the invariant
     # checks so invalid combinations fail here, not as a runtime hang
@@ -818,6 +886,37 @@ def main(argv=None):
             txt = coll.report_text()
             if txt:
                 print(txt, flush=True)
+    # telemetry exit lines (global scheduler): the final cluster state
+    # + health transition totals, and — when GEOMX_OBS_DIR names a
+    # directory — the Prometheus exposition + alert history artifacts
+    svc = getattr(po, "state_service", None)
+    if svc is not None:
+        from geomx_tpu.obs.state import render_text as _render_state
+
+        state = svc.compose()
+        health = state.get("health") or {}
+        mc = getattr(po, "metrics_collector", None)
+        shard_bits = ", ".join(
+            "{}:{}@t{}".format(k, v["holder"], v["term"])
+            for k, v in sorted(state.get("shards", {}).items()))
+        print(f"{node}: cluster_state shards={{{shard_bits}}} "
+              f"health_alerts={health.get('transitions_total', 0)} "
+              f"obs_reports={mc.reports_received if mc else 0}",
+              flush=True)
+        print(_render_state(state), flush=True)
+        obs_dir = os.environ.get("GEOMX_OBS_DIR", "")
+        if obs_dir and mc is not None:
+            import json as _json
+
+            os.makedirs(obs_dir, exist_ok=True)
+            with open(os.path.join(obs_dir, "geomx_metrics.prom"),
+                      "w") as f:
+                f.write(mc.prometheus_text())
+            with open(os.path.join(obs_dir, "geomx_cluster_state.json"),
+                      "w") as f:
+                _json.dump(state, f, indent=1)
+            print(f"{node}: metrics exposition + cluster state -> "
+                  f"{obs_dir}", flush=True)
     po.stop()
     return 0
 

@@ -1,0 +1,356 @@
+"""SLO health engine: rule evaluation over the collected series.
+
+Pull-based like the adaptive-WAN controller: each :meth:`tick` sweeps
+the ``MetricsCollector`` rings (and, when tracing is on, the trace
+collector's critical-path report) through a fixed rule set and emits
+structured alert records on STATE TRANSITIONS only — one record when a
+rule starts firing for a subject, one when it recovers.  Every record
+lands four independent ways:
+
+- appended to ``HealthEngine.alerts`` (and the JSONL alert log when
+  ``Config.obs_alert_log`` names one);
+- registry counters (``<gsched>.health_alerts`` / ``health_recoveries``
+  + a per-rule counter);
+- a ``health.alert`` trace instant, so alerts interleave with the PR 3
+  merged timeline exactly like failover/eviction control events;
+- one stdout line per transition (``health ALERT ...`` /
+  ``health RECOVERED ...``) the chaos scripts assert on.
+
+Rules (thresholds are ``Config.obs_*`` knobs):
+
+- **round_stall** — a global shard completed no key-round within
+  ``max(obs_stall_min_s, obs_stall_factor x rolling-median gap)``;
+  progress is tracked per (node, boot) so a promoted standby's first
+  completed round is the recovery signal.
+- **replication_lag** — a shard's hot-standby lag gauge exceeds
+  ``obs_repl_lag_s``.
+- **shard_imbalance** — the critical-path report's slowest shard is
+  busy more than ``obs_imbalance_factor`` x the mean of its peers.
+- **goodput_collapse** — a party's WAN byte rate fell below
+  ``obs_goodput_frac`` x its rolling peak while its rounds are still
+  progressing (a throttled-not-idle link).
+- **rtt_outlier** — a node's heartbeat RTT exceeds ``obs_rtt_s`` or
+  8x the fleet median.
+- **fence_spike** — fenced/evicted/rejected event counters for one
+  node grew by more than ``obs_fence_spike`` within the ring window.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import math
+import statistics
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from geomx_tpu.trace.collector import _shard_of
+from geomx_tpu.utils.metrics import system_counter
+
+# counters summed by the fence_spike rule (stats keys and/or registry
+# suffixes — whatever the node ships)
+_FENCE_KEYS = ("eviction_fenced_pushes", "fenced_rejects",
+               "policy_fenced_pushes", "rejected_compr_tags",
+               "evicted_workers", "worker_evictions")
+
+RULES = ("round_stall", "replication_lag", "shard_imbalance",
+         "goodput_collapse", "rtt_outlier", "fence_spike")
+
+
+def _json_safe(obj):
+    """NaN-fenced copy (invalid-JSON floats become None)."""
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    return obj
+
+
+class HealthEngine:
+    """One per deployment, beside the MetricsCollector on the global
+    scheduler.  ``Config.obs_interval_s <= 0`` runs no sweep thread —
+    tests drive :meth:`tick` deterministically."""
+
+    def __init__(self, collector, config=None, trace_collector=None):
+        from geomx_tpu.trace.recorder import get_tracer
+
+        self.collector = collector
+        self.config = config or collector.config
+        self.trace_collector = trace_collector
+        self.node = collector.node
+        cfg = self.config
+        self.stall_factor = float(getattr(cfg, "obs_stall_factor", 4.0))
+        self.stall_min_s = float(getattr(cfg, "obs_stall_min_s", 2.0))
+        self.repl_lag_s = float(getattr(cfg, "obs_repl_lag_s", 60.0))
+        self.rtt_s = float(getattr(cfg, "obs_rtt_s", 1.0))
+        self.goodput_frac = float(getattr(cfg, "obs_goodput_frac", 0.1))
+        self.fence_spike = int(getattr(cfg, "obs_fence_spike", 8))
+        self.imbalance_factor = float(
+            getattr(cfg, "obs_imbalance_factor", 4.0))
+        self.alert_log = str(getattr(cfg, "obs_alert_log", "") or "")
+        self._mu = threading.Lock()
+        self.active: Dict[Tuple[str, str], dict] = {}
+        self.alerts: List[dict] = []      # transition history, bounded
+        self._cap = 4096
+        # round_stall bookkeeping: per shard subject, the last seen
+        # (boot, value) per reporting node + progress times + gaps
+        self._stall: Dict[str, dict] = {}
+        self._peak_rate: Dict[str, float] = {}
+        self._tr = get_tracer(self.node)
+        self._alert_counter = system_counter(f"{self.node}.health_alerts")
+        self._recovery_counter = system_counter(
+            f"{self.node}.health_recoveries")
+        self._rule_counters = {r: system_counter(
+            f"{self.node}.health_{r}_alerts") for r in RULES}
+        self._stop = threading.Event()
+        self._thread = None
+        if getattr(cfg, "obs_interval_s", 0) > 0:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"health-engine-{self.node}")
+            self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self.config.obs_interval_s):
+            try:
+                self.tick()
+            except Exception:  # a sweep error must not kill the loop
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "%s: health sweep failed", self.node)
+
+    # ---- evaluation ---------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> List[dict]:
+        """One evaluation sweep; returns the NEW transition records
+        (alerts + recoveries) it produced.  ``now`` is injectable for
+        deterministic tests."""
+        now = time.monotonic() if now is None else now
+        records = []
+        for rule in (self._rule_round_stall, self._rule_replication_lag,
+                     self._rule_shard_imbalance, self._rule_goodput_collapse,
+                     self._rule_rtt_outlier, self._rule_fence_spike):
+            try:
+                records.extend(rule(now))
+            except Exception:  # one broken rule must not mute the rest
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "%s: health rule %s failed", self.node, rule.__name__)
+        return records
+
+    def active_alerts(self) -> List[dict]:
+        with self._mu:
+            return [dict(a) for a in self.active.values()]
+
+    # ---- state machine ------------------------------------------------------
+    def _set_state(self, rule: str, subject: str, firing: bool, now: float,
+                   severity: str = "warn", message: str = "",
+                   **data) -> Optional[dict]:
+        key = (rule, subject)
+        with self._mu:
+            cur = self.active.get(key)
+            if firing and cur is None:
+                rec = {"rule": rule, "subject": subject, "state": "firing",
+                       "severity": severity, "t": time.time(),
+                       "t_mono": now, "message": message,
+                       "data": _json_safe(data)}
+                self.active[key] = rec
+            elif not firing and cur is not None:
+                del self.active[key]
+                rec = {"rule": rule, "subject": subject,
+                       "state": "recovered", "severity": cur["severity"],
+                       "t": time.time(), "t_mono": now,
+                       "firing_for_s": round(now - cur["t_mono"], 3),
+                       "message": message or "condition cleared",
+                       "data": _json_safe(data)}
+            else:
+                return None
+        self._emit(rec)
+        return rec
+
+    def _emit(self, rec: dict) -> None:
+        firing = rec["state"] == "firing"
+        with self._mu:
+            self.alerts.append(rec)
+            del self.alerts[:-self._cap]
+        if firing:
+            self._alert_counter.inc()
+            self._rule_counters[rec["rule"]].inc()
+        else:
+            self._recovery_counter.inc()
+        # alerts land on the merged trace timeline like failover events
+        self._tr.instant("health.alert", rule=rec["rule"],
+                         subject=rec["subject"], state=rec["state"],
+                         severity=rec["severity"])
+        print(f"{self.node}: health "
+              f"{'ALERT' if firing else 'RECOVERED'} {rec['rule']} "
+              f"{rec['subject']} — {rec['message']}", flush=True)
+        if self.alert_log:
+            try:
+                with open(self.alert_log, "a") as f:
+                    f.write(json.dumps(rec, allow_nan=False) + "\n")
+            except (OSError, ValueError):
+                pass  # the log is best-effort; registry/stdout remain
+
+    # ---- rules --------------------------------------------------------------
+    def _rule_round_stall(self, now: float) -> List[dict]:
+        out = []
+        topo = self.collector.po.topology
+        nodes = self.collector.nodes()
+        for k in range(topo.num_global_servers):
+            subject = f"shard:{k}"
+            st = self._stall.setdefault(subject, {
+                "v": {}, "t_prog": None,
+                "gaps": collections.deque(maxlen=32)})
+            progressed = False
+            for node in nodes:
+                if _shard_of(node) != k:
+                    continue
+                sample = self.collector.latest(node)
+                if sample is None:
+                    continue
+                v = self.collector._get(sample, node, "key_rounds")
+                if not isinstance(v, (int, float)):
+                    continue
+                boot = sample.get("boot", 0)
+                prev = st["v"].get(node)
+                st["v"][node] = (boot, v)
+                # progress only counts within one boot: a restarted
+                # holder's zeroed counter re-baselines instead of
+                # masking (or faking) progress
+                if prev is not None and prev[0] == boot and v > prev[1]:
+                    progressed = True
+            if progressed:
+                if st["t_prog"] is not None:
+                    st["gaps"].append(now - st["t_prog"])
+                st["t_prog"] = now
+            if st["t_prog"] is None:
+                continue  # this shard never completed a round yet
+            med = statistics.median(st["gaps"]) if st["gaps"] else 0.0
+            limit = max(self.stall_min_s, self.stall_factor * med)
+            stalled = now - st["t_prog"]
+            rec = self._set_state(
+                "round_stall", subject, stalled > limit, now,
+                severity="critical",
+                message=(f"no key-round completed in {stalled:.2f}s "
+                         f"(limit {limit:.2f}s)" if stalled > limit
+                         else f"round completed after {stalled:.2f}s"),
+                stalled_for_s=round(stalled, 3), limit_s=round(limit, 3))
+            if rec:
+                out.append(rec)
+        return out
+
+    def _rule_replication_lag(self, now: float) -> List[dict]:
+        out = []
+        for node in self.collector.nodes():
+            v = self.collector.value(node, "replication_lag_s")
+            if not isinstance(v, (int, float)):
+                continue
+            rec = self._set_state(
+                "replication_lag", node, v > self.repl_lag_s, now,
+                message=f"standby lag {v:.1f}s (ceiling "
+                        f"{self.repl_lag_s:.0f}s)",
+                lag_s=round(float(v), 3), ceiling_s=self.repl_lag_s)
+            if rec:
+                out.append(rec)
+        return out
+
+    def _rule_shard_imbalance(self, now: float) -> List[dict]:
+        if self.trace_collector is None:
+            return []
+        try:
+            rounds = self.trace_collector.critical_path().get("rounds") or ()
+        except Exception:
+            return []
+        if not rounds:
+            return []
+        by_shard = rounds[-1].get("by_shard") or {}
+        if len(by_shard) < 2:
+            return []
+        slowest = max(by_shard, key=by_shard.get)
+        others = [v for s, v in by_shard.items() if s != slowest]
+        mean = sum(others) / len(others)
+        firing = mean > 0 and by_shard[slowest] > self.imbalance_factor * mean
+        out = []
+        for s in by_shard:
+            rec = self._set_state(
+                "shard_imbalance", f"shard:{s}",
+                firing and s == slowest, now,
+                message=f"shard busy {by_shard[s] / 1e3:.1f}ms vs peer "
+                        f"mean {mean / 1e3:.1f}ms",
+                busy_us=by_shard[s], peer_mean_us=mean)
+            if rec:
+                out.append(rec)
+        return out
+
+    def _rule_goodput_collapse(self, now: float) -> List[dict]:
+        out = []
+        for node in self.collector.nodes():
+            if not node.startswith("server:"):
+                continue  # WAN senders only (the local servers)
+            rate = self.collector.rate(node, "wan_send_bytes")
+            if rate is None:
+                continue
+            peak = self._peak_rate.get(node, 0.0)
+            self._peak_rate[node] = max(peak, rate)
+            rounds_rate = self.collector.rate(node, "wan_push_rounds")
+            firing = (peak > 0 and rate < self.goodput_frac * peak
+                      and bool(rounds_rate) and rounds_rate > 0)
+            rec = self._set_state(
+                "goodput_collapse", node, firing, now,
+                message=f"WAN goodput {rate / 1e6:.2f} MB/s vs peak "
+                        f"{max(peak, rate) / 1e6:.2f} MB/s",
+                goodput_bps=rate, peak_bps=max(peak, rate))
+            if rec:
+                out.append(rec)
+        return out
+
+    def _rule_rtt_outlier(self, now: float) -> List[dict]:
+        rtts = {}
+        for node in self.collector.nodes():
+            v = self.collector.value(node, "heartbeat_rtt_s")
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                rtts[node] = float(v)
+        med = statistics.median(rtts.values()) if len(rtts) >= 3 else None
+        out = []
+        for node, v in rtts.items():
+            firing = v > self.rtt_s or (
+                med is not None and v > 8 * max(med, 1e-3))
+            rec = self._set_state(
+                "rtt_outlier", node, firing, now,
+                message=f"heartbeat RTT {v * 1e3:.1f}ms "
+                        + (f"(fleet median {med * 1e3:.1f}ms)"
+                           if med is not None else
+                           f"(ceiling {self.rtt_s:.2f}s)"),
+                rtt_s=v, median_s=med)
+            if rec:
+                out.append(rec)
+        return out
+
+    def _rule_fence_spike(self, now: float) -> List[dict]:
+        out = []
+        for node in self.collector.nodes():
+            total = 0.0
+            seen = False
+            for key in _FENCE_KEYS:
+                pts = self.collector.series(node, key)
+                if len(pts) >= 2:
+                    seen = True
+                    total += pts[-1][1] - pts[0][1]
+            if not seen:
+                continue
+            rec = self._set_state(
+                "fence_spike", node, total > self.fence_spike, now,
+                message=f"{total:.0f} fenced/evicted events in the "
+                        f"window (threshold {self.fence_spike})",
+                events=total, threshold=self.fence_spike)
+            if rec:
+                out.append(rec)
+        return out
+
+    def stop(self):
+        self._stop.set()

@@ -244,6 +244,17 @@ class Postoffice:
                      for n, t in self._heartbeats.items()},
                     self._hb_epoch)
 
+    def uptime_s(self) -> float:
+        """Seconds since this postoffice started (0.0 before start).
+        QUERY_STATS and the metrics pump ship it so collectors can tell
+        a warm-booted node's zeroed counters (small uptime, new boot
+        nonce) from a genuine rate collapse."""
+        if not self._started:
+            return 0.0
+        import time as _time
+
+        return _time.monotonic() - self._hb_epoch
+
     def clock_offsets(self) -> Dict[str, float]:
         """Estimated scheduler-clock-minus-mine per scheduler target
         (from heartbeat echoes); {} until a first echo lands — and

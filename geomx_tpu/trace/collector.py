@@ -74,8 +74,8 @@ class TraceCollector:
     """One per deployment, on the global scheduler's postoffice."""
 
     def __init__(self, postoffice):
-        from geomx_tpu.kvstore.common import APP_PS
-        from geomx_tpu.ps.customer import Customer
+        from geomx_tpu.kvstore.common import Ctrl
+        from geomx_tpu.obs.endpoint import get_endpoint
 
         self.po = postoffice
         self.node = str(postoffice.node)
@@ -83,17 +83,17 @@ class TraceCollector:
         self._events: List[dict] = []
         self._offsets: Dict[str, Dict[str, float]] = {}
         self.reports_received = 0
-        self._customer = Customer(APP_PS, 0, self._on_msg, postoffice,
-                                  owns_app=True)
+        # sibling collectors (the metrics collector's perfetto counter
+        # tracks) contribute events to the merged timeline through here
+        self.extra_event_sources: List = []
+        # the scheduler's PS app is shared with the other telemetry
+        # collectors — one endpoint routes frames by Ctrl head
+        self._endpoint = get_endpoint(postoffice).acquire()
+        self._endpoint.route(Ctrl.TRACE_REPORT, self._on_msg)
 
     def _on_msg(self, msg):
-        from geomx_tpu.kvstore.common import Ctrl
-
-        if msg.request and msg.cmd == int(Ctrl.TRACE_REPORT):
-            body = msg.body if isinstance(msg.body, dict) else {}
-            self.ingest(body)
-        # anything else addressed at the scheduler's PS app is dropped —
-        # the scheduler serves no data traffic
+        body = msg.body if isinstance(msg.body, dict) else {}
+        self.ingest(body)
 
     def ingest(self, body: dict) -> None:
         node = str(body.get("node", "?"))
@@ -138,6 +138,11 @@ class TraceCollector:
         offsets = self._resolve_offsets()
         with self._mu:
             events = list(self._events)
+        for src in list(self.extra_event_sources):
+            try:
+                events.extend(src())
+            except Exception:  # a sibling mid-stop must not break dumps
+                pass
         if not events:
             return []
         out = []
@@ -273,4 +278,4 @@ class TraceCollector:
         return "\n".join(lines)
 
     def stop(self):
-        self._customer.stop()
+        self._endpoint.release()

@@ -81,11 +81,39 @@ def system_gauge(name: str) -> Gauge:
     return _system(name, Gauge)
 
 
-def system_snapshot(prefix: str = "") -> Dict[str, float]:
-    """Current values of every registered system metric under ``prefix``."""
+def system_snapshot(prefix: str = "",
+                    skip_unset: bool = False) -> Dict[str, float]:
+    """Current values of every registered system metric under ``prefix``.
+
+    ``skip_unset`` drops never-set gauges (value NaN): NaN is invalid
+    JSON and poisons any serialized dump that includes it, so every
+    wire/exposition boundary (the metrics pump, the Prometheus dump)
+    snapshots with it on.
+    """
+    import math
+
     with _SYS_MU:
-        return {k: m.value for k, m in _SYSTEM.items()
-                if k.startswith(prefix)}
+        out = {k: m.value for k, m in _SYSTEM.items()
+               if k.startswith(prefix)}
+    if skip_unset:
+        out = {k: v for k, v in out.items()
+               if not (isinstance(v, float) and math.isnan(v))}
+    return out
+
+
+def reset_system_metrics() -> None:
+    """Clear the process-global registry.
+
+    The registry deliberately outlives any one deployment (readers and
+    writers need no setup ordering), which means counters bleed across
+    sequential ``Simulation``s in one pytest process.  Tests reset
+    between cases for a clean slate; handles already held by live
+    objects keep working, they are simply no longer visible to new
+    :func:`system_snapshot` readers (a fresh ``system_counter(name)``
+    after the reset returns a fresh zeroed instance).
+    """
+    with _SYS_MU:
+        _SYSTEM.clear()
 
 
 class EvalMetric:

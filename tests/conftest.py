@@ -27,3 +27,22 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_system_metrics():
+    """Every test starts from an empty system-metrics registry.
+
+    The registry is process-global by design (readers and writers need
+    no setup ordering), so counters bleed across sequential Simulations
+    in one pytest run — historically forcing every test to assert via
+    snapshot deltas.  Resetting between tests gives each a clean slate;
+    metric handles already held by a previous test's (stopped) objects
+    keep working, they just stop being visible to new snapshots.
+    """
+    yield
+    from geomx_tpu.utils.metrics import reset_system_metrics
+
+    reset_system_metrics()

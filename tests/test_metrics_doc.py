@@ -1,0 +1,94 @@
+"""docs/metrics.md grep-audit (ISSUE 7 satellite): every system metric
+name registered anywhere in geomx_tpu/ must be documented.
+
+The audit extracts each ``system_counter``/``system_gauge`` call site's
+name template from source.  Static suffixes must appear (backticked) in
+the catalog; templates whose suffix is dynamic must have an explicit
+expansion below — adding a new dynamic call site without documenting
+its expansions fails here, by design.
+"""
+
+import pathlib
+import re
+
+from geomx_tpu.obs.health import RULES
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+DOC = ROOT / "docs" / "metrics.md"
+_CALL = re.compile(r'system_(?:counter|gauge)\(\s*f?"([^"]+)"', re.S)
+
+# templates whose SUFFIX is computed at runtime -> the concrete names
+# they can produce (each must be documented)
+EXPANSIONS = {
+    "{self.po.node}.{action}s": ["party_folds", "party_unfolds"],
+    "{postoffice.node}.wan_policy_{a}s": [
+        "wan_policy_downshifts", "wan_policy_upshifts",
+        "wan_policy_manuals"],
+    "{self.node}.wan_bytes_{tag or 'vanilla'}": [
+        "wan_bytes_vanilla", "wan_bytes_fp16", "wan_bytes_2bit",
+        "wan_bytes_bsc", "wan_bytes_mpq"],
+    "{self.node}.health_{r}_alerts": [
+        f"health_{r}_alerts" for r in RULES],
+}
+
+
+def _templates():
+    out = []
+    for p in sorted((ROOT / "geomx_tpu").rglob("*.py")):
+        for m in _CALL.finditer(p.read_text()):
+            out.append((str(p.relative_to(ROOT)), m.group(1)))
+    return out
+
+
+def test_every_registered_metric_is_documented():
+    doc = DOC.read_text()
+    templates = _templates()
+    assert templates, "audit regex found no call sites — broken audit"
+    missing = []
+    for src, tpl in templates:
+        # collapse {placeholders} to a marker FIRST — the node
+        # expression itself contains dots ({self.po.node}.x)
+        norm = re.sub(r"\{[^}]*\}", "\x00", tpl)
+        assert "." in norm, f"{src}: metric {tpl!r} has no node prefix"
+        prefix, suffix = norm.split(".", 1)
+        if "\x00" in suffix:
+            if tpl not in EXPANSIONS:
+                missing.append(
+                    f"{src}: dynamic metric name {tpl!r} — add its "
+                    "expansions to tests/test_metrics_doc.py AND "
+                    "document them in docs/metrics.md")
+                continue
+            for name in EXPANSIONS[tpl]:
+                if f"`{name}`" not in doc:
+                    missing.append(f"{src}: {name} (expansion of {tpl!r})")
+            continue
+        if prefix == "\x00":
+            # per-node metric: the doc lists the bare suffix
+            token = f"`{suffix}`"
+        else:
+            # literal family prefix (global_shard<k>.*): the doc lists
+            # the full dotted pattern with <k>
+            token = "`" + prefix.replace("\x00", "<k>") + "." + suffix + "`"
+        if token not in doc:
+            missing.append(f"{src}: {token} not in docs/metrics.md")
+    assert not missing, "undocumented system metrics:\n" + "\n".join(missing)
+
+
+def test_doc_has_no_stale_entries():
+    """The reverse direction, loosely: every per-node table row's name
+    still has a matching call site (catches renames that orphan doc
+    rows).  Dynamic expansions and the global_shard family are checked
+    by name-substring against the template list."""
+    doc = DOC.read_text()
+    templates = [t for _, t in _templates()]
+    expanded = [n for names in EXPANSIONS.values() for n in names]
+    rows = re.findall(r"^\| `([^`]+)` \|", doc, re.M)
+    assert rows, "no table rows parsed from docs/metrics.md"
+    stale = []
+    for name in rows:
+        bare = name.replace("global_shard<k>.", "")
+        if name in expanded or bare in expanded:
+            continue
+        if not any(t.endswith(f".{bare}") for t in templates):
+            stale.append(name)
+    assert not stale, f"doc rows with no call site: {stale}"
