@@ -1545,6 +1545,141 @@ def child_obs():
     }))
 
 
+def child_serve():
+    """Read-serving replica tier (ISSUE 8): ``pulls_per_sec`` at 1/2/4
+    replicas under CONCURRENT training — the serving tier's brand-new
+    bench axis.  A 2-party deployment trains in a background thread
+    while client threads hammer the replicas with SERVE_PULL reads;
+    reports aggregate QPS, client-side p50/p99 read latency, a
+    staleness histogram over the read metas (every read must sit under
+    the configured bound — violations are counted, not averaged away),
+    and the training rounds that completed during the measurement
+    window (proof the reads rode beside live training, not an idle
+    store)."""
+    import threading as _threading
+
+    import numpy as np
+
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.kvstore import Simulation
+
+    N_TENSORS = int(os.environ.get("BENCH_SERVE_TENSORS", "8"))
+    ELEMS = int(os.environ.get("BENCH_SERVE_ELEMS", "25000"))
+    SECONDS = float(os.environ.get("BENCH_SERVE_SECONDS", "3.0"))
+    CLIENTS_PER_REPLICA = 2
+    BOUND = 1.0
+
+    def pct(vs, q):
+        if not vs:
+            return None
+        vs = sorted(vs)
+        return vs[min(len(vs) - 1, max(0, int(round(q * (len(vs) - 1)))))]
+
+    sweep = {}
+    for n_rep in (1, 2, 4):
+        cfg = Config(
+            topology=Topology(num_parties=2, workers_per_party=1,
+                              num_replicas=n_rep),
+            serve_staleness_s=BOUND, serve_refresh_interval_s=0.1)
+        sim = Simulation(cfg)
+        try:
+            ws = sim.all_workers()
+            for w in ws:
+                for tid in range(N_TENSORS):
+                    w.init(tid, np.zeros(ELEMS, np.float32))
+            ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+            g = np.ones(ELEMS, np.float32)
+            stop = _threading.Event()
+            rounds = [0]
+
+            def train():
+                while not stop.is_set():
+                    for w in ws:
+                        for tid in range(N_TENSORS):
+                            w.push(tid, g)
+                    for w in ws:
+                        for tid in range(N_TENSORS):
+                            w.pull_sync(tid)
+                        w.wait_all()
+                    rounds[0] += 1
+
+            trainer = _threading.Thread(target=train, daemon=True)
+            trainer.start()
+            # replicas must hold the keys before the clock starts
+            deadline = time.monotonic() + 20
+            while (time.monotonic() < deadline
+                   and any(r.refresh_rounds == 0 or len(r.store) == 0
+                           for r in sim.replicas)):
+                time.sleep(0.05)
+            pulls = [0]
+            errors = [0]
+            lats: list = []
+            stals: list = []
+            mu = _threading.Lock()
+            # clients up-front: construction cost stays out of the window
+            clients = [sim.serve_client(r) for r in range(n_rep)
+                       for _ in range(CLIENTS_PER_REPLICA)]
+            t_end = time.monotonic() + SECONDS
+
+            def reader(c):
+                i = 0
+                while time.monotonic() < t_end:
+                    tid = i % N_TENSORS
+                    i += 1
+                    t0 = time.perf_counter()
+                    try:
+                        _, meta = c.pull_tensor(tid, ELEMS, timeout=5.0)
+                    except (TimeoutError, RuntimeError):
+                        with mu:
+                            errors[0] += 1
+                        continue
+                    dt = time.perf_counter() - t0
+                    with mu:
+                        pulls[0] += 1
+                        lats.append(dt * 1e3)
+                        s = meta.get("staleness_s")
+                        if isinstance(s, (int, float)):
+                            stals.append(float(s))
+
+            readers = [
+                _threading.Thread(target=reader, args=(c,), daemon=True)
+                for c in clients]
+            r0 = rounds[0]
+            for t in readers:
+                t.start()
+            for t in readers:
+                t.join(timeout=SECONDS + 30)
+            trained = rounds[0] - r0
+            stop.set()
+            trainer.join(timeout=30)
+            sweep[str(n_rep)] = {
+                "pulls_per_sec": round(pulls[0] / SECONDS, 1),
+                "pulls": pulls[0],
+                "read_errors": errors[0],
+                "serve_p50_ms": round(pct(lats, 0.5) or 0, 2),
+                "serve_p99_ms": round(pct(lats, 0.99) or 0, 2),
+                "staleness_p50_s": round(pct(stals, 0.5) or 0, 3),
+                "staleness_p99_s": round(pct(stals, 0.99) or 0, 3),
+                "staleness_max_s": round(max(stals), 3) if stals else None,
+                "bound_violations": sum(1 for s in stals if s > BOUND),
+                "train_rounds_during_window": trained,
+            }
+        finally:
+            sim.shutdown()
+    base = sweep["1"]["pulls_per_sec"]
+    print(json.dumps({
+        "tensors": N_TENSORS,
+        "tensor_elems": ELEMS,
+        "staleness_bound_s": BOUND,
+        "window_s": SECONDS,
+        "pulls_per_sec": {k: v["pulls_per_sec"] for k, v in sweep.items()},
+        "speedup_vs_1replica": {
+            k: round(v["pulls_per_sec"] / max(base, 1e-9), 2)
+            for k, v in sweep.items()},
+        "sweep": sweep,
+    }))
+
+
 def child_stress():
     """Server merge throughput at scale (VERDICT r1 item 5): one party of
     4 workers pushing a 50M-element tensor (200 MB) through the two-tier
@@ -1886,7 +2021,7 @@ def _build_record() -> dict:
                       ("stress", "stress"), ("lm", "lm"),
                       ("scaling", "scaling"), ("parity", "parity"),
                       ("serde", "serde"), ("shards", "shards"),
-                      ("probe", "probe")):
+                      ("serve", "serve"), ("probe", "probe")):
         if name in _results:
             record[key] = _results[name]
         elif name in TPU_CHILDREN and name in lkg:
@@ -1946,6 +2081,9 @@ def _compact(record: dict) -> dict:
     ob = record.get("obs") or {}
     if ob.get("overhead_pct") is not None:
         out["obs_overhead_pct"] = ob["overhead_pct"]
+    sv = record.get("serve") or {}
+    if sv.get("pulls_per_sec"):
+        out["serve_pulls_per_sec"] = sv["pulls_per_sec"]
     sd = record.get("serde") or {}
     if sd.get("speedup_encode"):
         out["serde_speedup"] = {"encode": sd["speedup_encode"],
@@ -2101,7 +2239,7 @@ def main():
                     choices=["cnn", "mfu", "mfu_sweep", "quant", "wan",
                              "overlap", "overlap_tpu", "stress", "probe",
                              "flash_autotune", "lm", "scaling", "parity",
-                             "serde", "shards", "obs"])
+                             "serde", "shards", "obs", "serve"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -2127,6 +2265,7 @@ def main():
          "probe": child_probe, "lm": child_lm, "scaling": child_scaling,
          "parity": child_parity, "serde": child_serde,
          "shards": child_shards, "obs": child_obs,
+         "serve": child_serve,
          "flash_autotune": child_flash_autotune}[args.child]()
         return
 
@@ -2227,6 +2366,7 @@ def main():
         _do("stress", 180, cpu_env)
         _do("shards", 240, cpu_env)
         _do("obs", 180, cpu_env)
+        _do("serve", 210, cpu_env)
 
     cpu_thread = threading.Thread(target=cpu_chain, daemon=True)
     cpu_thread.start()

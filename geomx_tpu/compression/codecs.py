@@ -388,6 +388,31 @@ class BroadcastCompressor:
             # NEW propagated value: back to the shared "init" lineage
             del self._lineage[pair]
 
+    def drop_subscriber(self, subscriber: str) -> int:
+        """Free every tracked view/version/lineage entry of one
+        subscriber (a departed party server or an evicted serve
+        replica).  Each view pins a full-model copy, so a server that
+        never prunes leaks one model per subscriber that ever churned.
+        Always SAFE to call on a live subscriber: a pruned pair's next
+        pull takes the no-base branch of :meth:`compress` and resyncs
+        dense — one extra dense response, never a wrong delta.  Returns
+        the number of view arrays freed."""
+        n = 0
+        for pair in [p for p in self._view if p[0] == subscriber]:
+            del self._view[pair]
+            n += 1
+        for pair in [p for p in self._ver if p[0] == subscriber]:
+            del self._ver[pair]
+        for pair in [p for p in self._lineage if p[0] == subscriber]:
+            del self._lineage[pair]
+        return n
+
+    def subscribers(self) -> set:
+        """Distinct subscriber ids with any tracked state
+        (observability for the prune paths + their tests)."""
+        return ({p[0] for p in self._view} | {p[0] for p in self._ver}
+                | {p[0] for p in self._lineage})
+
     def compress(self, subscriber: str, key: int, weights: np.ndarray,
                  echo_ver: int = 0):
         """Encode one pull for ``subscriber``.

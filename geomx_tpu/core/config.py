@@ -51,6 +51,15 @@ class Role(enum.Enum):
     #                                      returns before training (ref:
     #                                      examples/cnn.py:96,
     #                                      DMLC_ENABLE_CENTRAL_WORKER)
+    REPLICA = "replica"                  # read-serving model replica
+    #                                      (geomx_tpu/serve): subscribes
+    #                                      to the global tier with
+    #                                      staleness-bounded async pulls
+    #                                      and answers high-QPS
+    #                                      SERVE_PULL / PREDICT traffic
+    #                                      from its local copy — the
+    #                                      inference tier the training
+    #                                      tree never sees
 
     @property
     def is_scheduler(self) -> bool:
@@ -133,6 +142,11 @@ class Topology:
     #                               global server rank k (promotion swaps
     #                               the node id, the key range is the
     #                               primary's own shard)
+    num_replicas: int = 0  # read-serving replica tier (geomx_tpu/serve):
+    #                        each replica subscribes to EVERY global
+    #                        shard's key range and serves pull/predict
+    #                        reads from local memory; 0 (default)
+    #                        constructs nothing anywhere
     central_party: int = 0  # which party hosts the global tier
     central_worker: bool = False  # add a dedicated master worker to the
     #                               central party (ref:
@@ -150,6 +164,8 @@ class Topology:
             raise ValueError(
                 "num_standby_globals must be in [0, num_global_servers]: "
                 "standby rank k is the hot backup of global server rank k")
+        if self.num_replicas < 0:
+            raise ValueError("num_replicas must be >= 0")
 
     # ---- enumeration helpers -------------------------------------------------
     def workers(self, party: int):
@@ -184,6 +200,12 @@ class Topology:
             return NodeId(Role.STANDBY_GLOBAL, rank)
         return None
 
+    def replica(self, rank: int) -> NodeId:
+        return NodeId(Role.REPLICA, rank)
+
+    def replicas(self):
+        return [NodeId(Role.REPLICA, r) for r in range(self.num_replicas)]
+
     def master_worker(self) -> Optional[NodeId]:
         """The central party's control-plane driver, when enabled
         (ref: master worker lives in the central party and drives
@@ -203,9 +225,11 @@ class Topology:
         mw = self.master_worker()
         if mw is not None:
             nodes.append(mw)
-        # standbys LAST: the static TCP port plan indexes this order, and
-        # adding a standby must not renumber any existing node's port
+        # standbys (and replicas after them) LAST: the static TCP port
+        # plan indexes this order, and adding either must not renumber
+        # any existing node's port
         nodes.extend(self.standby_globals())
+        nodes.extend(self.replicas())
         return nodes
 
     @property
@@ -473,6 +497,22 @@ class Config:
     obs_goodput_frac: float = 0.1   # goodput-collapse fraction of peak
     obs_fence_spike: int = 8        # fenced/evicted events per window
     obs_imbalance_factor: float = 4.0  # slowest-shard busy vs peer mean
+    # --- read-serving replica tier (geomx_tpu/serve; beyond the
+    # reference, which is train-only).  Replicas (Topology.num_replicas /
+    # GEOMX_SERVE_REPLICAS / launch.py --replicas) keep a full local copy
+    # of the model refreshed by staleness-bounded async pulls from the
+    # global tier (BroadcastCompressor sparse deltas + the dense-resync
+    # version handshake) and answer Cmd.SERVE_PULL / Cmd.PREDICT read
+    # traffic from memory.  A read NEVER sees a copy older than
+    # serve_staleness_s: a read arriving while the copy is stale parks
+    # until the next refresh lands (or errors after the bound passes
+    # again with the global tier unreachable).
+    serve_staleness_s: float = 5.0      # the staleness bound (seconds)
+    serve_refresh_interval_s: float = 0.5  # refresh cadence; clamped to
+    #                                     at most serve_staleness_s / 2;
+    #                                     0 = no refresh thread (manual
+    #                                     refresh() only — what the
+    #                                     deterministic tests drive)
     verbose: int = 0
 
     def __post_init__(self):
@@ -491,6 +531,15 @@ class Config:
             self.topology = dataclasses.replace(
                 self.topology, num_global_servers=shards)
         self.global_shards = self.topology.num_global_servers
+        # replica-count env fallback (mirrors GEOMX_GLOBAL_SHARDS): a
+        # directly-constructed Config grows a replica tier from
+        # GEOMX_SERVE_REPLICAS without threading the knob through every
+        # fixture; an explicit topology count wins
+        if self.topology.num_replicas == 0:
+            reps = _env_int("GEOMX_SERVE_REPLICAS", 0)
+            if reps > 0:
+                self.topology = dataclasses.replace(
+                    self.topology, num_replicas=reps)
         # env overrides for the replay/backoff tuning knobs (the chaos
         # soaks tighten these without editing source; env wins so one
         # shell line covers directly-constructed Configs too)
@@ -563,6 +612,12 @@ class Config:
             raise ValueError("obs_goodput_frac must be in (0, 1)")
         if self.replicate_every < 1:
             raise ValueError("replicate_every must be >= 1")
+        if self.serve_staleness_s <= 0:
+            raise ValueError("serve_staleness_s must be > 0 (the replica "
+                             "read-staleness bound)")
+        if self.serve_refresh_interval_s < 0:
+            raise ValueError("serve_refresh_interval_s must be >= 0 "
+                             "(0 = manual refresh)")
         if self.server_shards < 0:
             raise ValueError("server_shards must be >= 0 (0 = auto)")
         if self.trace_sample_every < 0:
@@ -588,6 +643,7 @@ class Config:
                          _env_int("DMLC_NUM_GLOBAL_SERVER", 1)),
             ),
             num_standby_globals=_env_int("GEOMX_NUM_STANDBY_GLOBALS", 0),
+            num_replicas=_env_int("GEOMX_SERVE_REPLICAS", 0),
             central_worker=_env_bool(
                 "GEOMX_ENABLE_CENTRAL_WORKER",
                 _env_bool("DMLC_ENABLE_CENTRAL_WORKER"),
@@ -682,5 +738,8 @@ class Config:
             obs_goodput_frac=_env_float("GEOMX_OBS_GOODPUT_FRAC", 0.1),
             obs_fence_spike=_env_int("GEOMX_OBS_FENCE_SPIKE", 8),
             obs_imbalance_factor=_env_float("GEOMX_OBS_IMBALANCE", 4.0),
+            serve_staleness_s=_env_float("GEOMX_SERVE_STALENESS_S", 5.0),
+            serve_refresh_interval_s=_env_float("GEOMX_SERVE_REFRESH_S",
+                                                0.5),
             verbose=_env_int("GEOMX_VERBOSE", _env_int("PS_VERBOSE", 0)),
         )

@@ -289,6 +289,16 @@ class Cmd(enum.IntEnum):
     #                      serialized state snapshot (the checkpoint slab
     #                      format over the wire instead of disk); body
     #                      carries {term, seq} for fencing/ordering
+    SERVE_PULL = 7       # read client -> replica (geomx_tpu/serve): pull
+    #                      keys from the replica's staleness-bounded
+    #                      local model copy; the response body carries
+    #                      {staleness_s, version, rounds_at_refresh} so
+    #                      readers can assert the bound
+    PREDICT = 8          # read client -> replica: run a small forward
+    #                      pass (MLP layer chain named by ps keys in the
+    #                      body) over the replica's local copy and return
+    #                      the logits — inference without ever touching
+    #                      the training lanes
 
 
 class Ctrl(enum.IntEnum):

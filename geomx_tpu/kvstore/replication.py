@@ -474,6 +474,10 @@ class GlobalFailoverMonitor:
                 "new": str(self._holders[rank]),
                 "term": self._terms[rank]}
         targets = list(topo.servers()) + list(topo.all_workers())
+        # serve replicas subscribe to every shard's key range: they must
+        # retarget their refresh pulls exactly like the local servers'
+        # up-links (geomx_tpu/serve)
+        targets += list(topo.replicas())
         mw = topo.master_worker()
         if mw is not None:
             targets.append(mw)

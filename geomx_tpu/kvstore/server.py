@@ -2029,6 +2029,10 @@ class GlobalServer:
         self.sync_mode = self.config.sync_global_mode
         self.compression: dict = {"type": "none"}
         self.pull_comp = None  # BroadcastCompressor under bsc/mpq
+        self.subscriber_prunes = 0  # departed/evicted subscribers whose
+        #                             tracked pull-compressor views were
+        #                             freed (each view pins a full model
+        #                             copy — the PR 8 leak fix)
         # adaptive WAN (geomx_tpu/control), RECEIVER side: SET_WAN_POLICY
         # adopts the new decode parameters + pull compressor immediately
         # (tracked views invalidated through the version handshake —
@@ -2130,9 +2134,30 @@ class GlobalServer:
                 completed, hfa_delta=self.config.use_hfa, dissem_ok=True)
             total = self.num_contributors
         self._flush_completions(to_ack, dissem)
+        # a departed party's per-subscriber pull-compressor views are
+        # dead weight (one full-model copy each) — free them; if the
+        # party somehow pulls again, the no-base handshake resyncs dense
+        self._prune_subscriber(node_s)
         self.po.van.send(msg.reply_to(control=Control.ADD_NODE, body={
             "num_global_workers": total, "token": body.get("token")}))
         return True
+
+    def _prune_subscriber(self, node_s: str) -> int:
+        """Free one subscriber's tracked pull-compressor views (leaves /
+        folds / replica evictions).  Safe on live subscribers — a pruned
+        pair's next pull resyncs dense through the version handshake."""
+        with self._pc_mu:
+            if self.pull_comp is None:
+                return 0
+            n = self.pull_comp.drop_subscriber(node_s)
+        if n:
+            self.subscriber_prunes += 1
+            from geomx_tpu.utils.metrics import system_counter
+
+            system_counter(f"{self.po.node}.subscriber_prunes").inc()
+            print(f"{self.po.node}: pruned {n} tracked pull view(s) of "
+                  f"departed subscriber {node_s}", flush=True)
+        return n
 
     def _fold_party_out_locked(self, node_s: str) -> List[int]:
         """Lower the aggregation target by one party; returns the keys
@@ -2165,6 +2190,15 @@ class GlobalServer:
             return False
         body = msg.body if isinstance(msg.body, dict) else {}
         action = body.get("action")
+        if action == "subscriber_prune":
+            # the replica monitor (geomx_tpu/serve) declared a serve
+            # replica dead: free its tracked pull views.  Idempotent;
+            # a revived replica resyncs dense on its next refresh.
+            node_s = str(body.get("node", msg.sender))
+            pruned = self._prune_subscriber(node_s)
+            self.po.van.send(msg.reply_to(control=Control.EVICT, body={
+                "pruned": pruned, "token": body.get("token")}))
+            return True
         if action not in ("party_fold", "party_unfold"):
             return False
         node_s = str(body.get("node", msg.sender))
@@ -2195,6 +2229,11 @@ class GlobalServer:
             system_counter(f"{self.po.node}.{action}s").inc()
             print(f"{self.po.node}: {action} {node_s} "
                   f"(num_global_workers={total})", flush=True)
+            if action == "party_fold":
+                # the folded party's tracked views are freed too: its
+                # warm boot pulls dense and echoes -1, so the resync the
+                # handshake forces anyway makes the prune free
+                self._prune_subscriber(node_s)
         self._flush_completions(to_ack, dissem)
         self.po.van.send(msg.reply_to(control=Control.EVICT, body={
             "num_global_workers": total, "token": body.get("token")}))
@@ -3292,11 +3331,15 @@ class GlobalServer:
             self.server.reply_cmd(msg, body=self.stats())
             return
         elif msg.cmd == Ctrl.LIST_KEYS:
-            # a replacement local server's warm boot asks for the hosted
-            # key set before pulling the model state (kvstore/eviction.py)
+            # a replacement local server's warm boot — and every serve
+            # replica's refresh (geomx_tpu/serve) — asks for the hosted
+            # key set before pulling; ``key_rounds`` rides along so
+            # replicas can stamp their copy with the round progress it
+            # reflects (the version-lag observable)
             with self._mu:
                 ks = sorted(int(k) for k in self.store)
-            self.server.reply_cmd(msg, body={"keys": ks})
+                kr = self.key_rounds
+            self.server.reply_cmd(msg, body={"keys": ks, "key_rounds": kr})
             return
         elif msg.cmd == Ctrl.PROFILER:
             _handle_profiler_cmd(self.po, msg, self.server)
@@ -3334,6 +3377,9 @@ class GlobalServer:
             store_b = sum(a.nbytes for a in self.store.values())
             accum_b = sum(st.accum.nbytes for st in self._keys.values()
                           if st.accum is not None)
+        with self._pc_mu:
+            pv_subs = (len(self.pull_comp.subscribers())
+                       if self.pull_comp is not None else 0)
         return {
             "wan_send_bytes": van.wan_send_bytes,
             "wan_recv_bytes": van.wan_recv_bytes,
@@ -3350,6 +3396,12 @@ class GlobalServer:
             # rounds of one key) — observability for finding that
             "pull_resyncs": (self.pull_comp.resyncs
                              if self.pull_comp is not None else 0),
+            # tracked-view hygiene: distinct subscribers currently
+            # pinning a pull-compressor view, and prune events (leaves /
+            # folds / replica evictions) — a count that only grows as
+            # subscribers churn means the leak is back
+            "pull_view_subscribers": pv_subs,
+            "subscriber_prunes": self.subscriber_prunes,
             # failover observability: term fencing + replication
             "term": self.term,
             "is_standby": self.is_standby,

@@ -109,6 +109,25 @@ class Simulation:
 
             self.failover_monitor = GlobalFailoverMonitor(
                 self.offices[str(self.topology.global_scheduler())])
+        # read-serving replica tier (geomx_tpu/serve): replicas after
+        # the global servers they subscribe to; the monitor (eviction +
+        # subscriber prune) only with heartbeats on.  num_replicas == 0
+        # (the default) constructs nothing — no threads, no endpoints.
+        self.replicas: List["ModelReplica"] = []
+        self.replica_monitor = None
+        self._serve_clients: List = []
+        if self.topology.num_replicas:
+            from geomx_tpu.serve import ModelReplica
+
+            self.replicas = [
+                ModelReplica(self.offices[str(r)], config)
+                for r in self.topology.replicas()
+            ]
+            if config.heartbeat_interval_s > 0 and config.enable_eviction:
+                from geomx_tpu.serve import ReplicaMonitor
+
+                self.replica_monitor = ReplicaMonitor(
+                    self.offices[str(self.topology.global_scheduler())])
         self.workers: Dict[str, WorkerKVStore] = {}
         for p in range(self.topology.num_parties):
             for w in self.topology.workers(p):
@@ -156,6 +175,8 @@ class Simulation:
                          for ls in self.local_servers}
             stats_fns.update({str(gs.po.node): gs.stats for gs in
                               self.global_servers + self.standby_globals})
+            stats_fns.update({str(r.po.node): r.stats
+                              for r in self.replicas})
             for s, po in self.offices.items():
                 self.metrics_pumps[s] = MetricsPump(
                     po, config, stats_fn=stats_fns.get(s),
@@ -343,6 +364,64 @@ class Simulation:
         ls.po.stop()
         return ls
 
+    def kill_replica(self, rank: int = 0) -> "ModelReplica":
+        """Thread-level SIGKILL of a serve replica: its van neither
+        receives nor transmits, its heartbeat and refresh pulls die —
+        the replica monitor evicts it (subscriber views pruned at every
+        shard) after the heartbeat timeout."""
+        rep = self.replicas[rank]
+        rep._stop.set()
+        rep._wake.set()
+        rep.up._retry_stop.set()
+        rep.po.van.kill()
+        rep.po.stop()
+        return rep
+
+    def restart_replica(self, rank: int) -> "ModelReplica":
+        """Stand up a REPLACEMENT replica process (fresh postoffice,
+        new boot incarnation, empty store — what a relaunched ``--role
+        replica:K`` has).  Its first refresh pulls dense; the monitor
+        logs the rejoin when its heartbeats resume."""
+        from geomx_tpu.serve import ModelReplica
+
+        n = self.topology.replica(rank)
+        po = Postoffice(n, self.topology, self.fabric, self.config)
+        rep = ModelReplica(po, self.config)
+        po.start()
+        self.offices[str(n)] = po
+        self.replicas[rank] = rep
+        self._attach_tracer(po)
+        if self.config.enable_obs:
+            from geomx_tpu.obs import MetricsPump
+
+            old = self.metrics_pumps.pop(str(n), None)
+            if old is not None:
+                old.stop()
+            self.metrics_pumps[str(n)] = MetricsPump(
+                po, self.config, stats_fn=rep.stats)
+        return rep
+
+    def serve_client(self, replica_rank: int = 0) -> "ReplicaClient":
+        """An out-of-plan read client against one replica (the wire
+        path an inference frontend uses).  Heartbeats off — a passive
+        querier has no scheduler slot to ping."""
+        import dataclasses
+
+        from geomx_tpu.serve import ReplicaClient
+
+        # serialize id assignment: concurrent reader threads creating
+        # clients must not collide on one out-of-plan node id
+        with self._join_mu:
+            n = NodeId.parse(
+                f"master_worker:{700 + len(self._serve_clients)}")
+            cfg = dataclasses.replace(self.config,
+                                      heartbeat_interval_s=0.0)
+            po = Postoffice(n, self.topology, self.fabric, cfg)
+            po.start()
+            client = ReplicaClient(po, cfg, replica=replica_rank)
+            self._serve_clients.append((client, po))
+        return client
+
     def reassign_shard(self, rank: int, target=None,
                        reason: str = "sim reassignment") -> bool:
         """Live key-range reassignment: move global shard ``rank``'s
@@ -427,6 +506,13 @@ class Simulation:
             m.stop()
         if self.recovery_monitor is not None:
             self.recovery_monitor.stop()
+        if self.replica_monitor is not None:
+            self.replica_monitor.stop()
+        for client, po in self._serve_clients:
+            client.stop()
+            po.stop()
+        for rep in self.replicas:
+            rep.stop()
         if self.master is not None:
             self.master.stop()
         for w in self.workers.values():

@@ -105,6 +105,14 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
         # applies the primary's replication stream and serves nothing
         # until the global scheduler promotes it (kvstore/replication.py)
         role_obj = GlobalServer(po, config, standby=True)
+    elif node.role is Role.REPLICA:
+        from geomx_tpu.serve import ModelReplica
+
+        # read-serving replica (--role replica:K): subscribes to every
+        # global shard with staleness-bounded pulls and answers
+        # SERVE_PULL/PREDICT read traffic from its local copy
+        # (geomx_tpu/serve; docs/serving.md)
+        role_obj = ModelReplica(po, config)
     elif node.role is Role.SCHEDULER and config.enable_intra_ts:
         from geomx_tpu.sched.ts_push import TsPushScheduler
         from geomx_tpu.sched.tsengine import TsScheduler
@@ -140,6 +148,17 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
 
         po.recovery_monitor = LocalServerRecoveryMonitor(po)
         role_obj = role_obj or po.recovery_monitor
+    po.replica_monitor = None
+    if (node.role is Role.GLOBAL_SCHEDULER
+            and config.topology.num_replicas
+            and config.heartbeat_interval_s > 0
+            and config.enable_eviction):
+        # serve replicas are evictable members: expired heartbeats prune
+        # their tracked pull views at every shard; resumed ones rejoin
+        from geomx_tpu.serve import ReplicaMonitor
+
+        po.replica_monitor = ReplicaMonitor(po)
+        role_obj = role_obj or po.replica_monitor
     if node.role is Role.GLOBAL_SCHEDULER and config.enable_obs:
         # cluster telemetry plane (geomx_tpu/obs): the metrics collector
         # + SLO health engine live here, registered BEFORE po.start so
@@ -201,9 +220,11 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
         # their QUERY_STATS-equivalent stats dict
         from geomx_tpu.kvstore.server import GlobalServer, LocalServer
         from geomx_tpu.obs import MetricsPump
+        from geomx_tpu.serve import ModelReplica
 
         stats_fn = (role_obj.stats
-                    if isinstance(role_obj, (LocalServer, GlobalServer))
+                    if isinstance(role_obj, (LocalServer, GlobalServer,
+                                             ModelReplica))
                     else None)
         po.metrics_pump = MetricsPump(
             po, config, stats_fn=stats_fn,
@@ -264,6 +285,8 @@ def shutdown_cluster(po: Postoffice):
         targets.append((gs, Domain.GLOBAL))
     for sb in topo.standby_globals():
         targets.append((sb, Domain.GLOBAL))
+    for rp in topo.replicas():
+        targets.append((rp, Domain.GLOBAL))
     targets.append((topo.global_scheduler(), Domain.GLOBAL))
     for attempt in range(2):
         if attempt:
@@ -615,6 +638,20 @@ def main(argv=None):
                          "--role standby_global:K (every process must "
                          "pass the same count — the port plan includes "
                          "the standbys)")
+    ap.add_argument("--replicas", type=int,
+                    default=int(os.environ.get("GEOMX_SERVE_REPLICAS",
+                                               "0")),
+                    help="read-serving replica tier: K replicas, each "
+                         "holding a staleness-bounded local copy of the "
+                         "whole model and answering SERVE_PULL/PREDICT "
+                         "reads; run each as --role replica:K (every "
+                         "process must pass the same count — the port "
+                         "plan includes the replicas; docs/serving.md)")
+    ap.add_argument("--serve-staleness", type=float,
+                    default=float(os.environ.get("GEOMX_SERVE_STALENESS_S",
+                                                 "0") or 0),
+                    help="replica read-staleness bound in seconds "
+                         "(default Config.serve_staleness_s = 5.0)")
     ap.add_argument("--base-port", type=int,
                     default=int(os.environ.get("GEOMX_BASE_PORT", "9200")))
     ap.add_argument("--advertise", default=os.environ.get("GEOMX_ADVERTISE"),
@@ -722,7 +759,11 @@ def main(argv=None):
                             num_global_servers=(args.global_shards
                                                 or args.global_servers),
                             num_standby_globals=args.standby_globals,
+                            num_replicas=(args.replicas
+                                          or cfg.topology.num_replicas),
                             central_worker=central)
+    if args.serve_staleness > 0:
+        cfg.serve_staleness_s = args.serve_staleness
     cfg.compression = args.compression
     # ESync exchanges weights like HFA — servers must run in HFA mode
     # (ref: examples/cnn.py wires --esync the same way)
@@ -850,7 +891,20 @@ def main(argv=None):
                       ("warm_boots", "warm_boots"),
                       ("party_folds", "party_folds"),
                       ("party_unfolds", "party_unfolds"),
-                      ("server_recoveries", "server_recoveries")):
+                      ("server_recoveries", "server_recoveries"),
+                      # serve tier observables: reads answered, the
+                      # staleness contract's park/expire counters, the
+                      # refresh cadence, membership events, and the
+                      # tracked-view prunes (replicas + global servers)
+                      ("serve_pulls", "serve_pulls"),
+                      ("serve_predicts", "serve_predicts"),
+                      ("staleness_violations", "staleness_violations"),
+                      ("stale_rejects", "stale_rejects"),
+                      ("refresh_rounds", "replica_refreshes"),
+                      ("dense_resyncs", "dense_resyncs"),
+                      ("replica_evictions", "replica_evictions"),
+                      ("replica_rejoins", "replica_rejoins"),
+                      ("subscriber_prunes", "subscriber_prunes")):
         v = getattr(role_obj, attr, 0)
         if v:
             feats.append(f"{tag}={v}")

@@ -33,6 +33,10 @@ Rules (thresholds are ``Config.obs_*`` knobs):
   8x the fleet median.
 - **fence_spike** — fenced/evicted/rejected event counters for one
   node grew by more than ``obs_fence_spike`` within the ring window.
+- **replica_staleness** — a serve replica's reported local-copy age
+  exceeds the configured read bound (``Config.serve_staleness_s``):
+  its refresh loop is falling behind, so reads are parking instead of
+  being answered (the serving tier's SLO; geomx_tpu/serve).
 """
 
 from __future__ import annotations
@@ -55,7 +59,8 @@ _FENCE_KEYS = ("eviction_fenced_pushes", "fenced_rejects",
                "evicted_workers", "worker_evictions")
 
 RULES = ("round_stall", "replication_lag", "shard_imbalance",
-         "goodput_collapse", "rtt_outlier", "fence_spike")
+         "goodput_collapse", "rtt_outlier", "fence_spike",
+         "replica_staleness")
 
 
 def _json_safe(obj):
@@ -132,7 +137,8 @@ class HealthEngine:
         records = []
         for rule in (self._rule_round_stall, self._rule_replication_lag,
                      self._rule_shard_imbalance, self._rule_goodput_collapse,
-                     self._rule_rtt_outlier, self._rule_fence_spike):
+                     self._rule_rtt_outlier, self._rule_fence_spike,
+                     self._rule_replica_staleness):
             try:
                 records.extend(rule(now))
             except Exception:  # one broken rule must not mute the rest
@@ -348,6 +354,26 @@ class HealthEngine:
                 message=f"{total:.0f} fenced/evicted events in the "
                         f"window (threshold {self.fence_spike})",
                 events=total, threshold=self.fence_spike)
+            if rec:
+                out.append(rec)
+        return out
+
+    def _rule_replica_staleness(self, now: float) -> List[dict]:
+        out = []
+        bound = float(getattr(self.config, "serve_staleness_s", 5.0))
+        for node in self.collector.nodes():
+            if not node.startswith("replica:"):
+                continue
+            v = self.collector.value(node, "staleness_s")
+            if not isinstance(v, (int, float)) or not math.isfinite(v):
+                continue  # never refreshed yet: nothing to judge
+            rec = self._set_state(
+                "replica_staleness", node, v > bound, now,
+                message=f"local model copy {v:.2f}s old (read bound "
+                        f"{bound:.2f}s — reads are parking)"
+                if v > bound else
+                f"local copy {v:.2f}s old, back under the bound",
+                staleness_s=round(float(v), 3), bound_s=bound)
             if rec:
                 out.append(rec)
         return out

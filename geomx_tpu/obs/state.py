@@ -86,7 +86,7 @@ class ClusterStateService:
 
         nodes = {}
         for n in (list(topo.global_servers()) + list(topo.standby_globals())
-                  + list(topo.servers())):
+                  + list(topo.servers()) + list(topo.replicas())):
             nodes[str(n)] = node_entry(n)
 
         fm = self.failover_monitor
@@ -137,6 +137,45 @@ class ClusterStateService:
                         entry[key] = st[key]
             parties[p] = entry
 
+        # serve replicas (geomx_tpu/serve): per-replica staleness / QPS
+        # / version lag vs the shard holders' current round progress
+        replicas = {}
+        if topo.num_replicas:
+            cur_rounds = None
+            if self.collector is not None:
+                vals = []
+                for k, s in shards.items():
+                    kr = s.get("key_rounds")
+                    if isinstance(kr, (int, float)):
+                        vals.append(kr)
+                if vals:
+                    cur_rounds = int(sum(vals))
+            for r in topo.replicas():
+                s = str(r)
+                entry = {"node": s, "alive": nodes.get(s, {}).get("alive")}
+                if self.collector is not None:
+                    st = self.collector.latest_stats(s) or {}
+                    for key in ("staleness_s", "serve_pulls",
+                                "serve_predicts", "staleness_violations",
+                                "stale_rejects", "replica_refreshes",
+                                "rounds_at_refresh", "keys",
+                                "serve_p50_ms", "serve_p99_ms"):
+                        if st.get(key) is not None:
+                            entry[key] = st[key]
+                    qps = self.collector.rate(s, "serve_pulls")
+                    if qps is not None:
+                        entry["serve_qps"] = round(qps, 2)
+                    if (cur_rounds is not None
+                            and isinstance(st.get("rounds_at_refresh"),
+                                           (int, float))):
+                        # clamped at 0: the replica's LIST_KEYS snapshot
+                        # and the holder's pump sample are taken at
+                        # different instants, so a fresh replica can
+                        # read "ahead" of the collector by a few rounds
+                        entry["version_lag_rounds"] = max(0, int(
+                            cur_rounds - st["rounds_at_refresh"]))
+                replicas[r.rank] = entry
+
         policy = None
         if self.wan_controller is not None:
             s = self.wan_controller.status()
@@ -174,10 +213,12 @@ class ClusterStateService:
                 "workers_per_party": topo.workers_per_party,
                 "global_shards": topo.num_global_servers,
                 "standby_globals": topo.num_standby_globals,
+                "replicas": topo.num_replicas,
             },
             "heartbeats": hb_on,
             "shards": shards,
             "parties": parties,
+            "replicas": replicas,
             "nodes": nodes,
             "policy": policy,
             "health": health,
@@ -205,7 +246,9 @@ def render_text(state: dict) -> str:
         f"{topo.get('workers_per_party', '?')} workers, "
         f"{topo.get('global_shards', '?')} global shard(s)"
         + (f" (+{topo['standby_globals']} standby)"
-           if topo.get("standby_globals") else ""),
+           if topo.get("standby_globals") else "")
+        + (f", {topo['replicas']} serve replica(s)"
+           if topo.get("replicas") else ""),
     ]
     lines.append("shards:")
     shards = state.get("shards", {})
@@ -231,6 +274,25 @@ def render_text(state: dict) -> str:
             extra += f" wan_rounds={int(e['wan_push_rounds'])}"
         lines.append(f"  p{p}: {e.get('server')} "
                      f"[{_alive_tag(e.get('alive'))}]{extra}")
+    replicas = state.get("replicas") or {}
+    if replicas:
+        lines.append("replicas:")
+        for r in sorted(replicas, key=int):
+            e = replicas[r]
+            extra = ""
+            if e.get("staleness_s") is not None:
+                extra += f" staleness={e['staleness_s']:.2f}s"
+            if e.get("version_lag_rounds") is not None:
+                extra += f" lag={int(e['version_lag_rounds'])}r"
+            if e.get("serve_qps") is not None:
+                extra += f" qps={e['serve_qps']:.1f}"
+            if e.get("serve_pulls") is not None:
+                extra += f" pulls={int(e['serve_pulls'])}"
+            if e.get("staleness_violations"):
+                extra += (f" violations="
+                          f"{int(e['staleness_violations'])}")
+            lines.append(f"  replica {r}: {e.get('node')} "
+                         f"[{_alive_tag(e.get('alive'))}]{extra}")
     pol = state.get("policy")
     if pol:
         line = f"wan policy: epoch={pol.get('epoch')}"

@@ -183,7 +183,11 @@ class Postoffice:
         workers ping the party scheduler; global servers ping the global
         scheduler."""
         targets = []
-        if self.node.role in (Role.GLOBAL_SERVER, Role.STANDBY_GLOBAL):
+        if self.node.role in (Role.GLOBAL_SERVER, Role.STANDBY_GLOBAL,
+                              Role.REPLICA):
+            # replicas are WAN-domain members like the global tier: the
+            # global scheduler's table makes them evictable (subscriber
+            # prune) and their freshness visible in the status console
             targets.append((self.topology.global_scheduler(), Domain.GLOBAL))
         else:
             targets.append(
@@ -278,7 +282,7 @@ class Postoffice:
     def _my_scheduler(self):
         sched = (self.topology.global_scheduler()
                  if self.node.role in (Role.GLOBAL_SERVER,
-                                       Role.STANDBY_GLOBAL)
+                                       Role.STANDBY_GLOBAL, Role.REPLICA)
                  else self.topology.scheduler(self.node.party))
         domain = (Domain.GLOBAL if sched.role is Role.GLOBAL_SCHEDULER
                   else Domain.LOCAL)

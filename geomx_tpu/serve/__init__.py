@@ -1,0 +1,11 @@
+"""Read-serving replica tier: staleness-bounded model subscribers
+serving high-QPS pull/predict traffic under concurrent training.
+
+See docs/serving.md for the operator guide.
+"""
+
+from geomx_tpu.serve.client import ReplicaClient
+from geomx_tpu.serve.monitor import ReplicaMonitor
+from geomx_tpu.serve.replica import ModelReplica
+
+__all__ = ["ModelReplica", "ReplicaClient", "ReplicaMonitor"]

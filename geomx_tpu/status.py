@@ -106,6 +106,9 @@ def main(argv=None) -> int:
     ap.add_argument("--standby-globals", type=int,
                     default=int(os.environ.get("GEOMX_NUM_STANDBY_GLOBALS",
                                                "0")))
+    ap.add_argument("--replicas", type=int,
+                    default=int(os.environ.get("GEOMX_SERVE_REPLICAS",
+                                               "0")))
     ap.add_argument("--base-port", type=int,
                     default=int(os.environ.get("GEOMX_BASE_PORT", "9200")))
     ap.add_argument("--status-port", type=int,
@@ -119,7 +122,8 @@ def main(argv=None) -> int:
     cfg.topology = Topology(num_parties=args.parties,
                             workers_per_party=args.workers,
                             num_global_servers=args.global_shards,
-                            num_standby_globals=args.standby_globals)
+                            num_standby_globals=args.standby_globals,
+                            num_replicas=args.replicas)
     client = StatusClient(cfg, args.base_port,
                           args.status_port or args.base_port + 177)
     try:

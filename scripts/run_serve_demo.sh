@@ -1,0 +1,128 @@
+#!/usr/bin/env bash
+# Serve-tier demo: a real OS-process topology over TCP with TWO read
+# replicas serving pull traffic while training runs; SIGKILL replica 0
+# mid-serve and assert
+# (a) the SURVIVOR keeps answering reads within the staleness bound
+#     (serve.load --assert-staleness against replica 1),
+# (b) the console (`python -m geomx_tpu.status`) flips replica 0 to
+#     DOWN and the global scheduler logs the eviction (tracked views
+#     pruned at every shard),
+# (c) a RESTARTED replica 0 rejoins (the eviction/recovery pair in the
+#     scheduler log) and serves within the bound again, and
+# (d) training ran to completion throughout.
+#
+# The pytest acceptance (tests/test_serve.py::test_e2e_reads_survive_
+# shard_sigkill_under_training) is the in-proc shard-failover version;
+# this script is the operator-facing replica-churn tour.
+# See docs/serving.md.
+#
+# Env: GEOMX_BASE_PORT (default 9560), STEPS (default 600)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export JAX_PLATFORM_NAME=cpu
+export GEOMX_SERVE_REPLICAS=2
+export GEOMX_SERVE_STALENESS_S=2.0
+export GEOMX_SERVE_REFRESH_S=0.2
+export GEOMX_HEARTBEAT_INTERVAL=0.2
+export GEOMX_HEARTBEAT_TIMEOUT=1.5
+export GEOMX_REQUEST_RETRY_S=1.0
+export GEOMX_RETRY_BACKOFF_CAP=2
+export GEOMX_OBS=1
+export GEOMX_OBS_INTERVAL=0.2
+# pace the worker (~40 ms/step): training must outlive the kill +
+# restart + the console polls
+export GEOMX_TEST_STEP_SLEEP_MS='{"worker:0@p0": 40}'
+
+BASE=${GEOMX_BASE_PORT:-9560}
+export GEOMX_BASE_PORT=$BASE
+STEPS=${STEPS:-600}
+OUT=$(mktemp -d)
+trap 'kill $(jobs -p) 2>/dev/null || true; rm -rf "$OUT"' EXIT
+
+launch() { # role  (bsc pull compression so the replicas ride the
+  #                 sparse-delta subscription, and the eviction prune
+  #                 has tracked views to free)
+  python -m geomx_tpu.launch --role "$1" --parties 1 --workers 1 \
+    --replicas 2 --base-port "$BASE" --obs-interval 0.2 \
+    --compression bsc --steps "$STEPS" >"$OUT/${1//[:@]/_}.log" 2>&1 &
+}
+
+launch global_scheduler:0
+launch global_server:0
+launch scheduler:0@p0
+launch server:0@p0
+launch replica:0
+REPLICA0_PID=$!
+launch replica:1
+launch worker:0@p0
+WORKER_PID=$!
+
+for _ in $(seq 1 240); do
+  grep -q "training begins" "$OUT/worker_0_p0.log" 2>/dev/null && break
+  sleep 0.5
+done
+grep -q "training begins" "$OUT/worker_0_p0.log" \
+  || { echo "FAIL: worker never started training"; tail "$OUT/worker_0_p0.log"; exit 1; }
+sleep 2  # a few rounds + replica refreshes
+
+echo "== reads against BOTH replicas (staleness-asserted) =="
+python -m geomx_tpu.serve.load --replica 0 --seconds 2 --assert-staleness \
+  >"$OUT/load0_before.txt" || { echo "FAIL: replica 0 load"; cat "$OUT/load0_before.txt"; exit 1; }
+cat "$OUT/load0_before.txt"
+python -m geomx_tpu.serve.load --replica 1 --seconds 2 --assert-staleness \
+  >"$OUT/load1_before.txt" || { echo "FAIL: replica 1 load"; cat "$OUT/load1_before.txt"; exit 1; }
+cat "$OUT/load1_before.txt"
+
+echo "== SIGKILL replica 0 (pid $REPLICA0_PID) =="
+kill -9 "$REPLICA0_PID"
+
+echo "== survivor keeps serving within the bound =="
+python -m geomx_tpu.serve.load --replica 1 --seconds 3 --assert-staleness \
+  >"$OUT/load1_after.txt" || { echo "FAIL: survivor violated the staleness bound"; cat "$OUT/load1_after.txt"; exit 1; }
+cat "$OUT/load1_after.txt"
+
+# console: replica 0 flips to DOWN once its heartbeats expire
+FLIPPED=0
+for _ in $(seq 1 20); do
+  kill -0 "$WORKER_PID" 2>/dev/null \
+    || { echo "FAIL: training ended before the console saw the kill"; exit 1; }
+  python -m geomx_tpu.status --timeout 3 >"$OUT/status_after.txt" 2>/dev/null || true
+  if grep -q "replica 0: replica:0 \[DOWN\]" "$OUT/status_after.txt" \
+     && grep -q "replica 1: replica:1 \[up\]" "$OUT/status_after.txt"; then
+    FLIPPED=1; break
+  fi
+  sleep 0.5
+done
+echo "== status after the kill =="
+cat "$OUT/status_after.txt"
+[ "$FLIPPED" = 1 ] \
+  || { echo "FAIL: console never showed replica 0 DOWN / replica 1 up"; exit 1; }
+
+GS="$OUT/global_scheduler_0.log"
+for _ in $(seq 1 20); do
+  grep -q "evicted replica replica:0" "$GS" && break
+  sleep 0.5
+done
+grep -q "evicted replica replica:0" "$GS" \
+  || { echo "FAIL: scheduler never logged the replica eviction"; grep replica "$GS" || true; exit 1; }
+grep -q "pruned .* tracked pull view" "$OUT/global_server_0.log" \
+  || { echo "FAIL: global server never pruned the dead replica's views"; exit 1; }
+
+echo "== restart replica 0 (rejoin) =="
+launch replica:0
+for _ in $(seq 1 30); do
+  grep -q "replica replica:0 resumed heartbeats" "$GS" && break
+  sleep 0.5
+done
+grep -q "replica replica:0 resumed heartbeats" "$GS" \
+  || { echo "FAIL: scheduler never logged the rejoin"; grep replica "$GS" || true; exit 1; }
+python -m geomx_tpu.serve.load --replica 0 --seconds 2 --assert-staleness \
+  >"$OUT/load0_after.txt" || { echo "FAIL: rejoined replica 0 load"; cat "$OUT/load0_after.txt"; exit 1; }
+cat "$OUT/load0_after.txt"
+
+wait "$WORKER_PID" || true
+grep -q "steps=$STEPS" "$OUT/worker_0_p0.log" \
+  || { echo "FAIL: training did not finish all steps"; exit 1; }
+echo "OK: survivor served within the bound through the kill, console + logs showed the eviction/rejoin pair, training completed"

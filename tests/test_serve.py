@@ -1,0 +1,502 @@
+"""Read-serving replica tier (ISSUE 8 tentpole): staleness-bounded model
+subscribers serving pull/predict traffic under concurrent training.
+
+Covers: serve-pull/predict correctness against the training store, the
+staleness contract (a read is NEVER answered from a copy older than the
+bound — it parks until a refresh lands, and errors once the bound
+passes again with the global tier dark behind a FaultPolicy partition),
+the BroadcastCompressor subscription path (first refresh dense, then
+sparse deltas bit-identical to the tracked view, dense resync after a
+prune), the tracked-view LEAK regression (views freed on party leave,
+party fold, and replica eviction), replica eviction → rejoin through
+the heartbeat monitor, reads surviving a live key-range reassignment
+(ShardTargets retarget) and — slow — a global-shard SIGKILL failover
+with the version-lag assertion, the cluster-state replicas section +
+health rule, and the disabled-path guard (no replicas configured
+constructs nothing).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomx_tpu.core.config import Config, NodeId, Topology
+from geomx_tpu.kvstore import Simulation
+from geomx_tpu.kvstore.common import Cmd
+
+
+def _cfg(replicas=1, parties=1, **kw):
+    kw.setdefault("serve_refresh_interval_s", 0.0)  # manual refresh()
+    kw.setdefault("serve_staleness_s", 5.0)
+    return Config(topology=Topology(num_parties=parties,
+                                    workers_per_party=1,
+                                    num_replicas=replicas), **kw)
+
+
+def _wait_for(pred, timeout=20.0, every=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+def _train_rounds(sim, rounds, tids=(0,), n=1000, val=1.0):
+    ws = sim.all_workers()
+    for _ in range(rounds):
+        for w in ws:
+            for t in tids:
+                w.push(t, np.full(n, val, np.float32))
+        for w in ws:
+            for t in tids:
+                w.pull_sync(t)
+            w.wait_all()
+
+
+# ---------------------------------------------------------------------------
+def test_disabled_path_constructs_nothing():
+    """num_replicas == 0 (the default): no replica objects, no monitor,
+    no refresh threads anywhere in the deployment."""
+    before = {t.name for t in threading.enumerate()}
+    sim = Simulation(Config(topology=Topology(num_parties=1,
+                                              workers_per_party=1),
+                            heartbeat_interval_s=0.2))
+    try:
+        assert sim.replicas == []
+        assert sim.replica_monitor is None
+        new = {t.name for t in threading.enumerate()} - before
+        assert not any(n.startswith("replica-refresh") for n in new)
+        assert not any("ReplicaMonitor" in n for n in new)
+    finally:
+        sim.shutdown()
+
+
+def test_serve_pull_and_predict_match_training_store():
+    sim = Simulation(_cfg())
+    try:
+        w = sim.worker(0, 0)
+        w.init(0, np.arange(1000, dtype=np.float32))
+        w.init(1, np.ones((8, 4), np.float32))
+        w.set_optimizer({"type": "sgd", "lr": 0.1})
+        _train_rounds(sim, 2, tids=(0,))
+        rep = sim.replicas[0]
+        assert rep.refresh()
+        c = sim.serve_client(0)
+        arr, meta = c.pull_tensor(0, 1000)
+        # the replica serves exactly what the global tier holds
+        gs = sim.global_servers[0]
+        for k, v in gs.store.items():
+            assert np.array_equal(rep.store[k], v)
+        assert meta["staleness_s"] <= sim.config.serve_staleness_s
+        assert meta["version"] == 1
+        # predict: x @ W on the replica == numpy on the pulled weights
+        x = np.random.default_rng(0).standard_normal((3, 8)).astype(
+            np.float32)
+        out, pmeta = c.predict(x, [(1, (8, 4))])
+        W, _ = c.pull_tensor(1, 32)
+        assert np.allclose(out, x @ W.reshape(8, 4))
+        assert out.shape == (3, 4)
+        assert pmeta["staleness_s"] <= sim.config.serve_staleness_s
+        st = rep.stats()
+        assert st["serve_pulls"] >= 2 and st["serve_predicts"] == 1
+    finally:
+        sim.shutdown()
+
+
+def test_read_only_replica_rejects_pushes():
+    sim = Simulation(_cfg())
+    try:
+        w = sim.worker(0, 0)
+        w.init(0, np.zeros(64, np.float32))
+        sim.replicas[0].refresh()
+        c = sim.serve_client(0)
+        with pytest.raises(RuntimeError, match="read-serving"):
+            c._roundtrip({"push": True, "cmd": int(Cmd.DEFAULT),
+                          "keys": np.array([0], np.int64),
+                          "vals": np.ones(4, np.float32),
+                          "lens": np.array([4], np.int64)}, 5.0)
+    finally:
+        sim.shutdown()
+
+
+def test_stale_read_parks_until_refresh_lands():
+    """THE staleness contract: a read arriving while the copy is stale
+    is parked — never answered stale — and completes the moment a
+    refresh lands, with the response staleness under the bound."""
+    sim = Simulation(_cfg(serve_staleness_s=0.3))
+    try:
+        w = sim.worker(0, 0)
+        w.init(0, np.arange(256, dtype=np.float32))
+        rep = sim.replicas[0]
+        assert rep.refresh()
+        time.sleep(0.4)  # the copy ages past the bound
+        c = sim.serve_client(0)
+        out = {}
+
+        def read():
+            out["res"] = c.pull_tensor(0, 256)
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        # the read must be parked, not answered from the stale copy
+        assert _wait_for(lambda: len(rep._parked) == 1, timeout=5.0)
+        assert "res" not in out
+        assert rep.staleness_violations == 1
+        assert rep.refresh()
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        _, meta = out["res"]
+        assert meta["staleness_s"] <= 0.3
+        assert rep.stats()["parked_reads"] == 0
+    finally:
+        sim.shutdown()
+
+
+def test_stale_read_errors_when_global_tier_partitioned():
+    """Throttled/dead WAN (FaultPolicy link cut): with the global tier
+    unreachable past the bound, a parked read is answered with an
+    explicit staleness error — the bound is honored by refusing, never
+    by serving stale."""
+    sim = Simulation(_cfg(serve_staleness_s=0.3,
+                          serve_refresh_interval_s=0.1))
+    try:
+        w = sim.worker(0, 0)
+        w.init(0, np.arange(256, dtype=np.float32))
+        rep = sim.replicas[0]
+        assert _wait_for(lambda: rep.refresh_rounds > 0, timeout=10.0)
+        # cut the replica off from the global tier (reads still reach
+        # the replica — the whole point is that it must refuse them)
+        sim.partition("replica:0", "global_server:0")
+        time.sleep(0.5)  # the copy ages past the bound behind the cut
+        c = sim.serve_client(0)
+        with pytest.raises(RuntimeError, match="stale"):
+            c.pull_tensor(0, 256, timeout=15.0)
+        assert rep.stale_rejects >= 1
+        # heal: the refresh loop recovers and reads serve again
+        sim.heal()
+        rv = rep.refresh_rounds
+        assert _wait_for(lambda: rep.refresh_rounds > rv, timeout=10.0)
+        _, meta = c.pull_tensor(0, 256)
+        assert meta["staleness_s"] <= 0.3
+    finally:
+        sim.shutdown()
+
+
+def test_bsc_subscription_sparse_deltas_then_dense_resync():
+    """The PR 4 handshake end-to-end for a replica subscriber: first
+    compressed pull forced dense (echo -1), steady-state refreshes ride
+    sparse deltas that keep the replica bit-identical to the server's
+    tracked view, and a pruned view heals with exactly one more dense
+    resync."""
+    sim = Simulation(_cfg(compression="bsc"))
+    try:
+        w = sim.worker(0, 0)
+        w.init(0, np.zeros(5000, np.float32))
+        w.set_optimizer({"type": "sgd", "lr": 0.1})
+        w.set_gradient_compression({"type": "bsc", "ratio": 0.01})
+        rep = sim.replicas[0]
+        assert rep.refresh()  # bootstrap: dense body, untagged
+        gs = sim.global_servers[0]
+        rng = np.random.default_rng(1)
+        for _ in range(3):
+            _train_rounds(sim, 1, n=5000,
+                          val=float(rng.standard_normal()))
+            assert rep.refresh()
+        # exactly one forced dense resync (the echo -1 handshake);
+        # later refreshes were sparse deltas
+        assert rep.dense_resyncs == 1
+        k = sorted(rep.store)[0]
+        view = gs.pull_comp._view[("replica:0", k)]
+        assert np.array_equal(rep.store[k], view)
+        # prune (what eviction actuates) -> next refresh resyncs dense
+        assert gs._prune_subscriber("replica:0") == 1
+        _train_rounds(sim, 1, n=5000, val=0.5)
+        assert rep.refresh()
+        assert rep.dense_resyncs == 2
+        assert np.array_equal(rep.store[k], gs.store[k])
+    finally:
+        sim.shutdown()
+
+
+def test_tracked_view_leak_pruned_on_party_leave_and_fold():
+    """Regression for the PR 8 leak: a departed subscriber's tracked
+    views (one full-model copy each) must be freed on graceful party
+    leave AND on a crash fold — before this fix they were pinned
+    forever."""
+    sim = Simulation(_cfg(parties=2, compression="bsc",
+                          heartbeat_interval_s=0.2,
+                          heartbeat_timeout_s=1.0,
+                          request_retry_s=1.0))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(5000, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        for p in range(2):
+            sim.worker(p, 0).set_gradient_compression(
+                {"type": "bsc", "ratio": 0.01})
+        _train_rounds(sim, 2, n=5000)
+        gs = sim.global_servers[0]
+        subs = gs.pull_comp.subscribers()
+        assert {"server:0@p0", "server:0@p1"} <= subs
+        # graceful leave: party 1 withdraws -> its views are freed
+        sim.local_servers[1].leave_global()
+        assert _wait_for(
+            lambda: "server:0@p1" not in gs.pull_comp.subscribers(),
+            timeout=5.0)
+        assert gs.subscriber_prunes >= 1
+        # crash fold: party 0's local server dies -> recovery monitor
+        # folds it out, and the fold frees its views too
+        sim.kill_local_server(0)
+        assert _wait_for(
+            lambda: "server:0@p0" not in gs.pull_comp.subscribers(),
+            timeout=20.0)
+        assert gs.stats()["pull_view_subscribers"] == 0
+    finally:
+        sim.shutdown()
+
+
+def test_replica_eviction_prunes_views_and_rejoin_resyncs_dense():
+    """A replica whose heartbeats expire is evicted (views pruned at
+    every shard); a restarted replacement rejoins and its first refresh
+    resumes from a dense resync."""
+    sim = Simulation(_cfg(compression="bsc", heartbeat_interval_s=0.2,
+                          heartbeat_timeout_s=1.0, request_retry_s=1.0,
+                          serve_refresh_interval_s=0.1,
+                          serve_staleness_s=2.0))
+    try:
+        w = sim.worker(0, 0)
+        w.init(0, np.zeros(5000, np.float32))
+        w.set_optimizer({"type": "sgd", "lr": 0.1})
+        w.set_gradient_compression({"type": "bsc", "ratio": 0.01})
+        _train_rounds(sim, 2, n=5000)
+        rep = sim.replicas[0]
+        assert _wait_for(lambda: rep.refresh_rounds >= 2, timeout=10.0)
+        gs = sim.global_servers[0]
+        assert _wait_for(
+            lambda: "replica:0" in gs.pull_comp.subscribers(),
+            timeout=10.0)
+        sim.kill_replica(0)
+        assert _wait_for(
+            lambda: sim.replica_monitor.replica_evictions == 1,
+            timeout=20.0)
+        assert "replica:0" not in gs.pull_comp.subscribers()
+        # replacement process: fresh boot, empty store
+        rep2 = sim.restart_replica(0)
+        assert _wait_for(
+            lambda: sim.replica_monitor.replica_rejoins == 1,
+            timeout=20.0)
+        assert _wait_for(lambda: rep2.refresh_rounds > 0, timeout=10.0)
+        k = sorted(gs.store)[0]
+        assert np.array_equal(rep2.store[k], gs.store[k])
+        c = sim.serve_client(0)
+        _, meta = c.pull_tensor(0, 5000)
+        assert meta["staleness_s"] <= 2.0
+    finally:
+        sim.shutdown()
+
+
+def test_reads_survive_key_range_reassignment():
+    """ShardTargets retarget: draining shard 0's range onto shard 1
+    moves the replica's subscription (NEW_PRIMARY broadcast) and reads
+    keep landing under the bound."""
+    sim = Simulation(_cfg(global_shards=2, bigarray_bound=1000,
+                          heartbeat_interval_s=0.2,
+                          heartbeat_timeout_s=1.0, request_retry_s=1.0,
+                          serve_refresh_interval_s=0.1,
+                          serve_staleness_s=2.0))
+    try:
+        w = sim.worker(0, 0)
+        w.init(0, np.arange(4000, dtype=np.float32))  # spans both shards
+        w.set_optimizer({"type": "sgd", "lr": 0.1})
+        _train_rounds(sim, 2, n=4000)
+        rep = sim.replicas[0]
+        assert _wait_for(lambda: rep.refresh_rounds > 0, timeout=10.0)
+        c = sim.serve_client(0)
+        _, meta = c.pull_tensor(0, 4000)
+        assert meta["staleness_s"] <= 2.0
+        assert sim.reassign_shard(0, target="global_server:1")
+        assert _wait_for(lambda: rep.failover_events >= 1, timeout=10.0)
+        # training continues against the merged holder; reads follow
+        _train_rounds(sim, 1, n=4000)
+        rv = rep.refresh_rounds
+        assert _wait_for(lambda: rep.refresh_rounds > rv, timeout=10.0)
+        arr, meta = c.pull_tensor(0, 4000)
+        assert meta["staleness_s"] <= 2.0
+        assert str(rep.up.targets[0]) == "global_server:1"
+        # the replica's copy tracks the post-drain holder's store
+        gs1 = sim.global_servers[1]
+        for k in rep.store:
+            assert np.array_equal(rep.store[k], gs1.store[k])
+    finally:
+        sim.shutdown()
+
+
+def test_cluster_state_replicas_section_and_render():
+    sim = Simulation(_cfg(enable_obs=True, obs_interval_s=0.0,
+                          serve_staleness_s=5.0))
+    try:
+        w = sim.worker(0, 0)
+        w.init(0, np.arange(256, dtype=np.float32))
+        rep = sim.replicas[0]
+        assert rep.refresh()
+        c = sim.serve_client(0)
+        c.pull_tensor(0, 256)
+        sim.pump_metrics()
+        state = sim.cluster_state()
+        assert state["topology"]["replicas"] == 1
+        ent = state["replicas"][0]
+        assert ent["node"] == "replica:0"
+        assert ent["serve_pulls"] >= 1
+        assert ent["staleness_s"] is not None
+        assert ent["version_lag_rounds"] == 0  # no training since refresh
+        from geomx_tpu.obs.state import render_text
+
+        txt = render_text(state)
+        assert "replicas:" in txt and "replica 0: replica:0" in txt
+    finally:
+        sim.shutdown()
+
+
+def test_health_rule_replica_staleness():
+    """Unit: the replica_staleness rule fires when a replica's shipped
+    staleness exceeds the configured bound, recovers when it drops, and
+    never duplicates records while firing."""
+    sim = Simulation(Config(topology=Topology(num_parties=1,
+                                              workers_per_party=1),
+                            enable_obs=True, obs_interval_s=0.0,
+                            serve_staleness_s=2.0))
+    try:
+        mc, eng = sim.metrics_collector, sim.health
+        mc.ingest({"node": "replica:7", "boot": 1, "t_mono": 1.0,
+                   "metrics": {}, "stats": {"staleness_s": 9.0}})
+        recs = eng.tick(now=10.0)
+        got = {(r["rule"], r["subject"], r["state"]) for r in recs}
+        assert ("replica_staleness", "replica:7", "firing") in got
+        assert not eng.tick(now=11.0)  # still firing -> no duplicate
+        mc.ingest({"node": "replica:7", "boot": 1, "t_mono": 2.0,
+                   "metrics": {}, "stats": {"staleness_s": 0.2}})
+        recs = eng.tick(now=12.0)
+        got = {(r["rule"], r["subject"], r["state"]) for r in recs}
+        assert ("replica_staleness", "replica:7", "recovered") in got
+        assert not eng.active_alerts()
+    finally:
+        sim.shutdown()
+
+
+def test_replica_wire_roundtrip_and_multikey_pull():
+    """SERVE_PULL over the wire path: multi-key reads reassemble in key
+    order and LIST_KEYS/QUERY_STATS answer on the replica."""
+    sim = Simulation(_cfg(bigarray_bound=500, global_shards=2))
+    try:
+        w = sim.worker(0, 0)
+        w.init(0, np.arange(2000, dtype=np.float32))  # partitioned
+        rep = sim.replicas[0]
+        assert rep.refresh()
+        c = sim.serve_client(0)
+        keys = c.list_keys()
+        assert len(keys) == len(rep.store) >= 2
+        kvs, meta = c.pull(keys)
+        assert [int(k) for k in kvs.keys] == sorted(keys)
+        arr, _ = c.pull_tensor(0, 2000)
+        assert np.array_equal(arr, np.arange(2000, dtype=np.float32))
+        st = c.stats()
+        assert st["keys"] == len(keys) and st["serve_pulls"] >= 2
+    finally:
+        sim.shutdown()
+
+
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.failover
+def test_e2e_reads_survive_shard_sigkill_under_training():
+    """Acceptance (ISSUE 8): 2 replicas serve concurrent read traffic
+    while training runs; SIGKILL one global shard's primary mid-serve.
+    Training fails over and keeps making progress, every successful
+    read honors the staleness bound (version-lag assertion: the copy's
+    observed round progress keeps advancing past the kill), and the
+    surviving reads never error beyond the failover window."""
+    bound = 3.0
+    sim = Simulation(Config(
+        topology=Topology(num_parties=2, workers_per_party=1,
+                          num_global_servers=2, num_standby_globals=2,
+                          num_replicas=2),
+        bigarray_bound=1000,
+        heartbeat_interval_s=0.2, heartbeat_timeout_s=1.0,
+        request_retry_s=1.0, serve_staleness_s=bound,
+        serve_refresh_interval_s=0.2))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(4000, np.float32))  # spans both shards
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        stop = threading.Event()
+        rounds = [0]
+        train_err = []
+
+        def train():
+            try:
+                while not stop.is_set():
+                    _train_rounds(sim, 1, n=4000)
+                    rounds[0] += 1
+            except Exception as e:  # pragma: no cover - surfaced below
+                train_err.append(e)
+
+        trainer = threading.Thread(target=train, daemon=True)
+        trainer.start()
+        reps = sim.replicas
+        assert _wait_for(lambda: all(r.refresh_rounds > 0 and r.store
+                                     for r in reps), timeout=30.0)
+        metas = []
+        read_errors = []
+        stop_read = threading.Event()
+
+        def reader(rank):
+            c = sim.serve_client(rank)
+            while not stop_read.is_set():
+                try:
+                    _, meta = c.pull_tensor(0, 4000, timeout=10.0)
+                    metas.append(meta)
+                except (TimeoutError, RuntimeError) as e:
+                    read_errors.append(str(e))
+                time.sleep(0.02)
+
+        readers = [threading.Thread(target=reader, args=(r,),
+                                    daemon=True) for r in range(2)]
+        for t in readers:
+            t.start()
+        time.sleep(1.5)
+        pre_kill_reads = len(metas)
+        pre_kill_rounds = max((m.get("rounds_at_refresh", 0)
+                               for m in metas), default=0)
+        assert pre_kill_reads > 0
+        sim.kill_global_server(1)  # shard 1's primary goes dark
+        # failover: training must resume and keep completing rounds
+        r0 = rounds[0]
+        assert _wait_for(lambda: rounds[0] > r0 + 2, timeout=60.0), \
+            (rounds[0], r0, train_err)
+        time.sleep(2.0)  # serve through the post-failover steady state
+        stop_read.set()
+        for t in readers:
+            t.join(timeout=20.0)
+        stop.set()
+        trainer.join(timeout=60.0)
+        assert not train_err, train_err
+        # staleness contract: EVERY successful read under the bound
+        assert metas
+        worst = max(m["staleness_s"] for m in metas)
+        assert worst <= bound, f"staleness bound violated: {worst}"
+        # version-lag assertion: reads after the failover reflect round
+        # progress beyond anything seen before the kill — the replicas
+        # kept tracking the promoted shard, they didn't freeze
+        post_rounds = max(m.get("rounds_at_refresh", 0) for m in metas)
+        assert post_rounds > pre_kill_rounds, (post_rounds,
+                                               pre_kill_rounds)
+        assert len(metas) > pre_kill_reads  # reads flowed post-kill
+        # replicas retargeted their subscription to the promoted standby
+        assert any(r.failover_events >= 1 for r in reps)
+    finally:
+        sim.shutdown()
