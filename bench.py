@@ -1545,6 +1545,62 @@ def child_obs():
     }))
 
 
+def child_flight():
+    """Flight-recorder overhead guard (ISSUE 9 satellite): round wall
+    with the DEFAULT-ON recorder vs GEOMX_FLIGHT=0 on the
+    flagship-shaped 2-party push/pull workload (the obs child's
+    harness).  The recorder taps every message head, so this is the
+    direct measurement of the <2% acceptance bound; the event count
+    proves the cheap run actually recorded."""
+    import numpy as np
+
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.kvstore import Simulation
+
+    # big enough that the round is compute/copy bound (~0.1 s) and the
+    # per-message tap cost shows as a stable percentage, not host noise
+    N = int(os.environ.get("BENCH_FLIGHT_ELEMS", "20000000"))
+
+    def run(flight: bool):
+        cfg = Config(topology=Topology(num_parties=2, workers_per_party=1),
+                     enable_flight=flight)
+        sim = Simulation(cfg)
+        try:
+            ws = sim.all_workers()
+            for w in ws:
+                w.init(0, np.zeros(N, np.float32))
+            ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+            g = np.ones(N, np.float32)
+
+            def one_round() -> float:
+                t0 = time.perf_counter()
+                for w in ws:
+                    w.push(0, g)
+                for w in ws:
+                    w.pull_sync(0)
+                    w.wait_all()
+                return time.perf_counter() - t0
+
+            one_round()  # cold: one-time costs
+            dt = min(one_round() for _ in range(4))
+            events = sum(po.flight._n for po in sim.offices.values()
+                         if po.flight is not None)
+            return dt, events
+        finally:
+            sim.shutdown()
+
+    base, base_events = run(False)
+    on_dt, events = run(True)
+    print(json.dumps({
+        "tensor_elems": N,
+        "round_wall_s_disabled": round(base, 4),
+        "round_wall_s_enabled": round(on_dt, 4),
+        "overhead_pct": round(100.0 * (on_dt - base) / max(base, 1e-9), 2),
+        "events_recorded": events,
+        "events_disabled": base_events,
+    }))
+
+
 def child_serve():
     """Read-serving replica tier (ISSUE 8): ``pulls_per_sec`` at 1/2/4
     replicas under CONCURRENT training — the serving tier's brand-new
@@ -2081,6 +2137,9 @@ def _compact(record: dict) -> dict:
     ob = record.get("obs") or {}
     if ob.get("overhead_pct") is not None:
         out["obs_overhead_pct"] = ob["overhead_pct"]
+    flt = record.get("flight") or {}
+    if flt.get("overhead_pct") is not None:
+        out["flight_overhead_pct"] = flt["overhead_pct"]
     sv = record.get("serve") or {}
     if sv.get("pulls_per_sec"):
         out["serve_pulls_per_sec"] = sv["pulls_per_sec"]
@@ -2239,7 +2298,7 @@ def main():
                     choices=["cnn", "mfu", "mfu_sweep", "quant", "wan",
                              "overlap", "overlap_tpu", "stress", "probe",
                              "flash_autotune", "lm", "scaling", "parity",
-                             "serde", "shards", "obs", "serve"])
+                             "serde", "shards", "obs", "flight", "serve"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -2265,7 +2324,7 @@ def main():
          "probe": child_probe, "lm": child_lm, "scaling": child_scaling,
          "parity": child_parity, "serde": child_serde,
          "shards": child_shards, "obs": child_obs,
-         "serve": child_serve,
+         "flight": child_flight, "serve": child_serve,
          "flash_autotune": child_flash_autotune}[args.child]()
         return
 
@@ -2366,6 +2425,7 @@ def main():
         _do("stress", 180, cpu_env)
         _do("shards", 240, cpu_env)
         _do("obs", 180, cpu_env)
+        _do("flight", 180, cpu_env)
         _do("serve", 210, cpu_env)
 
     cpu_thread = threading.Thread(target=cpu_chain, daemon=True)

@@ -497,6 +497,33 @@ class Config:
     obs_goodput_frac: float = 0.1   # goodput-collapse fraction of peak
     obs_fence_spike: int = 8        # fenced/evicted events per window
     obs_imbalance_factor: float = 4.0  # slowest-shard busy vs peer mean
+    obs_flight_cooldown_s: float = 60.0  # min seconds between flight-
+    #                                 dump broadcasts for ONE (rule,
+    #                                 subject): the first firing
+    #                                 captures the incident window; a
+    #                                 flapping warn rule must not flood
+    #                                 GEOMX_OBS_DIR with a dump per
+    #                                 transition.  0 = dump on every
+    #                                 firing transition (tests)
+    # --- black-box flight recorder (geomx_tpu/obs/flight.py).  DEFAULT
+    # ON: every node keeps a fixed-size ring of structured events
+    # (message heads, fences, barriers, membership/failover
+    # transitions, round open/complete, sampled pressure readings) in
+    # preallocated slots — no per-event allocation, <2% round-wall
+    # overhead (bench.py flight).  Rings dump to GEOMX_OBS_DIR on
+    # process exit/signal, on a HealthEngine alert transition
+    # (Control.FLIGHT_DUMP broadcast — every node snapshots the same
+    # incident window), and on operator request (python -m
+    # geomx_tpu.status --dump-flight); python -m geomx_tpu.obs.postmortem
+    # assembles the dumps into one causal timeline.  None = follow
+    # GEOMX_FLIGHT (default on); an explicit True/False wins over env.
+    # GEOMX_FLIGHT=0 constructs nothing anywhere.
+    enable_flight: Optional[bool] = None
+    flight_events: int = 4096       # ring capacity (events) per node
+    flight_sample_s: float = 0.0    # dedicated pressure-sampler thread
+    #                                 cadence; 0 (default) = sample on
+    #                                 the metrics-pump cadence and at
+    #                                 dump time only (no extra thread)
     # --- read-serving replica tier (geomx_tpu/serve; beyond the
     # reference, which is train-only).  Replicas (Topology.num_replicas /
     # GEOMX_SERVE_REPLICAS / launch.py --replicas) keep a full local copy
@@ -602,6 +629,18 @@ class Config:
             raise ValueError("adapt_window must be >= 2")
         if self.obs_interval_s < 0:
             raise ValueError("obs_interval_s must be >= 0 (0 = manual)")
+        # flight recorder: None = follow the env (default ON — the
+        # whole point is evidence for failures nobody predicted); an
+        # explicitly constructed True/False wins, so GEOMX_FLIGHT=0 can
+        # shake the suite without defeating the disabled-path tests
+        if self.enable_flight is None:
+            self.enable_flight = _env_bool("GEOMX_FLIGHT", True)
+        if self.flight_events < 8:
+            raise ValueError("flight_events must be >= 8 (the ring must "
+                             "hold a useful window)")
+        if self.flight_sample_s < 0:
+            raise ValueError("flight_sample_s must be >= 0 (0 = sample "
+                             "on the pump cadence / at dump time)")
         if self.obs_window < 8:
             raise ValueError("obs_window must be >= 8 (rate math needs "
                              "a real ring)")
@@ -738,6 +777,11 @@ class Config:
             obs_goodput_frac=_env_float("GEOMX_OBS_GOODPUT_FRAC", 0.1),
             obs_fence_spike=_env_int("GEOMX_OBS_FENCE_SPIKE", 8),
             obs_imbalance_factor=_env_float("GEOMX_OBS_IMBALANCE", 4.0),
+            obs_flight_cooldown_s=_env_float("GEOMX_OBS_FLIGHT_COOLDOWN",
+                                             60.0),
+            enable_flight=_env_bool("GEOMX_FLIGHT", True),
+            flight_events=_env_int("GEOMX_FLIGHT_EVENTS", 4096),
+            flight_sample_s=_env_float("GEOMX_FLIGHT_SAMPLE_S", 0.0),
             serve_staleness_s=_env_float("GEOMX_SERVE_STALENESS_S", 5.0),
             serve_refresh_interval_s=_env_float("GEOMX_SERVE_REFRESH_S",
                                                 0.5),

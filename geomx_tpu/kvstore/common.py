@@ -156,6 +156,14 @@ class ShardExecutor:
             ok = ev.wait(timeout) and ok
         return ok
 
+    def depth(self) -> int:
+        """Deepest lane backlog right now (0 inline) — the flight
+        recorder's ``lane_depth`` pressure reading: a lane that keeps a
+        standing queue is the merge hot spot the postmortem names."""
+        if self.inline:
+            return 0
+        return max(q.qsize() for q in self._qs)
+
     def stop(self):
         if not self.inline:
             for q in self._qs:
@@ -188,6 +196,19 @@ def codec_pool(config=None):
             _codec_pool = ThreadPoolExecutor(
                 max_workers=threads, thread_name_prefix="geomx-codec")
     return _codec_pool
+
+
+def codec_pool_depth() -> int:
+    """Queued-but-unstarted codec jobs in the shared pool (0 when no
+    pool was ever built) — the flight recorder's ``codec_pool_busy``
+    pressure reading.  Read-only: never constructs the pool."""
+    pool = _codec_pool
+    if pool is None:
+        return 0
+    try:
+        return pool._work_queue.qsize()
+    except AttributeError:  # executor internals moved (future python)
+        return 0
 
 
 class RecentRequests:
@@ -349,3 +370,11 @@ class Ctrl(enum.IntEnum):
     #                            holders/terms, party fold state, per-node
     #                            heartbeat freshness, WAN policy epoch,
     #                            active health alerts — geomx_tpu/obs/state)
+    FLIGHT_DUMP = 26           # operator request -> global scheduler
+    #                            (python -m geomx_tpu.status
+    #                            --dump-flight): snapshot every node's
+    #                            flight-recorder ring.  The scheduler
+    #                            relays it as a Control.FLIGHT_DUMP
+    #                            broadcast under one incident id and
+    #                            replies with the dump dir + expected
+    #                            per-node paths (geomx_tpu/obs/flight)

@@ -233,6 +233,11 @@ class WorkerEvictionMonitor(_HeartbeatActuator):
             # fired relative to the stalled round
             get_tracer(str(self.po.node)).instant(
                 "evict.worker", node=node_s, boot=boot)
+            if self.po.flight is not None:
+                from geomx_tpu.obs.flight import FlightEv
+
+                self.po.flight.record(FlightEv.EVICT, d=boot,
+                                      peer=node_s, note="worker_evict")
             print(f"{self.po.node}: evicted {node_s} (heartbeat expired, "
                   f"boot={boot}) — rounds and barriers fold to the "
                   "survivor set", flush=True)
@@ -323,6 +328,11 @@ class LocalServerRecoveryMonitor(_HeartbeatActuator):
         self._fold_counter.inc()
         get_tracer(str(self.po.node)).instant(
             "evict.party_fold", party=party, node=node_s)
+        if self.po.flight is not None:
+            from geomx_tpu.obs.flight import FlightEv
+
+            self.po.flight.record(FlightEv.FOLD, b=party, d=boot,
+                                  peer=node_s, note="party_fold")
         print(f"{self.po.node}: folded party {party} out of global "
               f"rounds ({node_s} heartbeat expired) — the WAN root "
               "continues on the survivor parties", flush=True)
@@ -358,6 +368,12 @@ class LocalServerRecoveryMonitor(_HeartbeatActuator):
         get_tracer(str(self.po.node)).instant(
             "recover.party_unfold", party=party,
             warm_booted_keys=int(reply.get("keys", 0)))
+        if self.po.flight is not None:
+            from geomx_tpu.obs.flight import FlightEv
+
+            self.po.flight.record(FlightEv.UNFOLD, b=party,
+                                  c=int(reply.get("keys", 0)),
+                                  peer=str(node), note="party_unfold")
         print(f"{self.po.node}: party {party} recovered — {node} "
               f"warm-booted {reply.get('keys', 0)} keys and folded back "
               "into global rounds", flush=True)

@@ -336,6 +336,11 @@ class GlobalFailoverMonitor:
         # failover lands on the merged trace timeline as a control event
         get_tracer(str(self.po.node)).instant(
             "failover.promoted", rank=rank, term=term, reason=reason)
+        if self.po.flight is not None:
+            from geomx_tpu.obs.flight import FlightEv
+
+            self.po.flight.record(FlightEv.PROMOTE, a=term, b=rank,
+                                  peer=standby, note="promote")
         print(f"{self.po.node}: promoted {standby} to primary of shard "
               f"{rank} (term={term}, {reason})", flush=True)
         self._broadcast_new_primary(rank, old=old, repeats=3)
@@ -409,6 +414,11 @@ class GlobalFailoverMonitor:
         get_tracer(str(self.po.node)).instant(
             "reassign.moved", rank=rank, term=term, old=str(old),
             new=str(target), reason=reason)
+        if self.po.flight is not None:
+            from geomx_tpu.obs.flight import FlightEv
+
+            self.po.flight.record(FlightEv.HANDOFF, a=term, b=rank,
+                                  peer=target, note="reassign")
         print(f"{self.po.node}: reassigned shard {rank} key range "
               f"{old} -> {target} (term={term}, "
               f"{reply.get('keys', 0)} keys, {reason})", flush=True)

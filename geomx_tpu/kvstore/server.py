@@ -38,8 +38,10 @@ import numpy as np
 from geomx_tpu.core.config import Config, Group, NodeId, Topology
 from geomx_tpu.kvstore.common import (APP_PS, Cmd, Ctrl, RecentRequests,
                                       ShardExecutor, StripedRLock,
-                                      codec_pool, resolve_server_shards)
+                                      codec_pool, codec_pool_depth,
+                                      resolve_server_shards)
 from geomx_tpu.native.bindings import accumulate as _native_accumulate
+from geomx_tpu.obs.flight import FlightEv, attach_server_pressure
 from geomx_tpu.optim import DCASGD, ServerOptimizer, Sgd, make_optimizer
 from geomx_tpu.ps import KVPairs, KVServer, KVWorker, Postoffice
 from geomx_tpu.ps.postoffice import split_range
@@ -154,7 +156,7 @@ class _KeyState:
 
     __slots__ = ("accum", "count", "parked_pulls", "in_flight", "version",
                  "round", "row_sparse", "epoch", "priority", "expected",
-                 "completing", "contributors", "hfa_inv", "pushers")
+                 "completing", "contributors", "hfa_inv")
 
     def __init__(self):
         self.accum: Optional[np.ndarray] = None
@@ -203,17 +205,6 @@ class _KeyState:
         #                          would otherwise shrink the weights by
         #                          c/n — catastrophic for weights, unlike
         #                          a scaled gradient)
-        self.pushers: set = set()  # senders that EVER pushed this key
-        #                          (historical, unlike contributors which
-        #                          resets per round).  Distinguishes a
-        #                          bootstrapping joiner (never pushed —
-        #                          serve-stale is the only deadlock-free
-        #                          answer) from an established member
-        #                          whose contribution rode a TS-merged
-        #                          push (num_merge>1): the latter is owed
-        #                          the OPEN round's weights, so serving
-        #                          it stale mid-merge would silently
-        #                          diverge party replicas (advisor r5)
         self.completing = False  # round completion DECIDED but the
         #                          accumulator not yet taken.  Set under
         #                          _mu at the decision point; both
@@ -257,6 +248,16 @@ class LocalServer:
         self._members: Dict[str, int] = {
             str(w): w.rank
             for w in topo.workers(postoffice.node.party)}
+        # out-of-plan joiners that have not yet pushed ANYTHING: their
+        # bootstrap pulls mid-partial-merge are served from the last
+        # completed round (parking them behind a round that may need
+        # their own push is the advisor-r4 deadlock).  Every OTHER
+        # member — plan workers included, whether or not they ever
+        # pushed this key directly (under the TS push overlay
+        # non-elected workers never do) — PARKS during a TS-merged
+        # partial round instead of reading stale (advisor r5, round-5
+        # refinement).  GIL-atomic set ops; cleared on first push.
+        self._bootstrapping: set = set()
         self.joined_workers = 0  # observability
         self.left_workers = 0
         # heartbeat-driven eviction (kvstore/eviction.py): members the
@@ -292,6 +293,10 @@ class LocalServer:
 
         self._prof = get_profiler(str(postoffice.node))
         self._tr = get_tracer(str(postoffice.node))
+        # flight recorder (obs/flight.py): fence/fold/round events +
+        # this server's merge-pressure sources; None when disabled
+        self._flight = postoffice.flight
+        attach_server_pressure(self._flight, self._mu, self._shards)
         self._recent = RecentRequests()  # replayed-push dedup
         self.server = KVServer(APP_PS, 0, postoffice, self._handle)
         self.server.cmd_handler = self._on_cmd
@@ -550,6 +555,10 @@ class LocalServer:
                 total = self._workers_target
                 seq = self._membership_seq
                 self.joined_workers += 1
+                # until its first push lands, this joiner's pulls are
+                # BOOTSTRAP pulls: served from the last completed round
+                # even mid-partial-merge (see _try_serve_pull)
+                self._bootstrapping.add(node_s)
                 # mid-flight rounds must ALSO wait for the joiner: its
                 # first pushes land in whatever round is open, and with
                 # the old target a static worker's push would complete
@@ -602,6 +611,10 @@ class LocalServer:
             return False
         del self._members[node_s]
         self._member_addrs.pop(node_s, None)
+        self._bootstrapping.discard(node_s)
+        if self._flight is not None:
+            self._flight.record(FlightEv.FOLD, peer=node_s,
+                                note="member_fold")
         self._workers_target = max(1, self._workers_target - 1)
         self._membership_seq += 1
         completed = []
@@ -676,6 +689,9 @@ class LocalServer:
         from geomx_tpu.utils.metrics import system_counter
 
         system_counter(f"{self.po.node}.eviction_fenced_pushes").inc()
+        if self._flight is not None:
+            self._flight.record(FlightEv.FENCE, d=boot, peer=sender_s,
+                                note="evicted_push")
         err = {"error": f"evicted: {sender_s} was declared dead "
                         f"(boot={boot}) and folded out of the "
                         "aggregation group; rejoin via join_party for a "
@@ -767,6 +783,8 @@ class LocalServer:
         from geomx_tpu.utils.metrics import system_counter
 
         system_counter(f"{self.po.node}.warm_boots").inc()
+        if self._flight is not None:
+            self._flight.record(FlightEv.WARM_BOOT, a=len(got))
         # re-sync the party's 1/num_workers pre-scale and membership (a
         # replacement process restarted the count at the static plan)
         self._broadcast_membership()
@@ -796,6 +814,9 @@ class LocalServer:
         from geomx_tpu.utils.metrics import system_counter
 
         system_counter(f"{self.po.node}.failover_events").inc()
+        if self._flight is not None:
+            self._flight.record(FlightEv.PROMOTE, a=term, c=replayed,
+                                peer=b.get("new"), note="retarget")
         print(f"{self.po.node}: global shard {rank} failed over to "
               f"{b['new']} (term={term}, replayed={replayed} requests)",
               flush=True)
@@ -848,6 +869,9 @@ class LocalServer:
         sender_s = str(msg.sender)
         if self._fence_evicted_push(msg, sender_s):
             return  # evicted identity: rejected, told to rejoin
+        # first push from a dynamic joiner: it is established now — its
+        # later pulls park during partial merges like everyone else's
+        self._bootstrapping.discard(sender_s)
         # a TS-merged push carries several workers' contributions at once
         # (ref: num_merge counting van.cc:1197-1252)
         num_merge = 1
@@ -881,7 +905,6 @@ class LocalServer:
             with self._mu.stripe(k):
                 st = self._keys.setdefault(k, _KeyState())
                 st.contributors.add(sender_s)
-                st.pushers.add(sender_s)
                 if hfa_n:
                     st.hfa_inv += num_merge / hfa_n
                 if st.accum is None:
@@ -985,6 +1008,7 @@ class LocalServer:
         row_ids, rows = unpack_rows(kvs.vals, cols)
         key = int(kvs.keys[0])
         sender_s = str(msg.sender)
+        self._bootstrapping.discard(sender_s)
         self._saw_row_sparse = True
 
         # rides the key's merge lane like every other mutation of this
@@ -1009,7 +1033,6 @@ class LocalServer:
             with self._mu.stripe(key):
                 st = self._keys.setdefault(key, _KeyState())
                 st.contributors.add(sender_s)
-                st.pushers.add(sender_s)
                 if st.accum is None:
                     st.accum = np.zeros_like(self.store[key],
                                              dtype=np.float32)
@@ -1200,6 +1223,12 @@ class LocalServer:
             raw = {int(k): np.array(v, copy=True) for k, v in kvs.slices()}
         with self._ctr_mu:  # rounds of disjoint keys dispatch from
             self.wan_push_rounds += 1  # parallel lanes
+            wan_round = self.wan_push_rounds
+        if self._flight is not None:
+            # the WAN round boundary: the stall forensic's "this party
+            # pushed up and is now owed a pull-down"
+            self._flight.record(FlightEv.ROUND_OPEN, a=wan_round,
+                                c=len(keys), note="wan_push")
 
         with self._mu:
             epochs = {k: self._keys[k].epoch for k in keys
@@ -1643,6 +1672,9 @@ class LocalServer:
                 st.parked_pulls.clear()
         for req in to_retry:
             self._try_serve_pull(req)
+        if self._flight is not None:
+            self._flight.record(FlightEv.ROUND_COMPLETE, a=len(keys),
+                                b=self.wan_push_rounds, note="local")
         if self.ts_client is not None:
             # hand fresh weights to the overlay dissemination thread;
             # the per-key astype copies happen under the stripe so a
@@ -1696,22 +1728,25 @@ class LocalServer:
                 # and a worker lagging a round behind wants exactly the
                 # store's weights, not the open round's future ones.
                 # EXCEPT during a TS-MERGED round (count > distinct senders:
-                # some push carried num_merge>1): an established member's
+                # some push carried num_merge>1): a KNOWN PARTY MEMBER's
                 # contribution may be inside the open accumulator even
-                # though it never pushed directly, so serving it stale would
-                # silently diverge party replicas — park it; the round
-                # completes without its direct push by construction (its
-                # contribution already rode the merge tree).  Serve-stale
-                # stays for senders with no push history on this key (a
-                # bootstrapping joiner — parking those is the r4 deadlock)
-                # and for plain rounds (count == distinct senders), where
-                # the open round still NEEDS this sender's own push
-                # (advisor r5).
+                # though it never pushed directly — under the TS push
+                # overlay non-elected workers NEVER push directly, so any
+                # push-history test would serve them stale forever
+                # (advisor r5, round-5 refinement) and party replicas
+                # would silently diverge for every partial-merge window.
+                # Members park; the round completes without their direct
+                # push by construction (their contribution rode the
+                # merge tree).  Serve-stale stays for out-of-plan
+                # BOOTSTRAP pulls — a joiner that has not pushed anything
+                # yet (parking those is the r4 deadlock) — and for plain
+                # rounds (count == distinct senders), where the open
+                # round still NEEDS this sender's own push.
                 blocked = (k not in self.store or st.in_flight > 0
                            or (st.count > 0 and sender_s in st.contributors))
                 if (not blocked and st.count > len(st.contributors)
                         and sender_s in self._members
-                        and sender_s in st.pushers):
+                        and sender_s not in self._bootstrapping):
                     blocked = True
                 if blocked:
                     st.parked_pulls.append(req)
@@ -2060,6 +2095,10 @@ class GlobalServer:
 
         self._prof = get_profiler(str(postoffice.node))
         self._tr = get_tracer(str(postoffice.node))
+        # flight recorder (obs/flight.py): fence/promotion/round events
+        # + this shard's merge-pressure sources; None when disabled
+        self._flight = postoffice.flight
+        attach_server_pressure(self._flight, self._mu, self._shards)
         # inter-party TSEngine: after a sync round updates, disseminate
         # the fresh weights to the local servers via the WAN overlay
         # instead of serving N pulls (sync tier only)
@@ -2227,6 +2266,11 @@ class GlobalServer:
             from geomx_tpu.utils.metrics import system_counter
 
             system_counter(f"{self.po.node}.{action}s").inc()
+            if self._flight is not None:
+                self._flight.record(
+                    FlightEv.FOLD if action == "party_fold"
+                    else FlightEv.UNFOLD, c=total, peer=node_s,
+                    note=action)
             print(f"{self.po.node}: {action} {node_s} "
                   f"(num_global_workers={total})", flush=True)
             if action == "party_fold":
@@ -2370,6 +2414,10 @@ class GlobalServer:
             from geomx_tpu.utils.metrics import system_counter
 
             system_counter(f"{self.po.node}.rejected_compr_tags").inc()
+            if self._flight is not None:
+                self._flight.record(FlightEv.FENCE, b=msg.policy_epoch,
+                                    d=msg.boot, peer=msg.sender,
+                                    note="bad_compr_tag")
             self.server.response(msg, body={
                 "error": f"unknown compression tag '{msg.compr}' in push "
                          f"from {msg.sender} (policy epoch "
@@ -2385,6 +2433,10 @@ class GlobalServer:
             with self._mu:
                 cur_epoch = self._policy_epoch
                 cur_policy = dict(self.compression)
+            if self._flight is not None:
+                self._flight.record(FlightEv.FENCE, a=msg.policy_epoch,
+                                    b=cur_epoch, d=msg.boot,
+                                    peer=msg.sender, note="policy_epoch")
             self.server.response(msg, body={
                 "error": f"policy epoch fenced: push from {msg.sender} "
                          f"carries epoch {msg.policy_epoch}, server is "
@@ -2475,10 +2527,12 @@ class GlobalServer:
             k_acks: List[tuple] = []
             k_reparks: List[Message] = []
             completed = False
+            opened = False
             with self._mu.stripe(k):
                 st = self._keys.setdefault(k, _GlobalKeyState())
                 if st.accum is None:
                     st.accum = _adopt_or_copy(v, msg.donated)
+                    opened = True
                 else:
                     # native threaded merge for big tensors (the server
                     # hot loop; ref: kvstore_dist_server.h:1277-1296)
@@ -2491,6 +2545,11 @@ class GlobalServer:
                     completed = True
                     self._complete_key_locked(k, hfa_delta, k_acks,
                                               k_reparks)
+            if opened and self._flight is not None:
+                # a fresh aggregation round opened for this key — the
+                # stall forensic's "who was the round waiting on"
+                self._flight.record(FlightEv.ROUND_OPEN, a=k,
+                                    peer=msg.sender, note="global")
             with done_mu:
                 acks.extend(k_acks)
                 reparks.extend(k_reparks)
@@ -2563,6 +2622,10 @@ class GlobalServer:
         for m in reparks:
             self._park_pull(m)
         self.key_rounds += len(completed_keys)  # GIL-atomic int add
+        if completed_keys and self._flight is not None:
+            self._flight.record(FlightEv.ROUND_COMPLETE,
+                                a=len(completed_keys), b=self.key_rounds,
+                                note="global")
         dissem = None
         if completed_keys and (
                 self._repl is not None or self.ts_inter is not None
@@ -2593,6 +2656,10 @@ class GlobalServer:
             self._park_pull(m)
         if completed:
             self.key_rounds += len(completed)
+            if self._flight is not None:
+                self._flight.record(FlightEv.ROUND_COMPLETE,
+                                    a=len(completed), b=self.key_rounds,
+                                    note="fold")
             self._auto_ckpt_locked(len(completed))
             if self._repl is not None:
                 self._repl.mark_locked(len(completed))
@@ -2664,6 +2731,10 @@ class GlobalServer:
                     self.store[k] = self.optimizer.update_scaled(
                         k, self.store[k], grad, 1.0)
             self.key_rounds += len(kvs.keys)
+            if self._flight is not None:
+                self._flight.record(FlightEv.ROUND_COMPLETE,
+                                    a=len(kvs.keys), b=self.key_rounds,
+                                    note="async")
             self._auto_ckpt_locked(len(kvs.keys))
             if self._repl is not None:
                 self._repl.mark_locked(len(kvs.keys))
@@ -3097,6 +3168,10 @@ class GlobalServer:
                 system_counter(f"{self.po.node}.drains").inc()
                 self._tr.instant("reassign.drained", term=term,
                                  target=str(target), keys=nkeys)
+                if self._flight is not None:
+                    self._flight.record(FlightEv.HANDOFF, a=term,
+                                        c=nkeys, peer=target,
+                                        note="drained")
                 self._fence(f"key range drained to {target}", term)
             else:
                 # aborted ship: the range is still ours — resume serving
@@ -3152,6 +3227,10 @@ class GlobalServer:
 
                 system_counter(
                     f"{self.po.node}.replication_fenced_rejects").inc()
+                if self._flight is not None:
+                    self._flight.record(FlightEv.FENCE, a=term, b=self.term,
+                                        peer=msg.sender,
+                                        note="stale_repl_term")
                 err = {"error": f"fenced: stale replication term {term} < "
                                 f"{self.term}", "term": self.term}
             elif handoff and kvs is not None:
@@ -3209,6 +3288,11 @@ class GlobalServer:
                 from geomx_tpu.utils.metrics import system_counter
 
                 system_counter(f"{self.po.node}.promotions").inc()
+                if self._flight is not None:
+                    self._flight.record(FlightEv.PROMOTE, a=term,
+                                        c=len(self.store),
+                                        peer=self.po.node,
+                                        note="promoted")
                 print(f"{self.po.node}: promoted to primary "
                       f"(term={term}, keys={len(self.store)}, "
                       f"repl_seq={self._repl_seq})", flush=True)
@@ -3253,6 +3337,9 @@ class GlobalServer:
         from geomx_tpu.utils.metrics import system_counter
 
         system_counter(f"{self.po.node}.fenced").inc()
+        if self._flight is not None:
+            self._flight.record(FlightEv.FENCE, a=self.term,
+                                peer=self.po.node, note="deposed")
         print(f"{self.po.node}: fenced — {reason} (term={self.term})",
               flush=True)
 

@@ -269,6 +269,24 @@ class Simulation:
                     break
         return self.metrics_collector.reports_received
 
+    def dump_flight(self, out_dir: str,
+                    incident: Optional[str] = None) -> List[str]:
+        """Snapshot every LIVE node's flight-recorder ring to
+        ``out_dir`` (killed nodes' vans are dead, so — like a real
+        SIGKILL — they leave no dump; the postmortem assembler treats
+        that absence as the finding).  ``incident=None`` is the
+        exit-style dump (repeatable, overwrites); a named incident
+        dumps at most once per node.  Returns the written paths."""
+        paths = []
+        for po in self.offices.values():
+            fl = po.flight
+            if fl is None or po.van.killed or not po._started:
+                continue
+            p = fl.dump(out_dir, incident=incident)
+            if p:
+                paths.append(p)
+        return paths
+
     def cluster_state(self) -> dict:
         """The merged live cluster state (same composition the
         Ctrl.CLUSTER_STATE wire query and ``python -m geomx_tpu.status``

@@ -795,6 +795,13 @@ def main(argv=None):
         advertise = (host or "127.0.0.1", int(port))
     po, role_obj, stop_ev = build_runtime(node, cfg, args.base_port,
                                           advertise=advertise)
+    # black-box flight recorder crash/exit trigger: dump this node's
+    # ring to GEOMX_OBS_DIR at interpreter exit and on SIGTERM/SIGINT
+    # (SIGKILL leaves no dump — the postmortem assembler infers the
+    # victim from the survivors' rings; docs/observability.md)
+    from geomx_tpu.obs.flight import install_process_hooks
+
+    install_process_hooks(po)
     print(f"{node}: up", flush=True)
     if node.role is Role.WORKER:
         if args.workload == "lm":
@@ -872,6 +879,10 @@ def main(argv=None):
                      f"left={role_obj.left_workers}")
     if po.van.pq_overtakes:
         feats.append(f"pq_overtakes={po.van.pq_overtakes}")
+    if po.flight is not None and po.flight.dumps:
+        # flight-recorder observable: incident/operator dumps taken
+        # during the run (the atexit dump lands after this line)
+        feats.append(f"flight_dumps={po.flight.dumps}")
     # global-tier failover observables (replication stream, promotions,
     # term fencing, client-side retarget+replay)
     for attr, tag in (("failover_events", "failover_events"),

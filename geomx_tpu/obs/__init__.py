@@ -17,20 +17,33 @@ is per-process profiler dumps):
 - **cluster-state console** — :class:`ClusterStateService` answers
   ``Ctrl.CLUSTER_STATE`` with the merged live state (shard
   holders/terms, party folds, heartbeat freshness, policy epoch,
-  active alerts), rendered by ``python -m geomx_tpu.status`` and
-  ``Simulation.cluster_state()``.
+  active alerts, pressure column), rendered by ``python -m
+  geomx_tpu.status`` and ``Simulation.cluster_state()``;
+- **black-box flight recorder** — :class:`FlightRecorder`
+  (obs/flight.py, DEFAULT ON) keeps a fixed-size per-node event ring
+  (message heads, fences, barriers, membership/failover transitions,
+  round open/complete, sampled pressure) dumped to ``GEOMX_OBS_DIR``
+  on exit/signal, health-alert incidents (``Control.FLIGHT_DUMP``
+  broadcast) and operator request; ``python -m
+  geomx_tpu.obs.postmortem`` assembles the dumps into one
+  clock-rebased causal timeline + stall report.
 
-Off by default (``Config.enable_obs = False``): no pump, no collector,
-no threads, no frames — the disabled path is one flag check at
-construction time.  See docs/observability.md.
+The pump/collector/health plane is off by default
+(``Config.enable_obs = False``): no pump, no collector, no threads, no
+frames — the disabled path is one flag check at construction time.
+See docs/observability.md.
 """
 
 from geomx_tpu.obs.collector import MetricsCollector
 from geomx_tpu.obs.endpoint import TelemetryEndpoint, get_endpoint
+from geomx_tpu.obs.flight import (FlightEv, FlightRecorder,
+                                  broadcast_flight_dump,
+                                  install_process_hooks)
 from geomx_tpu.obs.health import HealthEngine
 from geomx_tpu.obs.pump import MetricsPump
 from geomx_tpu.obs.state import ClusterStateService, render_text
 
-__all__ = ["ClusterStateService", "HealthEngine", "MetricsCollector",
-           "MetricsPump", "TelemetryEndpoint", "get_endpoint",
-           "render_text"]
+__all__ = ["ClusterStateService", "FlightEv", "FlightRecorder",
+           "HealthEngine", "MetricsCollector", "MetricsPump",
+           "TelemetryEndpoint", "broadcast_flight_dump", "get_endpoint",
+           "install_process_hooks", "render_text"]

@@ -112,6 +112,17 @@ class HealthEngine:
             f"{self.node}.health_{r}_alerts") for r in RULES}
         self._stop = threading.Event()
         self._thread = None
+        # flight-recorder incident trigger: each FIRING transition
+        # broadcasts Control.FLIGHT_DUMP so EVERY node snapshots the
+        # same incident window (obs/flight.py); the counter keys the
+        # incident ids so two transitions never collide on one file.
+        # Per-(rule, subject) cooldown: the FIRST firing captures the
+        # evidence; a flapping rule re-firing inside the window must
+        # not flood the dump dir with near-identical snapshots
+        self._flight_incidents = 0
+        self._flight_last: Dict[Tuple[str, str], float] = {}
+        self._flight_cooldown = float(
+            getattr(cfg, "obs_flight_cooldown_s", 60.0))
         if getattr(cfg, "obs_interval_s", 0) > 0:
             self._thread = threading.Thread(
                 target=self._run, daemon=True,
@@ -180,6 +191,13 @@ class HealthEngine:
 
     def _emit(self, rec: dict) -> None:
         firing = rec["state"] == "firing"
+        if firing:
+            # snapshot the incident window BEFORE anything else: every
+            # node's flight ring dumps under one incident id, and the
+            # alert record carries the dump paths (obs/flight.py)
+            flight = self._request_flight_dump(rec)
+            if flight is not None:
+                rec.setdefault("data", {})["flight"] = flight
         with self._mu:
             self.alerts.append(rec)
             del self.alerts[:-self._cap]
@@ -201,6 +219,44 @@ class HealthEngine:
                     f.write(json.dumps(rec, allow_nan=False) + "\n")
             except (OSError, ValueError):
                 pass  # the log is best-effort; registry/stdout remain
+
+    def _request_flight_dump(self, rec: dict) -> Optional[dict]:
+        """Broadcast ``Control.FLIGHT_DUMP`` for one firing transition:
+        exactly one incident id per transition, so every node dumps
+        exactly once per alert (the per-node recorders dedup
+        rebroadcasts by the id).  Returns the info dict the alert
+        record carries (None when the recorder plane or GEOMX_OBS_DIR
+        is off)."""
+        import os
+
+        po = self.collector.po
+        if getattr(po, "flight", None) is None:
+            return None
+        out_dir = os.environ.get("GEOMX_OBS_DIR", "")
+        if not out_dir:
+            return None
+        from geomx_tpu.obs.flight import broadcast_flight_dump
+
+        key = (rec["rule"], rec["subject"])
+        now = rec["t_mono"]
+        with self._mu:
+            last = self._flight_last.get(key)
+            if (last is not None and self._flight_cooldown > 0
+                    and now - last < self._flight_cooldown):
+                return None  # flapping: the first firing has the window
+            self._flight_last[key] = now
+            self._flight_incidents += 1
+            n = self._flight_incidents
+        subject = "".join(c if c.isalnum() else "_"
+                          for c in str(rec["subject"]))
+        incident = f"{rec['rule']}-{subject}-{n}"
+        try:
+            paths = broadcast_flight_dump(po, out_dir, incident,
+                                          rule=rec["rule"],
+                                          subject=rec["subject"])
+        except Exception:  # the dump trigger must never mute the alert
+            return None
+        return {"incident": incident, "dir": out_dir, "paths": paths}
 
     # ---- rules --------------------------------------------------------------
     def _rule_round_stall(self, now: float) -> List[dict]:

@@ -83,6 +83,14 @@ class MetricsPump:
         from geomx_tpu.core.config import Role
 
         now = time.monotonic()
+        fl = getattr(self.po, "flight", None)
+        if fl is not None:
+            # refresh the flight recorder's pressure gauges (lock wait /
+            # lane depth / send-queue depth / codec backlog) so the
+            # registry slice below ships current readings — the pump IS
+            # the recorder's periodic sampler when no dedicated
+            # flight_sample_s thread runs
+            fl.sample_pressure()
         metrics = system_snapshot(prefix=f"{self.node}.", skip_unset=True)
         if self.po.node.role is Role.GLOBAL_SCHEDULER:
             metrics.update(system_snapshot(prefix="global_shard",

@@ -45,27 +45,78 @@ class ClusterStateService:
         self.collector = collector
         self.health = health
         self.queries_served = 0
+        self.flight_requests = 0
         self._endpoint = get_endpoint(postoffice).acquire()
         self._endpoint.route(Ctrl.CLUSTER_STATE, self._on_query)
+        # operator flight-dump trigger (python -m geomx_tpu.status
+        # --dump-flight): relayed as a Control.FLIGHT_DUMP broadcast so
+        # every node snapshots its ring under one incident id
+        self._endpoint.route(Ctrl.FLIGHT_DUMP, self._on_flight_dump)
 
     # ---- wire query ---------------------------------------------------------
     def _on_query(self, msg):
+        # out-of-plan querier (the status CLI): install its reply
+        # address like a dynamic joiner's, so the response can dial
+        self._install_reply_addr(msg)
+        self.queries_served += 1
+        try:
+            self.po.van.send(msg.reply_to(body=self.compose()))
+        except (KeyError, OSError):
+            pass  # querier vanished between ask and answer
+
+    def _install_reply_addr(self, msg):
         body = msg.body if isinstance(msg.body, dict) else {}
         addr = body.get("addr")
         if addr:
-            # out-of-plan querier (the status CLI): install its reply
-            # address like a dynamic joiner's, so the response can dial
             add = getattr(self.po.van.fabric, "add_address", None)
             if add is not None:
                 try:
                     add(str(msg.sender), (str(addr[0]), int(addr[1])))
                 except (TypeError, ValueError, IndexError):
                     pass
-        self.queries_served += 1
+
+    def _on_flight_dump(self, msg):
+        """Ctrl.FLIGHT_DUMP from the status console: broadcast the ring
+        snapshot to every node and answer with the dump dir + expected
+        per-node paths."""
+        import os
+
+        self._install_reply_addr(msg)
+        body = msg.body if isinstance(msg.body, dict) else {}
+        out_dir = str(body.get("dir")
+                      or os.environ.get("GEOMX_OBS_DIR", ""))
+        if not out_dir:
+            reply = {"ok": False,
+                     "error": "no dump directory: set GEOMX_OBS_DIR on "
+                              "the cluster or pass --flight-dir"}
+        else:
+            from geomx_tpu.obs.flight import broadcast_flight_dump
+
+            self.flight_requests += 1
+            incident = f"operator-{self.flight_requests}"
+            paths = broadcast_flight_dump(self.po, out_dir, incident,
+                                          reason="operator request")
+            reply = {"ok": True, "dir": out_dir, "incident": incident,
+                     "nodes": len(paths), "paths": paths}
         try:
-            self.po.van.send(msg.reply_to(body=self.compose()))
+            self.po.van.send(msg.reply_to(body=reply))
         except (KeyError, OSError):
             pass  # querier vanished between ask and answer
+
+    def _pressure_of(self, node: str) -> dict:
+        """The node's freshest flight-recorder pressure gauges (shipped
+        through the metrics pump; docs/metrics.md) — the status
+        console's pressure column."""
+        from geomx_tpu.obs.flight import PRESSURE_GAUGES
+
+        out = {}
+        if self.collector is None:
+            return out
+        for key in PRESSURE_GAUGES:
+            v = self.collector.value(node, key)
+            if isinstance(v, (int, float)):
+                out[key] = round(float(v), 6)
+        return out
 
     # ---- composition --------------------------------------------------------
     def compose(self) -> dict:
@@ -120,6 +171,9 @@ class ClusterStateService:
                             "num_global_workers", "key_rounds"):
                     if key in st:
                         entry[key] = st[key]
+                press = self._pressure_of(holder)
+                if press:
+                    entry["pressure"] = press
             shards[k] = entry
 
         rm = self.recovery_monitor
@@ -135,6 +189,9 @@ class ClusterStateService:
                 for key in ("wan_push_rounds", "policy_epoch", "uptime_s"):
                     if key in st:
                         entry[key] = st[key]
+                press = self._pressure_of(server)
+                if press:
+                    entry["pressure"] = press
             parties[p] = entry
 
         # serve replicas (geomx_tpu/serve): per-replica staleness / QPS
@@ -235,6 +292,23 @@ def _alive_tag(alive) -> str:
     return "up" if alive else "DOWN"
 
 
+def _press_tag(entry: dict) -> str:
+    """Compact pressure column for one console row: merge-lock wait,
+    lane/send-queue depth, codec backlog (absent gauges are omitted)."""
+    p = entry.get("pressure") or {}
+    if not p:
+        return ""
+    bits = []
+    if "lock_wait_s" in p:
+        bits.append(f"lock={p['lock_wait_s'] * 1e3:.1f}ms")
+    for key, short in (("lane_depth", "lane"),
+                       ("van_sendq_depth", "sq"),
+                       ("codec_pool_busy", "codec")):
+        if key in p:
+            bits.append(f"{short}={int(p[key])}")
+    return " press[" + " ".join(bits) + "]" if bits else ""
+
+
 def render_text(state: dict) -> str:
     """The operator dashboard: one screen of text for
     ``python -m geomx_tpu.status`` and the demo scripts."""
@@ -264,7 +338,7 @@ def render_text(state: dict) -> str:
         lines.append(
             f"  shard {k}: holder={s.get('holder')} term={s.get('term')} "
             f"[{_alive_tag(s.get('alive'))}]"
-            f" standby={s.get('standby') or '-'}{extra}")
+            f" standby={s.get('standby') or '-'}{extra}{_press_tag(s)}")
     lines.append("parties:")
     parties = state.get("parties", {})
     for p in sorted(parties, key=int):
@@ -273,7 +347,7 @@ def render_text(state: dict) -> str:
         if e.get("wan_push_rounds") is not None:
             extra += f" wan_rounds={int(e['wan_push_rounds'])}"
         lines.append(f"  p{p}: {e.get('server')} "
-                     f"[{_alive_tag(e.get('alive'))}]{extra}")
+                     f"[{_alive_tag(e.get('alive'))}]{extra}{_press_tag(e)}")
     replicas = state.get("replicas") or {}
     if replicas:
         lines.append("replicas:")

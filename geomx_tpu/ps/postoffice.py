@@ -20,6 +20,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from geomx_tpu.core.config import Config, Group, NodeId, Role, Topology
+from geomx_tpu.obs.flight import FlightEv
 from geomx_tpu.trace import context as _tctx
 from geomx_tpu.transport.message import Control, Domain, Message
 from geomx_tpu.transport.van import InProcFabric, Van
@@ -77,6 +78,7 @@ class Postoffice:
             config=self.config,
             use_priority_queue=self.config.enable_p3,
         )
+        self.flight = None  # black-box recorder, built below
         self._customers: Dict[Tuple[int, int], "Customer"] = {}
         self._app_owner: Dict[int, "Customer"] = {}
         self._control_hooks: List[Callable[[Message], bool]] = []
@@ -113,6 +115,22 @@ class Postoffice:
         # degrades to the survivor set instead of timing out
         self._excluded: set = set()
         self._started = False
+        # black-box flight recorder (geomx_tpu/obs/flight): DEFAULT ON —
+        # a fixed-size per-node event ring tapped by the van (message
+        # heads, dedup), this postoffice (barriers), and the server /
+        # monitor roles (fences, folds, promotions, rounds); dumps to
+        # GEOMX_OBS_DIR on exit / health alert / operator request.
+        # Disabled (GEOMX_FLIGHT=0): nothing constructed, every tap is
+        # one attribute check.
+        if getattr(self.config, "enable_flight", True):
+            from geomx_tpu.obs.flight import FlightRecorder
+
+            self.flight = FlightRecorder(str(node), self.config,
+                                         postoffice=self)
+            self.van.flight = self.flight
+            self.add_control_hook(self.flight.on_control)
+            self.flight.add_pressure("van_sendq_depth",
+                                     self.van._pq.qsize)
 
     # ---- lifecycle ----------------------------------------------------------
     def start(self):
@@ -138,6 +156,8 @@ class Postoffice:
                 self._hb_thread = None
             self.van.stop()
             self._started = False
+        if self.flight is not None:
+            self.flight.stop()
 
     # ---- registry -----------------------------------------------------------
     def register_customer(self, customer: "Customer", owns_app: bool = False):
@@ -423,6 +443,10 @@ class Postoffice:
             recipient=sched, control=Control.BARRIER, domain=domain, request=True,
             body={"group": group.value, "party": party, "seq": seq},
         )
+        fl = self.flight
+        if fl is not None:
+            fl.record(FlightEv.BARRIER_ENTER, a=group.value, b=seq,
+                      peer=sched)
         if _tctx.ACTIVE and _tctx.current() is not None:
             # barrier waits inside a sampled round are a first-class
             # critical-path stage (FSA stalls ARE barrier time)
@@ -442,6 +466,10 @@ class Postoffice:
                 ok = self._barrier_cv.wait_for(
                     lambda: self._barrier_done.pop(seq, False),
                     timeout=timeout)
+        if fl is not None:
+            fl.record(FlightEv.BARRIER_RELEASE if ok
+                      else FlightEv.BARRIER_TIMEOUT,
+                      a=group.value, b=seq, peer=sched)
         if not ok:
             # diagnosable stall: ask the scheduler who is dead and who
             # never entered this token, so the exception alone names the
@@ -478,6 +506,10 @@ class Postoffice:
                 waiting = self._barrier_waiting[token]
                 if len(waiting) >= len(self._alive_members_locked(token)):
                     to_release.extend(self._barrier_waiting.pop(token))
+        if to_release and self.flight is not None:
+            self.flight.record(FlightEv.BARRIER_RELEASE,
+                               c=len(to_release), peer=node_s,
+                               note="eviction_release")
         for req in to_release:
             self.van.send(req.reply_to(body={"seq": req.body["seq"]}))
 
@@ -505,13 +537,23 @@ class Postoffice:
             group = Group(msg.body["group"])
             party = msg.body["party"]
             token = f"{group.value}@{party}"
+            fl = self.flight
             with self._lock:
                 alive = self._alive_members_locked(token)
                 waiting = self._barrier_waiting.setdefault(token, [])
                 waiting.append(msg)
-                if len(waiting) < len(alive):
+                entered, quorum = len(waiting), len(alive)
+                if entered < quorum:
+                    if fl is not None:
+                        # the scheduler's view is the forensic one: who
+                        # entered, and how many the token still waits on
+                        fl.record(FlightEv.BARRIER_ENTER, a=group.value,
+                                  b=entered, c=quorum, peer=msg.sender)
                     return
                 released = self._barrier_waiting.pop(token)
+            if fl is not None:
+                fl.record(FlightEv.BARRIER_RELEASE, a=group.value,
+                          c=len(released), peer=msg.sender)
             for req in released:
                 self.van.send(req.reply_to(body={"seq": req.body["seq"]}))
         else:

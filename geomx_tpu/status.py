@@ -4,8 +4,12 @@ Joins the deployment's TCP plan as an OUT-OF-PLAN querier (its reply
 address travels in the request body, like a dynamic joiner's), asks the
 global scheduler for ``Ctrl.CLUSTER_STATE``, and renders the live text
 dashboard — shard holders/terms, party fold state, per-node heartbeat
-freshness, WAN policy epoch, active health alerts.  ``--watch`` redraws
-on an interval until interrupted.
+freshness, WAN policy epoch, active health alerts, and the flight
+recorder's pressure column.  ``--watch`` redraws on an interval until
+interrupted; ``--dump-flight`` instead asks the scheduler to broadcast
+a flight-recorder snapshot (every node dumps its black-box ring to
+``GEOMX_OBS_DIR`` — see docs/observability.md "Postmortem
+forensics").
 
 Topology comes from the same env surface the launcher uses
 (GEOMX_NUM_PARTIES / GEOMX_WORKERS_PER_PARTY / GEOMX_GLOBAL_SHARDS /
@@ -67,15 +71,26 @@ class StatusClient:
         self._app = _QueryApp(APP_PS, 0, self.po)
 
     def query(self, timeout: float = 5.0) -> dict:
+        return self._cmd(Ctrl.CLUSTER_STATE, {}, timeout,
+                         "empty cluster-state reply")
+
+    def dump_flight(self, out_dir: str = "", timeout: float = 5.0) -> dict:
+        """Ask the scheduler to broadcast a flight-recorder snapshot
+        (Ctrl.FLIGHT_DUMP → Control.FLIGHT_DUMP to every node); returns
+        the reply naming the dump dir + expected per-node paths."""
+        body = {"dir": out_dir} if out_dir else {}
+        return self._cmd(Ctrl.FLIGHT_DUMP, body, timeout,
+                         "empty flight-dump reply")
+
+    def _cmd(self, cmd, body: dict, timeout: float, err: str) -> dict:
         gsched = self.po.topology.global_scheduler()
-        ts = self._app.send_cmd(
-            gsched, Ctrl.CLUSTER_STATE,
-            body={"addr": [self.addr[0], self.addr[1]]},
-            domain=Domain.GLOBAL, wait=False)
+        body = dict(body, addr=[self.addr[0], self.addr[1]])
+        ts = self._app.send_cmd(gsched, cmd, body=body,
+                                domain=Domain.GLOBAL, wait=False)
         self._app.customer.wait(ts, timeout=timeout)
         reply = self._app.cmd_response(ts)
         if not isinstance(reply, dict):
-            raise RuntimeError("empty cluster-state reply")
+            raise RuntimeError(err)
         return reply
 
     def stop(self):
@@ -116,6 +131,16 @@ def main(argv=None) -> int:
                     or None,
                     help="local reply port (default base-port + 177)")
     ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--dump-flight", action="store_true",
+                    help="ask every node to snapshot its black-box "
+                         "flight-recorder ring to the cluster's "
+                         "GEOMX_OBS_DIR (or --flight-dir), then exit; "
+                         "assemble with python -m "
+                         "geomx_tpu.obs.postmortem <dir>")
+    ap.add_argument("--flight-dir", default="",
+                    help="dump directory override sent with "
+                         "--dump-flight (must be writable by the "
+                         "cluster's processes)")
     args = ap.parse_args(argv)
 
     cfg = Config.from_env()
@@ -127,6 +152,24 @@ def main(argv=None) -> int:
     client = StatusClient(cfg, args.base_port,
                           args.status_port or args.base_port + 177)
     try:
+        if args.dump_flight:
+            try:
+                reply = client.dump_flight(args.flight_dir,
+                                           timeout=args.timeout)
+            except (TimeoutError, RuntimeError) as e:
+                print(f"status: flight dump failed ({e})",
+                      file=sys.stderr)
+                return 1
+            if not reply.get("ok"):
+                print(f"status: flight dump refused — "
+                      f"{reply.get('error')}", file=sys.stderr)
+                return 1
+            print(f"flight dump requested: incident "
+                  f"{reply.get('incident')} -> {reply.get('dir')} "
+                  f"({reply.get('nodes')} node(s)); assemble with "
+                  f"python -m geomx_tpu.obs.postmortem "
+                  f"{reply.get('dir')}")
+            return 0
         while True:
             try:
                 state = client.query(timeout=args.timeout)

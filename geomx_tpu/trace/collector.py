@@ -70,6 +70,33 @@ def _shard_of(node: str):
     return None
 
 
+def resolve_clock_offsets(offs: Dict[str, Dict[str, float]],
+                          gname: str) -> Dict[str, float]:
+    """Per-node offset to the global scheduler's clock (seconds), from
+    each node's heartbeat-echo offsets to its scheduler target(s) —
+    the chaining documented in the module docstring.  Shared by the
+    trace collector and the flight-recorder postmortem assembler
+    (obs/postmortem.py), which rebases per-node dumps the same way."""
+    out: Dict[str, float] = {gname: 0.0}
+    # party-scheduler offsets chained through the party's server
+    psched_to_g: Dict[str, float] = {}
+    for n, o in offs.items():
+        if gname in o:
+            out[n] = o[gname]
+            for sched, v in o.items():
+                if sched != gname:
+                    psched_to_g[sched] = o[gname] - v
+                    out.setdefault(sched, o[gname] - v)
+    for n, o in offs.items():
+        if n in out:
+            continue
+        for sched, v in o.items():
+            if sched in psched_to_g:
+                out[n] = v + psched_to_g[sched]
+                break
+    return out
+
+
 class TraceCollector:
     """One per deployment, on the global scheduler's postoffice."""
 
@@ -111,24 +138,9 @@ class TraceCollector:
         """Per-node offset to the global scheduler's clock (seconds)."""
         with self._mu:
             offs = {n: dict(o) for n, o in self._offsets.items()}
-        gname = str(self.po.topology.global_scheduler())
-        out: Dict[str, float] = {self.node: 0.0, gname: 0.0}
-        # party-scheduler offsets chained through the party's server
-        psched_to_g: Dict[str, float] = {}
-        for n, o in offs.items():
-            if gname in o:
-                out[n] = o[gname]
-                for sched, v in o.items():
-                    if sched != gname:
-                        psched_to_g[sched] = o[gname] - v
-                        out.setdefault(sched, o[gname] - v)
-        for n, o in offs.items():
-            if n in out:
-                continue
-            for sched, v in o.items():
-                if sched in psched_to_g:
-                    out[n] = v + psched_to_g[sched]
-                    break
+        out = resolve_clock_offsets(
+            offs, str(self.po.topology.global_scheduler()))
+        out.setdefault(self.node, 0.0)
         return out
 
     # ---- merge --------------------------------------------------------------

@@ -76,6 +76,14 @@ class Control(enum.Enum):
     #                    NEW_PRIMARY so every client retargets + replays
     #                    — the same epoch-fence machinery as failover,
     #                    exercised with the old holder still alive
+    FLIGHT_DUMP = 17   # broadcast -> every node: snapshot your flight-
+    #                    recorder ring to disk NOW, under one shared
+    #                    incident id (body: {incident, dir, rule?,
+    #                    subject?}).  Sent by the health engine on an
+    #                    alert transition (every node dumps the same
+    #                    incident window) and by the scheduler relaying
+    #                    an operator's Ctrl.FLIGHT_DUMP request
+    #                    (geomx_tpu/obs/flight.py)
 
 
 class Domain(enum.Enum):

@@ -419,6 +419,10 @@ class Van:
         # WAN byte counters mirrored into the system-metrics registry so
         # the tracer's reports and bench.py read the same ledger
         self._tracer = None
+        # black-box flight recorder (geomx_tpu/obs/flight): wired by the
+        # owning Postoffice when Config.enable_flight (default ON); None
+        # = one attribute check per message, nothing recorded
+        self.flight = None
         self._wan_codec_counters: Dict[str, object] = {}
         # P3 observability: count priority-queue overtakes (a message
         # dequeued before an earlier-enqueued one — i.e. the queue
@@ -551,6 +555,9 @@ class Van:
             self.send_bytes += n
             if msg.domain is Domain.GLOBAL:
                 self.wan_send_bytes += n
+        fl = self.flight
+        if fl is not None:
+            fl.msg_send(msg, n)
         if msg.control is Control.EMPTY:
             is_wan = msg.domain is Domain.GLOBAL
             if is_wan:
@@ -646,6 +653,9 @@ class Van:
             self.recv_bytes += n
             if msg.domain is Domain.GLOBAL:
                 self.wan_recv_bytes += n
+        fl = self.flight
+        if fl is not None:
+            fl.msg_recv(msg, n)
         if (_tctx.ACTIVE and msg.trace_id > 0
                 and msg.domain is Domain.GLOBAL
                 and msg.control is Control.EMPTY):
@@ -676,6 +686,8 @@ class Van:
             # sends would be suppressed as its predecessor's duplicates
             dedup_key = (str(msg.sender), msg.boot, msg.msg_sig)
             if dedup_key in self._seen_sigs:
+                if fl is not None:
+                    fl.msg_dedup(msg)
                 return  # duplicate suppression (ref: resender.h:60-77)
             self._seen_sigs.add(dedup_key)
             self._seen_order.append(dedup_key)

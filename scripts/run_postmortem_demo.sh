@@ -1,0 +1,121 @@
+#!/usr/bin/env bash
+# Postmortem-forensics demo (ISSUE 9 acceptance): a real OS-process
+# topology over TCP with TWO global shards (each backed by a hot
+# standby) and the telemetry + flight-recorder planes on; SIGKILL
+# shard 1's primary mid-training, let the round-stall alert broadcast
+# a FLIGHT_DUMP incident + the exit hooks dump the survivors' rings,
+# then assemble everything offline and assert — from the dumps alone —
+# that the report names
+#   (a) the DEAD node (global_server:1 — SIGKILL leaves no dump; the
+#       survivors' rings carry the last time anyone heard from it),
+#   (b) the STALLED round/shard (shard 1), and
+#   (c) the subsequent PROMOTION (standby_global:1),
+# with flight dumps from >= 3 distinct nodes feeding the timeline.
+#
+# The pytest twin is tests/test_flight.py::
+# test_postmortem_of_killed_shard_primary_e2e (in-proc, slow-marked);
+# this script is the operator-facing tour.  See docs/observability.md
+# ("Postmortem forensics").
+#
+# Env: GEOMX_BASE_PORT (default 9560), STEPS (default 600)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export JAX_PLATFORM_NAME=cpu
+export GEOMX_GLOBAL_SHARDS=2
+export GEOMX_NUM_STANDBY_GLOBALS=2
+export GEOMX_HEARTBEAT_INTERVAL=0.2
+export GEOMX_HEARTBEAT_TIMEOUT=1.5
+export GEOMX_REQUEST_RETRY_S=1.0
+export GEOMX_RETRY_BACKOFF_CAP=2
+export GEOMX_OBS=1
+export GEOMX_OBS_INTERVAL=0.2
+export GEOMX_OBS_STALL_MIN=1.0
+# pace the worker (~40 ms/step): the cluster must outlive the kill +
+# the failover + the dump round trips
+export GEOMX_TEST_STEP_SLEEP_MS='{"worker:0@p0": 40}'
+
+BASE=${GEOMX_BASE_PORT:-9560}
+export GEOMX_BASE_PORT=$BASE
+STEPS=${STEPS:-600}
+OUT=$(mktemp -d)
+export GEOMX_OBS_DIR="$OUT/obs"
+trap 'kill $(jobs -p) 2>/dev/null || true; rm -rf "$OUT"' EXIT
+
+launch() { # role
+  python -m geomx_tpu.launch --role "$1" --parties 1 --workers 1 \
+    --global-shards 2 --standby-globals 2 --base-port "$BASE" \
+    --obs-interval 0.2 --steps "$STEPS" >"$OUT/${1//[:@]/_}.log" 2>&1 &
+}
+
+launch global_scheduler:0
+launch global_server:0
+launch global_server:1
+launch standby_global:0
+launch standby_global:1
+launch scheduler:0@p0
+launch server:0@p0
+launch worker:0@p0
+WORKER_PID=$!
+
+for _ in $(seq 1 240); do
+  grep -q "training begins" "$OUT/worker_0_p0.log" 2>/dev/null && break
+  sleep 0.5
+done
+grep -q "training begins" "$OUT/worker_0_p0.log" \
+  || { echo "FAIL: worker never started training"; tail "$OUT/worker_0_p0.log"; exit 1; }
+sleep 3  # several rounds + replication snapshots + telemetry samples
+
+VICTIM=$(pgrep -f "geomx_tpu.launch --role global_server:1 .*--base-port $BASE" | head -1)
+echo "== SIGKILL shard 1 primary (pid $VICTIM) =="
+kill -9 "$VICTIM"
+
+# the round-stall alert fires on the scheduler and broadcasts
+# Control.FLIGHT_DUMP — wait for the incident dumps to land
+INCIDENT=0
+for _ in $(seq 1 40); do
+  if ls "$GEOMX_OBS_DIR"/flight_*round_stall*.json >/dev/null 2>&1; then
+    INCIDENT=1; break
+  fi
+  sleep 0.5
+done
+[ "$INCIDENT" = 1 ] \
+  || { echo "FAIL: no alert-incident flight dumps appeared"; ls "$GEOMX_OBS_DIR" 2>/dev/null || true; exit 1; }
+echo "== alert incident dumps =="
+ls "$GEOMX_OBS_DIR"/flight_*round_stall*.json
+
+# while the cluster still runs: an operator-triggered dump round trip
+python -m geomx_tpu.status --dump-flight >"$OUT/dump_req.txt" 2>/dev/null || true
+cat "$OUT/dump_req.txt" 2>/dev/null || true
+
+# let training finish so every surviving process writes its exit dump
+wait "$WORKER_PID" || true
+sleep 2
+
+echo "== assembling the postmortem =="
+python -m geomx_tpu.obs.postmortem "$GEOMX_OBS_DIR" >"$OUT/report.txt"
+cat "$OUT/report.txt"
+
+N_NODES=$(python -c "import json; print(len(json.load(open(
+    '$GEOMX_OBS_DIR/postmortem.json'))['nodes']))")
+echo "== $N_NODES distinct node(s) left flight dumps =="
+[ "$N_NODES" -ge 3 ] \
+  || { echo "FAIL: fewer than 3 nodes left flight dumps"; exit 1; }
+if ls "$GEOMX_OBS_DIR" | grep -q "flight_global_server_1_exit"; then
+  echo "FAIL: the SIGKILLed primary left an exit dump?!"; exit 1
+fi
+
+grep -q "DEAD: global_server:1" "$OUT/report.txt" \
+  || { echo "FAIL: report does not name the dead node"; exit 1; }
+grep -q "shard 1: STALLED at round" "$OUT/report.txt" \
+  || { echo "FAIL: report does not name the stalled round/shard"; exit 1; }
+grep -q "standby_global:1" "$OUT/report.txt" \
+  || { echo "FAIL: report does not show the promotion"; exit 1; }
+grep -q "last heard" "$OUT/report.txt" \
+  || { echo "FAIL: no last-heard attribution for the dead node"; exit 1; }
+[ -s "$GEOMX_OBS_DIR/postmortem.json" ] \
+  || { echo "FAIL: no machine-readable postmortem.json"; exit 1; }
+grep -q "steps=$STEPS" "$OUT/worker_0_p0.log" \
+  || { echo "FAIL: training did not finish all steps"; exit 1; }
+echo "OK: $N_NODES nodes' rings assembled; report names the dead node, the stalled shard/round, and the promotion"

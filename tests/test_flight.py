@@ -1,0 +1,512 @@
+"""Black-box flight recorder + cross-node postmortem forensics
+(ISSUE 9 tentpole).
+
+Covers: ring wraparound/overwrite semantics over preallocated slots,
+the no-allocation hot-path guard (tracemalloc over a taped record
+loop), default-on recording of real traffic (message heads land in
+every node's ring), pressure gauges flowing through the PR 7 metrics
+pump + the status console's pressure column, exactly-one-dump-per-
+alert-transition e2e (HealthEngine → Control.FLIGHT_DUMP broadcast →
+every node dumps once under one incident id, the alert record carries
+the paths), the operator wire trigger (Ctrl.FLIGHT_DUMP), postmortem
+assembly of a 3-role chain with rebased clocks, the disabled path
+(GEOMX_FLIGHT=0 constructs nothing), and the slow acceptance e2e
+(SIGKILL a global-shard primary mid-training → ≥3 nodes' dumps
+assemble into one timeline naming the dead node, the stalled
+round/shard and the promotion).
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from geomx_tpu.core.config import Config, Topology
+from geomx_tpu.kvstore import Simulation
+from geomx_tpu.kvstore.common import Ctrl
+from geomx_tpu.obs.flight import FlightEv, FlightRecorder, dump_path
+from geomx_tpu.obs.postmortem import assemble, report_text
+from geomx_tpu.transport.message import Domain
+
+
+def _cfg(parties=1, workers=1, **kw):
+    return Config(topology=Topology(num_parties=parties,
+                                    workers_per_party=workers), **kw)
+
+
+def _wait_for(pred, timeout=15.0, every=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+def _run_rounds(sim, rounds, tids=(0,), n=32):
+    ws = sim.all_workers()
+    for _ in range(rounds):
+        for w in ws:
+            for t in tids:
+                w.push(t, np.ones(n, np.float32))
+        for w in ws:
+            for t in tids:
+                w.pull_sync(t)
+            w.wait_all()
+
+
+# ---------------------------------------------------------------------------
+# ring semantics
+# ---------------------------------------------------------------------------
+
+def test_ring_wraparound_overwrite():
+    """A full ring overwrites its OLDEST slots in place: the decode
+    returns exactly the last ``cap`` events in order, the total
+    recorded count keeps climbing, and the column arrays are never
+    reallocated."""
+    rec = FlightRecorder("node:0", cap=8)
+    ids = (id(rec._t), id(rec._code), id(rec._a), id(rec._peer))
+    for i in range(20):
+        rec.record(FlightEv.SEND, a=i, t=float(i))
+    assert rec._n == 20
+    evs = rec.events()
+    assert len(evs) == 8
+    assert [e["a"] for e in evs] == list(range(12, 20))
+    assert all(e["ev"] == "SEND" for e in evs)
+    # same preallocated arrays after 2.5x wraparound
+    assert ids == (id(rec._t), id(rec._code), id(rec._a), id(rec._peer))
+    # partial fill decodes only what was recorded
+    rec2 = FlightRecorder("node:1", cap=8)
+    rec2.record(FlightEv.FENCE, a=7, peer="worker:0@p0", note="x")
+    evs2 = rec2.events()
+    assert len(evs2) == 1
+    assert evs2[0]["ev"] == "FENCE" and evs2[0]["peer"] == "worker:0@p0"
+
+
+def test_record_hot_path_no_allocation():
+    """The guard the tentpole promises: a taped record() loop retains
+    (effectively) no memory — preallocated slots only, no per-event
+    dict/list/str construction on the hot path."""
+    rec = FlightRecorder("node:0", cap=1024)
+    peer = "server:0@p0"  # call sites pass existing refs, never build
+    for i in range(2048):  # warm: wrap the ring, touch every slot
+        rec.record(FlightEv.SEND, a=5, b=1, c=4096, d=7, peer=peer)
+    tracemalloc.start()
+    base, _ = tracemalloc.get_traced_memory()
+    for i in range(5000):
+        rec.record(FlightEv.SEND, a=5, b=1, c=4096, d=7, peer=peer)
+    cur, _ = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    grown = cur - base
+    assert grown < 16 * 1024, \
+        f"record() retained {grown}B over 5000 events — the hot path " \
+        "is allocating per event"
+    assert rec._n == 7048
+
+
+# ---------------------------------------------------------------------------
+# default-on recording of real traffic + pressure plumbing
+# ---------------------------------------------------------------------------
+
+def test_default_on_records_message_heads_and_rounds():
+    """Default config: every node's postoffice carries a recorder, the
+    van taps stamp SEND/RECV heads, and the servers stamp round
+    open/complete — the always-on evidence trail."""
+    sim = Simulation(_cfg(parties=2))
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(32, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        _run_rounds(sim, 2)
+        for s, po in sim.offices.items():
+            assert po.flight is not None, s
+            assert po.van.flight is po.flight
+        gs_evs = sim.offices["global_server:0"].flight.events()
+        names = {e["ev"] for e in gs_evs}
+        assert {"SEND", "RECV", "ROUND_OPEN", "ROUND_COMPLETE"} <= names
+        completes = [e for e in gs_evs if e["ev"] == "ROUND_COMPLETE"]
+        assert completes[-1]["b"] == 2  # key_rounds rides the event
+        ls_evs = sim.offices["server:0@p0"].flight.events()
+        sends = [e for e in ls_evs if e["ev"] == "SEND"]
+        # peers recorded as-is, decoded to strings at dump time
+        assert any(e["peer"] == "global_server:0" for e in sends)
+        assert any(e["ev"] == "ROUND_OPEN" and e["note"] == "wan_push"
+                   for e in ls_evs)
+    finally:
+        sim.shutdown()
+
+
+def test_pressure_gauges_flow_through_pump_and_console():
+    """sample_pressure sets the lock_wait_s / lane_depth /
+    van_sendq_depth / codec_pool_busy registry gauges; the metrics pump
+    ships them, and the status console's pressure column renders
+    them."""
+    from geomx_tpu.obs.state import render_text
+
+    sim = Simulation(_cfg(parties=1, enable_obs=True, obs_interval_s=0.0))
+    try:
+        w = sim.all_workers()[0]
+        w.init(0, np.zeros(8, np.float32))
+        w.set_optimizer({"type": "sgd", "lr": 0.1})
+        _run_rounds(sim, 1, n=8)
+        sim.pump_metrics()
+        mc = sim.metrics_collector
+        for node in ("server:0@p0", "global_server:0"):
+            for key in ("lock_wait_s", "lane_depth", "codec_pool_busy",
+                        "van_sendq_depth"):
+                v = mc.value(node, key)
+                assert isinstance(v, (int, float)), (node, key, v)
+        # every node ships at least the van send-queue depth
+        assert isinstance(mc.value("worker:0@p0", "van_sendq_depth"),
+                          (int, float))
+        st = sim.cluster_state()
+        assert "lock_wait_s" in st["shards"][0]["pressure"]
+        assert "lane_depth" in st["parties"][0]["pressure"]
+        txt = render_text(st)
+        assert "press[" in txt
+        # PRESSURE events landed in the ring too
+        evs = sim.offices["server:0@p0"].flight.events()
+        notes = {e["note"] for e in evs if e["ev"] == "PRESSURE"}
+        assert {"lock_wait_s", "lane_depth", "van_sendq_depth",
+                "codec_pool_busy"} <= notes
+    finally:
+        sim.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# dump triggers
+# ---------------------------------------------------------------------------
+
+def test_exactly_one_dump_per_alert_transition(tmp_path, monkeypatch):
+    """A HealthEngine FIRING transition broadcasts Control.FLIGHT_DUMP:
+    every node dumps exactly once under the shared incident id (ticks
+    while still firing add nothing; rebroadcasts dedup), and the alert
+    record carries the dump paths.  A second transition is a second
+    incident with its own dumps."""
+    monkeypatch.setenv("GEOMX_OBS_DIR", str(tmp_path))
+    sim = Simulation(_cfg(parties=1, enable_obs=True, obs_interval_s=0.0,
+                          obs_flight_cooldown_s=0.0))
+    try:
+        mc, eng = sim.metrics_collector, sim.health
+        n_nodes = len(sim.offices)
+        mc.ingest({"node": "global_server:9", "boot": 7, "t_mono": 1.0,
+                   "metrics": {"global_server:9.replication_lag_s": 120.0},
+                   "stats": {}})
+        recs = eng.tick(now=10.0)
+        fired = [r for r in recs if r["state"] == "firing"]
+        assert len(fired) == 1
+        flight = fired[0]["data"].get("flight")
+        assert flight and flight["dir"] == str(tmp_path)
+        assert len(flight["paths"]) == n_nodes
+
+        def n_dumps():
+            return len(glob.glob(str(tmp_path / "flight_*.json")))
+
+        assert _wait_for(lambda: n_dumps() == n_nodes), \
+            (n_dumps(), n_nodes)
+        # still firing: no new record, no new dumps
+        assert not eng.tick(now=11.0)
+        time.sleep(0.2)
+        assert n_dumps() == n_nodes
+        # a dump parses and names the incident
+        body = json.load(open(glob.glob(
+            str(tmp_path / "flight_global_scheduler*"))[0]))
+        assert body["incident"] == flight["incident"]
+        assert body["events"], "scheduler dumped an empty ring"
+        # recover, then fire again: a NEW incident, one more dump each
+        mc.ingest({"node": "global_server:9", "boot": 7, "t_mono": 2.0,
+                   "metrics": {"global_server:9.replication_lag_s": 0.1},
+                   "stats": {}})
+        eng.tick(now=12.0)
+        assert n_dumps() == n_nodes  # recovery transition: no dump
+        mc.ingest({"node": "global_server:9", "boot": 7, "t_mono": 3.0,
+                   "metrics": {"global_server:9.replication_lag_s": 200.0},
+                   "stats": {}})
+        recs = eng.tick(now=20.0)
+        flight2 = [r for r in recs if r["state"] == "firing"][0][
+            "data"]["flight"]
+        assert flight2["incident"] != flight["incident"]
+        assert _wait_for(lambda: n_dumps() == 2 * n_nodes)
+    finally:
+        sim.shutdown()
+
+
+def test_flight_dump_cooldown_suppresses_flapping(tmp_path, monkeypatch):
+    """Default cooldown: a (rule, subject) re-firing inside
+    obs_flight_cooldown_s captures NO new incident — the first firing
+    already holds the evidence window, and a flapping warn rule must
+    not flood the dump dir."""
+    monkeypatch.setenv("GEOMX_OBS_DIR", str(tmp_path))
+    sim = Simulation(_cfg(parties=1, enable_obs=True, obs_interval_s=0.0,
+                          obs_flight_cooldown_s=60.0))
+    try:
+        mc, eng = sim.metrics_collector, sim.health
+        n_nodes = len(sim.offices)
+
+        def flap(lag, now):
+            mc.ingest({"node": "global_server:9", "boot": 7,
+                       "t_mono": now,
+                       "metrics": {"global_server:9.replication_lag_s":
+                                   lag},
+                       "stats": {}})
+            return eng.tick(now=now)
+
+        first = flap(120.0, 10.0)
+        assert first[0]["data"].get("flight")
+        assert _wait_for(lambda: len(glob.glob(
+            str(tmp_path / "flight_*.json"))) == n_nodes)
+        flap(0.1, 12.0)              # recover
+        refire = flap(150.0, 15.0)   # re-fire inside the window
+        assert refire and "flight" not in refire[0]["data"]
+        time.sleep(0.2)
+        assert len(glob.glob(str(tmp_path / "flight_*.json"))) == n_nodes
+        # past the cooldown the next firing is a fresh incident
+        flap(0.1, 30.0)
+        beyond = flap(150.0, 80.0)
+        assert beyond[0]["data"].get("flight")
+        assert _wait_for(lambda: len(glob.glob(
+            str(tmp_path / "flight_*.json"))) == 2 * n_nodes)
+    finally:
+        sim.shutdown()
+
+
+def test_operator_flight_dump_over_the_wire(tmp_path):
+    """Ctrl.FLIGHT_DUMP at the scheduler (the status console's
+    --dump-flight) broadcasts the snapshot and answers with the dir +
+    expected paths — no GEOMX_OBS_DIR needed when the request names the
+    dir."""
+    sim = Simulation(_cfg(parties=1))
+    try:
+        w = sim.all_workers()[0]
+        w.init(0, np.zeros(8, np.float32))
+        w.set_optimizer({"type": "sgd", "lr": 0.1})
+        _run_rounds(sim, 1, n=8)
+        kv = sim.worker(0, 0)
+        reply = kv.worker.send_cmd(
+            sim.topology.global_scheduler(), Ctrl.FLIGHT_DUMP,
+            body={"dir": str(tmp_path)}, domain=Domain.GLOBAL)
+        assert reply["ok"] and reply["incident"] == "operator-1"
+        assert reply["nodes"] == len(sim.offices)
+        assert _wait_for(lambda: len(glob.glob(
+            str(tmp_path / "flight_*.json"))) == len(sim.offices))
+        assert sim.state_service.flight_requests == 1
+        # the assembler reads the operator dumps like any others
+        res = assemble(str(tmp_path))
+        assert sorted(res["nodes"]) == sorted(sim.offices)
+        assert not res["dead"]
+    finally:
+        sim.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# postmortem assembly
+# ---------------------------------------------------------------------------
+
+def test_postmortem_three_role_chain_rebased_clocks(tmp_path):
+    """Three dumps with DIFFERENT local clocks (worker knows only its
+    party scheduler; the offset to the global clock chains through the
+    dual-homed local server) assemble into one causally ordered
+    timeline: send-before-recv holds after rebasing even though the
+    raw local timestamps are wildly out of order."""
+    gname, sname, wname = ("global_scheduler:0", "server:0@p0",
+                           "worker:0@p0")
+    # server: +50s to global, +20s to psched => psched->global = +30
+    # worker: +10s to psched => worker->global = 10 + 30 = +40
+    offs = {gname: {}, sname: {gname: 50.0, "scheduler:0@p0": 20.0},
+            wname: {"scheduler:0@p0": 10.0}}
+    # causal chain on the GLOBAL clock: 100.0 -> 100.5 -> 101 -> 101.5
+    chains = {
+        wname: [(60.0, FlightEv.SEND, sname)],       # 60+40 = 100
+        sname: [(50.5, FlightEv.RECV, wname),        # 50.5+50 = 100.5
+                (51.0, FlightEv.SEND, gname)],       # 51+50 = 101
+        gname: [(101.5, FlightEv.RECV, sname)],      # its clock IS global
+    }
+    topo = [gname, sname, wname]
+    for node, evs in chains.items():
+        rec = FlightRecorder(node, cap=16)
+        for t, code, peer in evs:
+            rec.record(code, c=8, peer=peer, t=t)
+        body = rec.snapshot()
+        body.update({"clock_offsets": offs[node], "topology": topo,
+                     "boot": 1})
+        with open(dump_path(str(tmp_path), node, "test"), "w") as f:
+            json.dump(body, f)
+    res = assemble(str(tmp_path))
+    assert res["clock_offsets_s"][sname] == pytest.approx(50.0)
+    assert res["clock_offsets_s"][wname] == pytest.approx(40.0)
+    tl = [(e["node"], e["ev"]) for e in res["timeline"]]
+    assert tl == [(wname, "SEND"), (sname, "RECV"), (sname, "SEND"),
+                  (gname, "RECV")]
+    ts = [e["t"] for e in res["timeline"]]
+    assert ts == sorted(ts)
+    assert ts[-1] - ts[0] == pytest.approx(1.5)
+    assert not res["dead"]
+    assert "3 node(s)" in report_text(res)
+
+
+def test_postmortem_names_dead_node_from_survivor_rings(tmp_path):
+    """A plan node that left NO dump is reported dead, with the last
+    instant a survivor heard from it (its SIGKILL leaves exactly this
+    evidence shape)."""
+    gname, sname = "global_scheduler:0", "server:0@p0"
+    dead = "global_server:1"
+    topo = [gname, sname, dead, "global_server:0"]
+    rec = FlightRecorder(sname, cap=32)
+    rec.record(FlightEv.SEND, c=100, peer=dead, t=5.0)
+    rec.record(FlightEv.RECV, c=64, peer=dead, t=6.0)   # last heard
+    rec.record(FlightEv.SEND, c=100, peer=dead, t=9.0)  # unanswered
+    body = rec.snapshot()
+    body.update({"clock_offsets": {gname: 0.0}, "topology": topo})
+    with open(dump_path(str(tmp_path), sname, "exit"), "w") as f:
+        json.dump(body, f)
+    rec0 = FlightRecorder("global_server:0", cap=32)
+    rec0.record(FlightEv.ROUND_COMPLETE, a=1, b=4, t=8.0)
+    body0 = rec0.snapshot()
+    body0.update({"clock_offsets": {gname: 0.0}, "topology": topo})
+    with open(dump_path(str(tmp_path), "global_server:0", "exit"),
+              "w") as f:
+        json.dump(body0, f)
+    res = assemble(str(tmp_path))
+    d = {e["node"]: e for e in res["dead"]}
+    assert dead in d and "global_scheduler:0" in d  # no dump either
+    assert d[dead]["last_heard_t"] == pytest.approx(6.0)
+    assert d[dead]["last_heard_by"] == sname
+    # the dead holder names its shard stalled even with no events of
+    # its own in any ring window
+    assert res["shards"][1]["stalled"]
+    assert res["shards"][1]["dead_holder"] == dead
+    assert not res["shards"][0]["stalled"]
+    txt = report_text(res)
+    assert f"DEAD: {dead}" in txt
+    assert "shard 1: STALLED" in txt
+
+
+# ---------------------------------------------------------------------------
+# disabled path
+# ---------------------------------------------------------------------------
+
+def test_disabled_flight_constructs_nothing(tmp_path):
+    """GEOMX_FLIGHT=0 / enable_flight=False: no recorder on any
+    postoffice, no van tap, no sampler thread, no files — and the
+    health engine's dump trigger degrades to a silent no-op."""
+    sim = Simulation(_cfg(parties=1, enable_flight=False,
+                          enable_obs=True, obs_interval_s=0.0))
+    try:
+        for s, po in sim.offices.items():
+            assert po.flight is None, s
+            assert po.van.flight is None, s
+        names = {t.name for t in threading.enumerate()}
+        assert not any(n.startswith("flight-sampler") for n in names)
+        w = sim.all_workers()[0]
+        w.init(0, np.zeros(8, np.float32))
+        w.set_optimizer({"type": "sgd", "lr": 0.1})
+        _run_rounds(sim, 1, n=8)
+        sim.pump_metrics()
+        # no pressure gauges ship without a recorder sampling them
+        assert sim.metrics_collector.value("server:0@p0",
+                                           "lane_depth") is None
+        # an alert transition dumps nothing (no recorder plane)
+        os.environ["GEOMX_OBS_DIR"] = str(tmp_path)
+        try:
+            sim.metrics_collector.ingest(
+                {"node": "global_server:9", "boot": 1, "t_mono": 1.0,
+                 "metrics": {"global_server:9.replication_lag_s": 99.0},
+                 "stats": {}})
+            recs = sim.health.tick(now=10.0)
+        finally:
+            del os.environ["GEOMX_OBS_DIR"]
+        assert recs and "flight" not in recs[0]["data"]
+        assert not glob.glob(str(tmp_path / "flight_*.json"))
+        assert sim.dump_flight(str(tmp_path)) == []
+    finally:
+        sim.shutdown()
+
+
+def test_flight_env_default_and_override(monkeypatch):
+    """Config default follows GEOMX_FLIGHT (on unless set falsy); an
+    explicitly constructed value wins over the env."""
+    assert Config().enable_flight is True
+    monkeypatch.setenv("GEOMX_FLIGHT", "0")
+    assert Config().enable_flight is False
+    assert Config(enable_flight=True).enable_flight is True
+    monkeypatch.delenv("GEOMX_FLIGHT")
+    assert Config(enable_flight=False).enable_flight is False
+    with pytest.raises(ValueError):
+        Config(flight_events=4)
+
+
+# ---------------------------------------------------------------------------
+# acceptance e2e (slow): SIGKILL a shard primary -> assembled postmortem
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.failover
+def test_postmortem_of_killed_shard_primary_e2e(tmp_path, monkeypatch):
+    """The ISSUE 9 acceptance shape, in-proc: kill global shard 1's
+    primary mid-training; the round-stall alert broadcasts a flight
+    dump (same incident window on every surviving node), the exit
+    dumps follow, and the assembler's report names the dead node, the
+    stalled round/shard, and the subsequent promotion — from ≥3
+    distinct nodes' rings."""
+    monkeypatch.setenv("GEOMX_OBS_DIR", str(tmp_path))
+    from geomx_tpu.kvstore.keys import encode_tensor
+
+    cfg = Config(
+        topology=Topology(num_parties=2, workers_per_party=1,
+                          num_global_servers=2, num_standby_globals=2),
+        enable_obs=True, obs_interval_s=0.0,
+        request_retry_s=0.4, heartbeat_interval_s=0.05,
+        heartbeat_timeout_s=0.4, replicate_every=1, retry_backoff_cap=2,
+        obs_stall_min_s=0.3, obs_stall_factor=2.0)
+    sim = Simulation(cfg)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(16, np.float32))
+            w.init(1, np.zeros(16, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        for _ in range(3):
+            _run_rounds(sim, 1, tids=(0, 1), n=16)
+            sim.pump_metrics()
+            sim.health.tick()
+        sb1 = sim.standby_globals[1]
+        k1 = encode_tensor(1, 16, 2)[0].ps_key
+        assert _wait_for(lambda: k1 in sb1.store), "replication stalled"
+        sim.kill_global_server(1)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            _run_rounds(sim, 1, tids=(0,), n=16)
+            sim.pump_metrics()
+            sim.health.tick()
+            if sim.health.active_alerts():
+                break
+            time.sleep(0.05)
+        assert sim.health.active_alerts(), "round stall never alerted"
+        assert _wait_for(lambda: not sb1.is_standby), "promotion stalled"
+        _run_rounds(sim, 1, tids=(1,), n=16)  # replays at the standby
+        sim.dump_flight(str(tmp_path))  # the survivors' exit dumps
+        dumped_nodes = {json.load(open(p))["node"] for p in
+                        glob.glob(str(tmp_path / "flight_*.json"))}
+        assert len(dumped_nodes) >= 3
+        assert "global_server:1" not in dumped_nodes  # SIGKILL = no dump
+        res = assemble(str(tmp_path))
+        assert {d["node"] for d in res["dead"]} == {"global_server:1"}
+        assert res["dead"][0]["last_heard_t"] is not None
+        assert res["shards"][1]["stalled"]
+        assert res["shards"][1]["dead_holder"] == "global_server:1"
+        assert res["shards"][1]["stalled_round"] > 0
+        assert not res["shards"][0]["stalled"]
+        promos = [e for e in res["transitions"] if e["ev"] == "PROMOTE"]
+        assert any(e.get("peer") == "standby_global:1" for e in promos)
+        txt = report_text(res)
+        assert "DEAD: global_server:1" in txt
+        assert "shard 1: STALLED" in txt
+        assert "standby_global:1" in txt
+    finally:
+        sim.shutdown()

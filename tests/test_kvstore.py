@@ -472,3 +472,65 @@ def test_merged_round_parks_member_pulls_until_complete():
             w.wait_all()
     finally:
         sim.shutdown()
+
+
+def test_partial_merge_parks_member_with_no_push_history():
+    """ADVICE r5 (round 5): under the TS push overlay, non-elected
+    workers NEVER push directly, so a push-history test would serve
+    their pulls from the previous round for every partial-merge window
+    — replicas silently diverging one round apart.  A known party
+    member with NO push history must PARK during a TS-merged partial
+    round (its contribution rode the merge tree; the round completes
+    without its direct push by construction), while an out-of-plan
+    joiner's BOOTSTRAP pull (nothing pushed yet) is still served from
+    the last completed round — the advisor-r4 deadlock-free answer."""
+    import threading
+    import time
+
+    sim = make_sim(parties=1, workers=3)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(8, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
+        srv = sim.local_servers[0]
+        # degraded/partial TS-merged push straight away: w0 relays its
+        # own + w1's contributions (num_merge=2); w2 has NEVER pushed
+        ws[0].push(0, 2 * np.ones(8, np.float32), num_merge=2)
+
+        def merged_landed():
+            with srv._mu:
+                return any(st.count >= 2 for st in srv._keys.values())
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not merged_landed():
+            time.sleep(0.01)
+        assert merged_landed()
+        got = {}
+        done = threading.Event()
+
+        def on_pull(t, v):
+            got["w2"] = np.array(v)
+            done.set()
+
+        # w2: plan member, zero push history on this key (the TS
+        # non-elected shape) — must park, NOT read round-0 weights
+        ws[2].pull(0, on_pull)
+        time.sleep(0.4)
+        assert not done.is_set(), (
+            "never-pushed member pull served STALE mid-merged-round "
+            f"(replica divergence): got {got.get('w2')}")
+        # an out-of-plan joiner mid-merge still bootstraps serve-stale
+        wj = sim.add_worker(0)
+        wj.init(0, np.zeros(8, np.float32))
+        np.testing.assert_allclose(wj.pull_sync(0), 0.0)
+        # w2's first push + the joiner's complete the round (target 4)
+        ws[2].push(0, np.ones(8, np.float32))
+        wj.push(0, np.ones(8, np.float32))
+        assert done.wait(timeout=30), "parked pull never served"
+        # accum = 2 (merged) + 1 + 1 = 4 → weights 0 - 4 = -4
+        np.testing.assert_allclose(got["w2"], -4.0)
+        for w in ws + [wj]:
+            w.wait_all()
+    finally:
+        sim.shutdown()

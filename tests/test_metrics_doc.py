@@ -29,6 +29,11 @@ EXPANSIONS = {
         "wan_bytes_bsc", "wan_bytes_mpq"],
     "{self.node}.health_{r}_alerts": [
         f"health_{r}_alerts" for r in RULES],
+    # the flight recorder's pressure gauges (obs/flight.py
+    # add_pressure): the van's send-queue probe is registered by the
+    # Postoffice, the merge-side trio by attach_server_pressure
+    "{self.node}.{name}": ["lock_wait_s", "lane_depth",
+                           "van_sendq_depth", "codec_pool_busy"],
 }
 
 
