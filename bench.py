@@ -888,6 +888,87 @@ def child_serde():
     }))
 
 
+def child_merge():
+    """numpy vs jax merge-backend round wall (ISSUE 10): 8 concurrent
+    pushers of one 20M-element (80 MB f32) gradient into one key — the
+    pure merge lane, rounds never complete — swept over
+    ``Config.merge_backend``, with a bit-parity sum check
+    (integer-valued gradients make f32 accumulation exact in any
+    order, so numpy and jax must agree to the bit).  Runs in the cpu
+    chain under JAX_PLATFORMS=cpu: a no-TPU host measures the staged
+    H2D + jitted donated-accumulate machinery on the CPU backend
+    instead of burning a probe timeout (the probe-verdict stamp / env
+    check already decided there is no device); the same child run on a
+    live-TPU host reports on-chip walls."""
+    import threading as _th
+
+    import numpy as np
+
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.kvstore import Simulation
+    from geomx_tpu.kvstore.common import Cmd
+    from geomx_tpu.ps.kv_app import KVPairs
+    from geomx_tpu.transport.message import Message
+
+    elems = int(os.environ.get("BENCH_MERGE_ELEMS", "20000000"))
+    pushers, pushes = 8, 2
+
+    def run(backend: str):
+        cfg = Config(topology=Topology(num_parties=1,
+                                       workers_per_party=pushers),
+                     merge_backend=backend)
+        sim = Simulation(cfg)
+        try:
+            ls = sim.local_servers[0]
+            # pure merge throughput: the round must never complete and
+            # acks go on the floor (same harness as serde's
+            # merge_scaling — we measure the backend, not reply routing)
+            ls._workers_target = 1 << 30
+            ls.server.response = lambda *a, **k: None
+            grads = [np.full(elems, float(i + 1), np.float32)
+                     for i in range(pushers)]
+            workers = sim.topology.workers(0)
+
+            def pusher(i):
+                for t in range(pushes):
+                    m = Message(sender=workers[i], recipient=ls.po.node,
+                                push=True, request=True, timestamp=t,
+                                cmd=Cmd.DEFAULT,
+                                keys=np.array([0], np.int64),
+                                vals=grads[i],
+                                lens=np.array([elems], np.int64))
+                    ls._handle_push(m, KVPairs(m.keys, m.vals, m.lens))
+
+            threads = [_th.Thread(target=pusher, args=(i,))
+                       for i in range(pushers)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            ls._shards.drain()
+            wall = time.perf_counter() - t0
+            acc = ls._backend.materialize(ls._keys[0].accum)
+            return wall, float(acc.sum()), ls._backend.stats()
+        finally:
+            sim.shutdown()
+
+    w_np, s_np, _ = run("numpy")
+    w_jx, s_jx, bs = run("jax")
+    gb = elems * 4 * pushers * pushes / 1e9
+    print(json.dumps({
+        "elems": elems, "pushers": pushers, "pushes_per": pushes,
+        "numpy_wall_s": round(w_np, 3),
+        "jax_wall_s": round(w_jx, 3),
+        "numpy_GBps": round(gb / max(w_np, 1e-9), 2),
+        "jax_GBps": round(gb / max(w_jx, 1e-9), 2),
+        "speedup": round(w_np / max(w_jx, 1e-9), 2),
+        "sums_bit_identical": s_np == s_jx,
+        "jax_backend": bs,  # names the platform that actually ran
+        "cpus": os.cpu_count(),
+    }))
+
+
 # staged-overlap-on-chip config: big enough that per-stage compute is
 # real MXU work, small enough that 10 stage jits compile fast.  The sim
 # kvstore runs in-proc on the host (no WAN throttle): the child isolates
@@ -2077,6 +2158,7 @@ def _build_record() -> dict:
                       ("stress", "stress"), ("lm", "lm"),
                       ("scaling", "scaling"), ("parity", "parity"),
                       ("serde", "serde"), ("shards", "shards"),
+                      ("merge", "merge"),
                       ("serve", "serve"), ("probe", "probe")):
         if name in _results:
             record[key] = _results[name]
@@ -2143,6 +2225,12 @@ def _compact(record: dict) -> dict:
     sv = record.get("serve") or {}
     if sv.get("pulls_per_sec"):
         out["serve_pulls_per_sec"] = sv["pulls_per_sec"]
+    mg = record.get("merge") or {}
+    if mg.get("speedup") is not None:
+        out["merge_backend_speedup"] = {
+            "speedup": mg["speedup"],
+            "parity": mg.get("sums_bit_identical"),
+            "device": (mg.get("jax_backend") or {}).get("merge_device")}
     sd = record.get("serde") or {}
     if sd.get("speedup_encode"):
         out["serde_speedup"] = {"encode": sd["speedup_encode"],
@@ -2298,7 +2386,8 @@ def main():
                     choices=["cnn", "mfu", "mfu_sweep", "quant", "wan",
                              "overlap", "overlap_tpu", "stress", "probe",
                              "flash_autotune", "lm", "scaling", "parity",
-                             "serde", "shards", "obs", "flight", "serve"])
+                             "serde", "shards", "obs", "flight", "serve",
+                             "merge"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -2325,6 +2414,7 @@ def main():
          "parity": child_parity, "serde": child_serde,
          "shards": child_shards, "obs": child_obs,
          "flight": child_flight, "serve": child_serve,
+         "merge": child_merge,
          "flash_autotune": child_flash_autotune}[args.child]()
         return
 
@@ -2424,6 +2514,7 @@ def main():
         _do("parity", 280, cpu_env)
         _do("stress", 180, cpu_env)
         _do("shards", 240, cpu_env)
+        _do("merge", 180, cpu_env)
         _do("obs", 180, cpu_env)
         _do("flight", 180, cpu_env)
         _do("serve", 210, cpu_env)

@@ -1,0 +1,111 @@
+"""jax version compatibility shims.
+
+The repo targets the current jax API; this module papers over the
+renames between the jax versions the container images actually ship so
+one source tree imports cleanly everywhere:
+
+- ``shard_map`` moved from ``jax.experimental.shard_map`` into the
+  ``jax`` namespace (jax >= 0.6), and its replication-check kwarg was
+  renamed ``check_rep`` -> ``check_vma`` along the way.  Import
+  ``shard_map`` from HERE, call it with the modern ``check_vma=``
+  spelling, and the shim translates for whichever jax is installed.
+- ``jax.lax.axis_size`` (new) vs ``jax.core.axis_frame(...).size``
+  (0.4.x) for the static mesh-axis size inside a mapped function.
+- ``pltpu.force_tpu_interpret_mode`` (new) vs per-call
+  ``pallas_call(..., interpret=True)`` (0.4.x) for running pallas TPU
+  kernels on CPU in tests.
+
+Import cost is one ``inspect.signature`` call at module import; the
+returned callable adds a dict lookup per *trace*, never per step (the
+wrapped function is what jit retraces, not this adapter).
+"""
+
+from __future__ import annotations
+
+import contextlib as _contextlib
+import inspect
+
+try:  # jax >= 0.6: promoted to the top-level namespace
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4.x/0.5.x: still experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+_HAS_VMA = "check_vma" in _PARAMS
+_HAS_REP = "check_rep" in _PARAMS
+
+
+def shard_map(f, *args, **kwargs):
+    """``jax.shard_map`` with the ``check_vma``/``check_rep`` kwarg
+    translated to whatever this jax version's signature expects (the
+    two are the same switch under different names; older jax raises
+    ``TypeError`` on the newer spelling and vice versa)."""
+    if not _HAS_VMA and "check_vma" in kwargs:
+        v = kwargs.pop("check_vma")
+        if _HAS_REP:
+            kwargs["check_rep"] = v
+    elif not _HAS_REP and "check_rep" in kwargs:
+        v = kwargs.pop("check_rep")
+        if _HAS_VMA:
+            kwargs["check_vma"] = v
+    return _shard_map(f, *args, **kwargs)
+
+
+def cost_analysis(compiled) -> dict:
+    """``Compiled.cost_analysis()`` as a flat dict: jax 0.4.x returned
+    a one-element list of per-device dicts, newer jax the dict
+    itself."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis, from inside the mapped
+    function (``jax.lax.axis_size`` where it exists; the 0.4.x axis
+    frame otherwise — both return a python int usable in shape
+    arithmetic and divisibility checks at trace time)."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    from jax import core
+
+    frame = core.axis_frame(axis_name)
+    # 0.4.x returned the bare int for a while, then an AxisEnvFrame
+    return frame if isinstance(frame, int) else frame.size
+
+
+@_contextlib.contextmanager
+def force_tpu_interpret_mode():
+    """Run pallas TPU kernels in interpret mode (CPU emulation).
+
+    Delegates to ``pltpu.force_tpu_interpret_mode`` when this jax has
+    it; on 0.4.x — where interpret mode is a per-call kwarg — the shim
+    swaps ``pl.pallas_call`` for a wrapper that injects
+    ``interpret=True`` (every kernel in this repo calls through the
+    module attribute, so the swap is visible to all of them).  Test
+    scaffolding only: never wrap a production path in this."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    native = getattr(pltpu, "force_tpu_interpret_mode", None)
+    if native is not None:
+        with native():
+            yield
+        return
+    orig = pl.pallas_call
+
+    def interpreted(*args, **kwargs):
+        kwargs.setdefault("interpret", True)
+        return orig(*args, **kwargs)
+
+    pl.pallas_call = interpreted
+    try:
+        yield
+    finally:
+        pl.pallas_call = orig
+
+
+__all__ = ["shard_map", "axis_size", "force_tpu_interpret_mode"]

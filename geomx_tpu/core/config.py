@@ -432,6 +432,29 @@ class Config:
     #                         decide-under-lock semantics are unchanged.
     #                         Deterministic mode forces 1 (see
     #                         kvstore.common.resolve_server_shards)
+    merge_backend: str = "auto"  # server merge lane engine
+    #                              (kvstore/backend.py): "numpy" = the
+    #                              host reference path (native threaded
+    #                              axpy; bit-identical to the
+    #                              pre-backend servers), "jax" = staged
+    #                              H2D + jitted donated-argument
+    #                              accumulate, party aggregation as
+    #                              shard_map+psum over the device mesh,
+    #                              "auto" = jax iff an accelerator
+    #                              backend is live (TPU/GPU), else
+    #                              numpy.  Deterministic mode FORCES
+    #                              numpy.  GEOMX_MERGE_BACKEND is
+    #                              honored as an env fallback for
+    #                              directly-constructed Configs (see
+    #                              kvstore.backend.resolve_merge_backend)
+    merge_quantized: bool = False  # EQuARX-style rung for the jax
+    #                                backend's mesh collective: route
+    #                                party aggregation through the int8
+    #                                block-quantized psum
+    #                                (parallel/quantized_allreduce.py)
+    #                                instead of the exact f32 psum.
+    #                                Opt-in: bounded quantization error
+    #                                per round (docs/merge-backends.md)
     heartbeat_interval_s: float = 0.0   # 0 = off
     heartbeat_timeout_s: float = 10.0
     # --- crash-tolerant membership (heartbeat-driven ACTUATION; requires
@@ -747,6 +770,9 @@ class Config:
             ),
             server_merge_threads=_env_int("GEOMX_SERVER_MERGE_THREADS", 0),
             server_shards=_env_int("GEOMX_SERVER_SHARDS", 0),
+            merge_backend=os.environ.get("GEOMX_MERGE_BACKEND", "auto")
+            or "auto",
+            merge_quantized=_env_bool("GEOMX_MERGE_QUANTIZED"),
             heartbeat_interval_s=_env_float(
                 "GEOMX_HEARTBEAT_INTERVAL", _env_float("PS_HEARTBEAT_INTERVAL", 0.0)
             ),

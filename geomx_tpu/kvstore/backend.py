@@ -1,0 +1,217 @@
+"""Pluggable merge backends for the server aggregation lanes.
+
+Both server tiers accumulate gradient pushes per key on their
+``ShardExecutor`` lanes (kvstore/common.py).  The MERGE itself —
+first-push accumulator seeding, ``acc += v``, the weighted mean at
+round close — is delegated to a :class:`MergeBackend` so the same lane
+machinery can run host-side (numpy + the native threaded axpy, the
+default and the semantic reference) or on an accelerator
+(:mod:`geomx_tpu.kvstore.jax_backend`: staged H2D + jitted
+donated-argument accumulate, ``shard_map`` + ``psum`` party aggregation
+over a device mesh).
+
+Contract every backend honors:
+
+- **dtype promotion**: the accumulator is float32 whatever the push
+  payload dtype (f16 pushes promote on the first touch — the same rule
+  ``_adopt_or_copy`` always enforced).
+- **donated-buffer adopt**: a push whose ``Message.donated`` flag
+  transfers ownership may be adopted as the accumulator without a copy
+  (numpy path) or consumed by the single staged H2D copy (jax path);
+  a NON-donated payload is never aliased or mutated.
+- **opaque accumulator**: ``_KeyState.accum`` holds whatever
+  :meth:`MergeBackend.seed` returned; the only operations the servers
+  apply to it are the backend's own methods plus ``.nbytes`` (memory
+  accounting).  Paths that need a host array (optimizer update, WAN
+  pack, row-sparse scatter) call :meth:`MergeBackend.materialize`.
+
+``NumpyBackend`` is extracted verbatim from the pre-backend server hot
+loop and stays the default: with it, every merge is bit-identical to
+HEAD and the ``deterministic`` suite is unaffected (deterministic mode
+FORCES numpy — device dispatch order is not replayable).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Optional
+
+import numpy as np
+
+from geomx_tpu.native.bindings import accumulate as _native_accumulate
+
+
+def _adopt_or_copy(v: np.ndarray, donated: bool) -> np.ndarray:
+    """First-push accumulator seed: adopt the wire buffer when the sender
+    transferred ownership (``Message.donated``) and it is mutable;
+    otherwise take the defensive copy — in-proc delivery is by reference,
+    so a non-donated payload may alias the sender's live data, and a
+    frozen payload is an immutability promise to OTHER aliases."""
+    acc = np.ascontiguousarray(v, dtype=np.float32)
+    if donated and acc.flags.writeable:
+        return acc
+    if np.may_share_memory(acc, v):
+        acc = acc.copy()  # never alias (or mutate) the wire buffer
+    return acc
+
+
+class MergeBackend:
+    """One server's merge engine (one instance per server; its methods
+    run concurrently from that server's merge lanes, each key confined
+    to one lane).
+
+    ``max_lanes`` caps the server's lane count when the backend cannot
+    merge more streams in parallel than that (a single device stream
+    serializes dispatch; extra lanes only add contention) — ``None``
+    leaves :func:`geomx_tpu.kvstore.common.resolve_server_shards`
+    alone."""
+
+    name = "abstract"
+    max_lanes: Optional[int] = None
+
+    def seed(self, v: np.ndarray, donated: bool):
+        """First push of a round: build and return the accumulator
+        (f32-promoted; adopt ``v`` only under the donation contract)."""
+        raise NotImplementedError
+
+    def accumulate(self, acc, v: np.ndarray):
+        """Merge one push into the accumulator; returns the (possibly
+        replaced) accumulator handle."""
+        raise NotImplementedError
+
+    def scale(self, acc, s: float):
+        """In-place weighted mean at round close (the HFA convex
+        renormalization); returns the accumulator handle."""
+        raise NotImplementedError
+
+    def materialize(self, acc) -> np.ndarray:
+        """The accumulator as a host f32 ndarray the server owns (the
+        identity on the numpy path — NO copy; a device sync + one D2H
+        on an accelerator path)."""
+        raise NotImplementedError
+
+    def stats(self) -> dict:
+        """Observability: merged into the server's QUERY_STATS body."""
+        return {"merge_backend": self.name}
+
+    def stop(self) -> None:  # release device handles, if any
+        pass
+
+
+def _accumulate_kernel():
+    """The threaded host accumulate, resolved late through the server
+    module when it is loaded: ``tests/test_sharded_merge`` wedges a
+    lane by rebinding ``kvstore.server._native_accumulate``, and that
+    published patch point must keep working now the call site lives
+    here."""
+    srv = sys.modules.get("geomx_tpu.kvstore.server")
+    if srv is not None:
+        return srv._native_accumulate
+    return _native_accumulate
+
+
+class NumpyBackend(MergeBackend):
+    """The host merge path, verbatim from the pre-backend server hot
+    loop: adopt-or-copy seed, native threaded axpy accumulate (numpy
+    fallback inside the binding), ``np.multiply(..., out=)`` scale.
+    Bit-identical to HEAD by construction — zero-copy recv views flow
+    straight into the accumulator, no host copy is added anywhere."""
+
+    name = "numpy"
+
+    def __init__(self, config=None):
+        self._threads = int(getattr(config, "server_merge_threads", 0)
+                            or 0)
+
+    def seed(self, v: np.ndarray, donated: bool) -> np.ndarray:
+        return _adopt_or_copy(v, donated)
+
+    def accumulate(self, acc: np.ndarray, v: np.ndarray) -> np.ndarray:
+        # native threaded merge for big tensors (the server hot loop;
+        # ref: kvstore_dist_server.h:1277-1296)
+        _accumulate_kernel()(acc, np.ascontiguousarray(v, np.float32),
+                             self._threads)
+        return acc
+
+    def scale(self, acc: np.ndarray, s: float) -> np.ndarray:
+        np.multiply(acc, s, out=acc)
+        return acc
+
+    def materialize(self, acc) -> np.ndarray:
+        return acc  # row-sparse scatters hand host arrays through too
+
+
+# one probe per process: jax backend-liveness can cost a device query
+_probe_mu = threading.Lock()
+_accel_live: Optional[bool] = None
+
+
+def _accelerator_live() -> bool:
+    """True iff importing jax would land on a non-CPU backend.  Fast
+    False (no jax import) when the platform env pins cpu — the tier-1
+    / CI posture — so ``auto`` never pays backend-init latency on a
+    host that provably has no accelerator."""
+    global _accel_live
+    for var in ("JAX_PLATFORMS", "JAX_PLATFORM_NAME"):
+        val = os.environ.get(var, "")
+        if val and all(p.strip().lower() == "cpu"
+                       for p in val.split(",") if p.strip()):
+            return False
+    with _probe_mu:
+        if _accel_live is None:
+            try:
+                import jax
+
+                _accel_live = jax.default_backend() != "cpu"
+            except Exception:
+                _accel_live = False
+        return _accel_live
+
+
+def resolve_merge_backend(config) -> str:
+    """The effective backend name for a server: ``Config.merge_backend``
+    (``auto`` | ``numpy`` | ``jax``), with ``GEOMX_MERGE_BACKEND`` as
+    the env fallback for directly-constructed Configs (the way
+    GEOMX_SERVER_SHARDS shakes the striped-merge path, so a whole test
+    suite runs under the jax lanes without threading the knob through
+    every fixture).  Rules:
+
+    - ``deterministic`` FORCES numpy — device dispatch completion order
+      is not replayable run-to-run.
+    - ``auto`` picks jax iff an accelerator backend is live (TPU/GPU);
+      plain CPU hosts keep the numpy reference path.
+    - an explicit ``jax`` on a host whose jax cannot import degrades to
+      numpy loudly at construction (:func:`make_merge_backend`)."""
+    if getattr(config, "deterministic", False):
+        return "numpy"
+    choice = (getattr(config, "merge_backend", "") or "").strip().lower()
+    if choice in ("", "auto"):
+        env = os.environ.get("GEOMX_MERGE_BACKEND", "").strip().lower()
+        choice = env or "auto"
+    if choice == "numpy":
+        return "numpy"
+    if choice == "jax":
+        return "jax"
+    if choice != "auto":
+        raise ValueError(
+            f"unknown merge_backend {choice!r} (auto|numpy|jax)")
+    return "jax" if _accelerator_live() else "numpy"
+
+
+def make_merge_backend(config, node: str = "?") -> MergeBackend:
+    """Construct the resolved backend; an explicit-jax host whose jax
+    stack cannot build one degrades to numpy with a printed reason
+    instead of taking the server down (the merge must never be the
+    component that can't boot)."""
+    kind = resolve_merge_backend(config)
+    if kind == "jax":
+        try:
+            from geomx_tpu.kvstore.jax_backend import JaxBackend
+
+            return JaxBackend(config)
+        except Exception as e:  # missing/broken jax: gate, don't crash
+            print(f"[{node}] merge backend 'jax' unavailable "
+                  f"({type(e).__name__}: {e}); falling back to numpy")
+    return NumpyBackend(config)

@@ -170,6 +170,24 @@ class ShardExecutor:
                 q.put(None)
 
 
+def make_merge_lanes(config, node, backend=None):
+    """Both server tiers construct their stripe lock + merge lanes
+    HERE, per merge backend: the lane count starts from
+    :func:`resolve_server_shards` and is then capped by the backend's
+    ``max_lanes`` (a device-dispatch backend serializes on its stream —
+    lanes beyond its cap only contend, they cannot overlap device
+    work).  The stripe count always equals the lane count: stripes
+    guard the per-key state the lanes mutate, so they cap together.
+    Deterministic mode still forces 1 of each (resolve_server_shards),
+    whatever the backend."""
+    n = resolve_server_shards(config)
+    cap = getattr(backend, "max_lanes", None) if backend is not None else None
+    if cap:
+        n = min(n, max(1, int(cap)))
+    mu = StripedRLock(n)
+    return mu, ShardExecutor(n, name=f"merge-{node}")
+
+
 _codec_pool = None
 _codec_pool_mu = threading.Lock()
 

@@ -1,0 +1,272 @@
+"""JAX/XLA merge backend: party aggregation on the device mesh.
+
+The ROADMAP's founding premise is that a TPU pod slice acts as one
+GeoMX "data center" — yet the host numpy path merged every intra-DC
+gradient on CPU.  This backend lowers the server merge lanes onto the
+device:
+
+- each push is **staged exactly once** (one H2D ``device_put`` of the
+  zero-copy recv view; ``h2d_bytes`` counts them) into a pinned f32
+  device buffer;
+- with a single device, pushes fold in arrival order through a jitted
+  **donated-argument** accumulate (``donate_argnums=(0,)`` — XLA
+  reuses the accumulator buffer, no per-push allocation), the device
+  analog of the native axpy path;
+- with a **multi-device mesh** (``parallel/mesh.py``) and big tensors,
+  each push parks pre-reduced on a round-robin device slot and the
+  round close reduces across slots with ``shard_map`` +
+  ``jax.lax.psum`` — whole-party aggregation as one XLA collective
+  over ICI, exactly how ``dp.make_party_step`` reduces inside a jit;
+- the opt-in EQuARX rung (``Config.merge_quantized``) routes that
+  collective through :func:`quantized_psum_mean` instead, so intra-DC
+  traffic gets the same int8 compression treatment the WAN ladder has
+  (error <= 2 * block_absmax / 254 per element — see
+  parallel/quantized_allreduce.py; never use it under optimizers that
+  assume exact sums without error feedback).
+
+Accumulators are :class:`_DeviceAccum` handles; the servers only touch
+them through the backend methods plus ``.nbytes``.  Row-sparse
+scatters stay host-side (``np.add.at`` has no device analog worth the
+transfer) — :meth:`materialize` hands host arrays through unchanged
+and :meth:`accumulate` falls back to the host kernel when it meets
+one, so mixed dense/row-sparse rounds of one key stay correct.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from geomx_tpu.kvstore.backend import (MergeBackend, _accumulate_kernel,
+                                       _adopt_or_copy)
+
+# below this many elements the mesh collective loses to a plain add
+# (dispatch + cross-device assembly dominate); overridable so the CPU
+# test mesh can exercise the psum path on small tensors
+_MESH_MIN_ELEMS = int(os.environ.get("GEOMX_MERGE_MESH_MIN_ELEMS",
+                                     str(1 << 16)))
+
+
+class _DeviceAccum:
+    """One key's in-flight round on the device: up to one pre-reduced
+    buffer per mesh device (``spread`` mode) or a single folded buffer
+    (single-device mode).  Confined to the key's merge lane — no lock.
+    """
+
+    __slots__ = ("parts", "elems", "spread", "count")
+
+    def __init__(self, part, elems: int, spread: bool):
+        self.parts: List = [part]
+        self.elems = elems
+        self.spread = spread
+        self.count = 1
+
+    @property
+    def nbytes(self) -> int:  # device-resident f32 bytes (stats())
+        return 4 * self.elems * len(self.parts)
+
+    def tobytes(self) -> bytes:
+        """White-box escape hatch (tests snapshot ``accum.tobytes()``):
+        the pending parts as the host bytes a numpy accumulator would
+        hold.  Single-part accums transfer without reducing; multi-part
+        (mesh-spread) accums fold host-side so peeking never perturbs
+        the device-resident round state."""
+        if len(self.parts) == 1:
+            return np.asarray(self.parts[0]).tobytes()
+        total = np.zeros(self.elems, np.float32)
+        for p in self.parts:
+            total += np.asarray(p)
+        return total.tobytes()
+
+
+class JaxBackend(MergeBackend):
+    name = "jax"
+    # a device stream serializes dispatch; more lanes than this only
+    # contend on the dispatch lock without overlapping device work
+    max_lanes = 4
+
+    def __init__(self, config=None):
+        import jax  # deliberate: constructing this backend IS the opt-in
+        import jax.numpy as jnp
+
+        self._jax = jax
+        self._jnp = jnp
+        self._devices = list(jax.devices())
+        self._threads = int(getattr(config, "server_merge_threads", 0)
+                            or 0)
+        self._quantized = bool(getattr(config, "merge_quantized", False))
+        self._platform = self._devices[0].platform
+        # donated-argument accumulate: XLA writes the sum back into the
+        # accumulator's buffer — the device analog of acc += v
+        self._add = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+        # scale takes the factor as an f32 ARRAY argument: a python
+        # float would be baked into the jaxpr and retrace per distinct
+        # HFA renormalization value
+        self._scale = jax.jit(lambda a, s: a * s, donate_argnums=(0,))
+        self._mesh_cache: Dict[int, object] = {}
+        self._reducers: Dict[tuple, object] = {}
+        self._mu = threading.Lock()  # counters + caches (leaf lock)
+        self.h2d_bytes = 0
+        self.merge_device_ms = 0.0
+
+    # ---- staging ------------------------------------------------------------
+    def _stage(self, v: np.ndarray, device):
+        """One H2D copy of the (possibly zero-copy wire view) payload,
+        f32-promoted.  ``ascontiguousarray`` is the identity for the
+        aligned f32 views wire format v2 decodes, so the device_put
+        reads straight out of the receive buffer."""
+        arr = np.ascontiguousarray(v, dtype=np.float32)
+        staged = self._jax.device_put(arr, device)
+        with self._mu:
+            self.h2d_bytes += arr.nbytes
+        return staged
+
+    def seed(self, v: np.ndarray, donated: bool):
+        # the donation contract is honored trivially here: the wire
+        # buffer is consumed by the single staged H2D copy and never
+        # aliased or mutated afterwards
+        t0 = time.perf_counter()
+        spread = (len(self._devices) > 1
+                  and len(v) >= _MESH_MIN_ELEMS)
+        acc = _DeviceAccum(self._stage(v, self._devices[0]), len(v),
+                           spread)
+        self._bill(t0)
+        return acc
+
+    def accumulate(self, acc, v: np.ndarray):
+        if isinstance(acc, np.ndarray):
+            # a row-sparse scatter seeded this key host-side: stay on
+            # the host kernel for the rest of the round
+            _accumulate_kernel()(acc,
+                                 np.ascontiguousarray(v, np.float32),
+                                 self._threads)
+            return acc
+        t0 = time.perf_counter()
+        if not acc.spread:
+            staged = self._stage(v, self._devices[0])
+            acc.parts[0] = self._add(acc.parts[0], staged)
+        else:
+            # round-robin device slots: contribution i lands on device
+            # i % n, pre-reduced per slot in arrival order; the round
+            # close psums ACROSS the slots
+            slot = acc.count % len(self._devices)
+            staged = self._stage(v, self._devices[slot])
+            if slot < len(acc.parts):
+                acc.parts[slot] = self._add(acc.parts[slot], staged)
+            else:
+                acc.parts.append(staged)
+        acc.count += 1
+        self._bill(t0)
+        return acc
+
+    # ---- round close --------------------------------------------------------
+    def scale(self, acc, s: float):
+        if isinstance(acc, np.ndarray):
+            np.multiply(acc, s, out=acc)
+            return acc
+        t0 = time.perf_counter()
+        part = self._reduced(acc)
+        acc.parts = [self._scale(part, np.float32(s))]
+        self._bill(t0)
+        return acc
+
+    def materialize(self, acc) -> np.ndarray:
+        if isinstance(acc, np.ndarray):
+            return acc
+        t0 = time.perf_counter()
+        host = np.asarray(self._reduced(acc))  # block + one D2H
+        if not host.flags.writeable:
+            # the CPU jax backend hands out a read-only view of the
+            # device buffer; the server OWNS the materialized round
+            # (optimizer builds the update in it — donated contract)
+            host = host.copy()
+        self._bill(t0)
+        return host
+
+    def _reduced(self, acc: "_DeviceAccum"):
+        if len(acc.parts) == 1:
+            return acc.parts[0]
+        part = self._mesh_reduce(acc.parts, acc.elems)
+        acc.parts = [part]
+        return part
+
+    # ---- mesh collective ----------------------------------------------------
+    def _submesh(self, k: int):
+        """A ``{"party": k}`` mesh over the first k devices (cached):
+        slot i's pre-reduced buffer is already resident on device i, so
+        the global array assembles below with zero copies."""
+        mesh = self._mesh_cache.get(k)
+        if mesh is None:
+            from geomx_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh({"party": k}, devices=self._devices[:k])
+            with self._mu:
+                self._mesh_cache[k] = mesh
+        return mesh
+
+    def _reducer(self, k: int, elems: int):
+        key = (k, elems, self._quantized)
+        red = self._reducers.get(key)
+        if red is not None:
+            return red
+        from jax.sharding import PartitionSpec as P
+
+        from geomx_tpu.compat import shard_map
+
+        jax = self._jax
+        mesh = self._submesh(k)
+        if self._quantized:
+            from geomx_tpu.parallel.quantized_allreduce import (
+                quantized_psum_mean)
+
+            def body(x):  # [1, elems] per device
+                # quantized mean * k = the party SUM the round-close
+                # consumers expect (the global optimizer divides by
+                # num_contributors itself)
+                return (quantized_psum_mean(x[0], "party", k)
+                        * np.float32(k))[None]
+        else:
+            def body(x):
+                return jax.lax.psum(x, "party")
+
+        red = jax.jit(shard_map(body, mesh=mesh, in_specs=P("party"),
+                                out_specs=P("party"), check_vma=False))
+        with self._mu:
+            self._reducers[key] = red
+        return red
+
+    def _mesh_reduce(self, parts: List, elems: int):
+        """Cross-slot party aggregation as one XLA collective: assemble
+        the [k, elems] global array from the per-device resident
+        buffers (no copies — each shard is already where the sharding
+        wants it) and psum over the ``party`` axis.  Returns the summed
+        [elems] buffer on device 0."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        k = len(parts)
+        mesh = self._submesh(k)
+        sharding = NamedSharding(mesh, P("party"))
+        global_arr = self._jax.make_array_from_single_device_arrays(
+            (k, elems), sharding,
+            [p.reshape(1, elems) for p in parts])
+        out = self._reducer(k, elems)(global_arr)  # [k, elems], rows equal
+        return out[0]
+
+    # ---- observability ------------------------------------------------------
+    def _bill(self, t0: float) -> None:
+        dt = (time.perf_counter() - t0) * 1e3
+        with self._mu:
+            self.merge_device_ms += dt
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"merge_backend": self.name,
+                    "merge_device": self._platform,
+                    "merge_devices": len(self._devices),
+                    "merge_quantized": self._quantized,
+                    "merge_device_ms": round(self.merge_device_ms, 3),
+                    "h2d_bytes": self.h2d_bytes}

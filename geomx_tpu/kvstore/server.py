@@ -36,10 +36,10 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from geomx_tpu.core.config import Config, Group, NodeId, Topology
+from geomx_tpu.kvstore.backend import _adopt_or_copy, make_merge_backend
 from geomx_tpu.kvstore.common import (APP_PS, Cmd, Ctrl, RecentRequests,
-                                      ShardExecutor, StripedRLock,
                                       codec_pool, codec_pool_depth,
-                                      resolve_server_shards)
+                                      make_merge_lanes)
 from geomx_tpu.native.bindings import accumulate as _native_accumulate
 from geomx_tpu.obs.flight import FlightEv, attach_server_pressure
 from geomx_tpu.optim import DCASGD, ServerOptimizer, Sgd, make_optimizer
@@ -135,20 +135,6 @@ def _mutable(arr: np.ndarray) -> np.ndarray:
     the optimizer result — ``ServerOptimizer.update`` never writes
     ``weight`` in place) need no gate."""
     return arr if arr.flags.writeable else arr.copy()
-
-
-def _adopt_or_copy(v: np.ndarray, donated: bool) -> np.ndarray:
-    """First-push accumulator seed: adopt the wire buffer when the sender
-    transferred ownership (``Message.donated``) and it is mutable;
-    otherwise take the defensive copy — in-proc delivery is by reference,
-    so a non-donated payload may alias the sender's live data, and a
-    frozen payload is an immutability promise to OTHER aliases."""
-    acc = np.ascontiguousarray(v, dtype=np.float32)
-    if donated and acc.flags.writeable:
-        return acc
-    if np.may_share_memory(acc, v):
-        acc = acc.copy()  # never alias (or mutate) the wire buffer
-    return acc
 
 
 class _KeyState:
@@ -283,9 +269,14 @@ class LocalServer:
         # unchanged.  server_shards=1 (the deterministic default, and
         # the auto default on 1-core hosts) collapses both to the old
         # single server RLock with inline merges.
-        self._mu = StripedRLock(resolve_server_shards(self.config))
-        self._shards = ShardExecutor(self._mu.n,
-                                     name=f"merge-{postoffice.node}")
+        # pluggable merge engine for the lanes below (kvstore/backend.py:
+        # numpy = the host reference path, jax = staged device merge;
+        # deterministic forces numpy).  The lanes themselves are built
+        # per-backend — a device backend caps how many can usefully run.
+        self._backend = make_merge_backend(self.config,
+                                           str(postoffice.node))
+        self._mu, self._shards = make_merge_lanes(
+            self.config, postoffice.node, self._backend)
         self._ctr_mu = threading.Lock()  # leaf lock for shared counters
         #                                  bumped from parallel lanes
         from geomx_tpu.trace.recorder import get_tracer
@@ -297,6 +288,9 @@ class LocalServer:
         # this server's merge-pressure sources; None when disabled
         self._flight = postoffice.flight
         attach_server_pressure(self._flight, self._mu, self._shards)
+        if self._flight is not None:
+            self._flight.record(FlightEv.MERGE_BACKEND, a=self._mu.n,
+                                note=self._backend.name)
         self._recent = RecentRequests()  # replayed-push dedup
         self.server = KVServer(APP_PS, 0, postoffice, self._handle)
         self.server.cmd_handler = self._on_cmd
@@ -908,15 +902,11 @@ class LocalServer:
                 if hfa_n:
                     st.hfa_inv += num_merge / hfa_n
                 if st.accum is None:
-                    st.accum = _adopt_or_copy(v, msg.donated)
+                    st.accum = self._backend.seed(v, msg.donated)
                     # fold joins in at the round boundary
                     st.expected = self._workers_target
                 else:
-                    # native threaded merge for big tensors (the server
-                    # hot loop; ref: kvstore_dist_server.h:1277-1296)
-                    _native_accumulate(
-                        st.accum, np.ascontiguousarray(v, np.float32),
-                        self.config.server_merge_threads)
+                    st.accum = self._backend.accumulate(st.accum, v)
                 st.count += num_merge
                 st.priority = msg.priority
                 if (self.sync_mode
@@ -1037,6 +1027,10 @@ class LocalServer:
                     st.accum = np.zeros_like(self.store[key],
                                              dtype=np.float32)
                     st.expected = self._workers_target
+                else:
+                    # a dense push may have seeded this key on a device
+                    # backend; the scatter-add is host-side by design
+                    st.accum = self._backend.materialize(st.accum)
                 np.add.at(st.accum.reshape(-1, cols), row_ids, rows)
                 st.count += 1
                 st.row_sparse = True
@@ -1089,9 +1083,9 @@ class LocalServer:
                 self.hfa_gated_key_rounds += 1
         if (self.hfa_enabled and st.hfa_inv > 0.0
                 and abs(st.hfa_inv - 1.0) > 1e-9):
-            np.multiply(st.accum, 1.0 / st.hfa_inv, out=st.accum)
-        bundle = {"k": k, "v": st.accum, "gated": gated,
-                  "rs": st.row_sparse}
+            st.accum = self._backend.scale(st.accum, 1.0 / st.hfa_inv)
+        bundle = {"k": k, "v": self._backend.materialize(st.accum),
+                  "gated": gated, "rs": st.row_sparse}
         st.hfa_inv = 0.0
         st.accum = None
         st.count = 0
@@ -1922,7 +1916,21 @@ class LocalServer:
             # the reset as a rate collapse
             "uptime_s": self.po.uptime_s(),
             "boot": van.boot,
+            # merge backend observability (kvstore/backend.py):
+            # merge_backend name + the jax path's merge_device_ms /
+            # h2d_bytes, mirrored to the registry for the status console
+            **self._merge_stats(),
         }
+
+    def _merge_stats(self) -> dict:
+        out = self._backend.stats()
+        ms, h2d = out.get("merge_device_ms"), out.get("h2d_bytes")
+        if ms is not None:
+            from geomx_tpu.utils.metrics import system_gauge
+
+            system_gauge(f"{self.po.node}.merge_device_ms").set(ms)
+            system_gauge(f"{self.po.node}.h2d_bytes").set(h2d or 0)
+        return out
 
     def leave_global(self, timeout: float = 30.0) -> dict:
         """Gracefully withdraw this PARTY from the global tier (VERDICT
@@ -1983,6 +1991,7 @@ class LocalServer:
         if self.ts_push_inter is not None:
             self._merge_q.put(None)
         self._shards.stop()
+        self._backend.stop()
         self.server.stop()
         self.up.stop()
 
@@ -2023,10 +2032,12 @@ class GlobalServer:
         # key-sharded merge (see LocalServer): stripe(k) guards key k,
         # ``with self._mu:`` is the all-stripes barrier for party
         # folds, failover fences, replication snapshots and policy
-        # swaps — their atomicity against the data path is unchanged
-        self._mu = StripedRLock(resolve_server_shards(self.config))
-        self._shards = ShardExecutor(self._mu.n,
-                                     name=f"gmerge-{postoffice.node}")
+        # swaps — their atomicity against the data path is unchanged.
+        # Lanes are built per merge backend (kvstore/backend.py).
+        self._backend = make_merge_backend(self.config,
+                                           str(postoffice.node))
+        self._mu, self._shards = make_merge_lanes(
+            self.config, f"g{postoffice.node}", self._backend)
         self._ack_mu = threading.Lock()  # leaf lock: a parked push's
         #                                  remaining-keys set is shared
         #                                  across stripes
@@ -2099,6 +2110,9 @@ class GlobalServer:
         # + this shard's merge-pressure sources; None when disabled
         self._flight = postoffice.flight
         attach_server_pressure(self._flight, self._mu, self._shards)
+        if self._flight is not None:
+            self._flight.record(FlightEv.MERGE_BACKEND, a=self._mu.n,
+                                note=self._backend.name)
         # inter-party TSEngine: after a sync round updates, disseminate
         # the fresh weights to the local servers via the WAN overlay
         # instead of serving N pulls (sync tier only)
@@ -2531,14 +2545,10 @@ class GlobalServer:
             with self._mu.stripe(k):
                 st = self._keys.setdefault(k, _GlobalKeyState())
                 if st.accum is None:
-                    st.accum = _adopt_or_copy(v, msg.donated)
+                    st.accum = self._backend.seed(v, msg.donated)
                     opened = True
                 else:
-                    # native threaded merge for big tensors (the server
-                    # hot loop; ref: kvstore_dist_server.h:1277-1296)
-                    _native_accumulate(
-                        st.accum, np.ascontiguousarray(v, np.float32),
-                        self.config.server_merge_threads)
+                    st.accum = self._backend.accumulate(st.accum, v)
                 st.count += num_merge
                 st.parked_pushes.append(entry)
                 if st.count >= self.num_contributors:
@@ -2591,16 +2601,19 @@ class GlobalServer:
             st.parked_pushes.clear()
             return
         with self._tr.span("global.opt"):
+            # the weighted mean at round close consumes a HOST array
+            # (identity on numpy; device sync + one D2H under jax)
+            accum = self._backend.materialize(st.accum)
             if hfa_delta:
                 # milestone deltas come pre-divided by num_global_workers;
                 # apply additively (ref: HandleHFAAccumulate :959-972)
-                self.store[k] = self.store[k] + st.accum
+                self.store[k] = self.store[k] + accum
             else:
                 # accum is donated: update_scaled may build the new
                 # weights in it, skipping the /num temporary and the
                 # result allocation (big-tensor hot path)
                 self.store[k] = self.optimizer.update_scaled(
-                    k, self.store[k], st.accum,
+                    k, self.store[k], accum,
                     1.0 / self.num_contributors)
         st.accum = None
         st.count = 0
@@ -3515,7 +3528,19 @@ class GlobalServer:
             # restart discrimination (see LocalServer.stats)
             "uptime_s": self.po.uptime_s(),
             "boot": van.boot,
+            # merge backend observability (see LocalServer._merge_stats)
+            **self._merge_stats(),
         }
+
+    def _merge_stats(self) -> dict:
+        out = self._backend.stats()
+        ms, h2d = out.get("merge_device_ms"), out.get("h2d_bytes")
+        if ms is not None:
+            from geomx_tpu.utils.metrics import system_gauge
+
+            system_gauge(f"{self.po.node}.merge_device_ms").set(ms)
+            system_gauge(f"{self.po.node}.h2d_bytes").set(h2d or 0)
+        return out
 
     def stop(self):
         if self._repl is not None:
@@ -3525,4 +3550,5 @@ class GlobalServer:
         if self.ts_inter is not None:
             self.ts_inter.stop()
         self._shards.stop()
+        self._backend.stop()
         self.server.stop()

@@ -719,6 +719,14 @@ def main(argv=None):
                          "serial merge lanes per server (0 = auto "
                          "min(8, cpus); 1 = the single-lock server; "
                          "see docs/perf.md)")
+    ap.add_argument("--merge-backend",
+                    default=os.environ.get("GEOMX_MERGE_BACKEND", "auto"),
+                    choices=["auto", "numpy", "jax"],
+                    help="server merge lane engine: numpy = host "
+                         "reference path (default off-accelerator), "
+                         "jax = on-device accumulate + mesh psum party "
+                         "aggregation, auto = jax iff a TPU/GPU "
+                         "backend is live (see docs/merge-backends.md)")
     ap.add_argument("--optimizer", default="adam",
                     choices=["sgd", "adam", "dcasgd"])
     args = ap.parse_args(argv)
@@ -784,6 +792,7 @@ def main(argv=None):
     if args.obs_interval > 0:
         cfg.obs_interval_s = args.obs_interval
     cfg.server_shards = args.server_shards or cfg.server_shards
+    cfg.merge_backend = args.merge_backend or cfg.merge_backend
     # CLI overrides bypass dataclass construction — re-run the invariant
     # checks so invalid combinations fail here, not as a runtime hang
     cfg.__post_init__()
@@ -883,6 +892,15 @@ def main(argv=None):
         # flight-recorder observable: incident/operator dumps taken
         # during the run (the atexit dump lands after this line)
         feats.append(f"flight_dumps={po.flight.dumps}")
+    # merge backend observable (kvstore/backend.py): which engine this
+    # server's lanes actually ran, + the jax path's device counters
+    be = getattr(role_obj, "_backend", None)
+    if be is not None:
+        bs = be.stats()
+        feats.append(f"merge_backend={bs.get('merge_backend')}")
+        if bs.get("h2d_bytes"):
+            feats.append(f"h2d_bytes={bs['h2d_bytes']} "
+                         f"merge_device_ms={bs.get('merge_device_ms')}")
     # global-tier failover observables (replication stream, promotions,
     # term fencing, client-side retarget+replay)
     for attr, tag in (("failover_events", "failover_events"),

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from geomx_tpu.compat import shard_map
 
 from geomx_tpu.parallel.ring_attention import (
     dense_attention, fast_dense_attention, ring_attention)

@@ -85,6 +85,10 @@ class FlightEv(enum.IntEnum):
     WARM_BOOT = 16       # a=keys pulled
     DUMP = 17            # a ring dump was taken (note=incident)
     ALERT = 18           # health transition observed locally
+    MERGE_BACKEND = 19   # server merge engine chosen at boot: a=lane
+    #                      count, note=backend name (numpy/jax) — the
+    #                      postmortem can tell a device-lane server
+    #                      from a host-lane one without its config
 
 
 _EV_NAMES = {int(e): e.name for e in FlightEv}

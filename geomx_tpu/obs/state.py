@@ -168,7 +168,8 @@ class ClusterStateService:
             if self.collector is not None:
                 st = self.collector.latest_stats(holder) or {}
                 for key in ("draining", "policy_epoch",
-                            "num_global_workers", "key_rounds"):
+                            "num_global_workers", "key_rounds",
+                            "merge_backend"):
                     if key in st:
                         entry[key] = st[key]
                 press = self._pressure_of(holder)
@@ -186,7 +187,8 @@ class ClusterStateService:
                      "workers": topo.workers_per_party}
             if self.collector is not None:
                 st = self.collector.latest_stats(server) or {}
-                for key in ("wan_push_rounds", "policy_epoch", "uptime_s"):
+                for key in ("wan_push_rounds", "policy_epoch", "uptime_s",
+                            "merge_backend"):
                     if key in st:
                         entry[key] = st[key]
                 press = self._pressure_of(server)
@@ -335,6 +337,8 @@ def render_text(state: dict) -> str:
             extra += " draining"
         if s.get("key_rounds") is not None:
             extra += f" rounds={int(s['key_rounds'])}"
+        if s.get("merge_backend"):
+            extra += f" merge={s['merge_backend']}"
         lines.append(
             f"  shard {k}: holder={s.get('holder')} term={s.get('term')} "
             f"[{_alive_tag(s.get('alive'))}]"
@@ -346,6 +350,8 @@ def render_text(state: dict) -> str:
         extra = " FOLDED-OUT" if e.get("folded") else ""
         if e.get("wan_push_rounds") is not None:
             extra += f" wan_rounds={int(e['wan_push_rounds'])}"
+        if e.get("merge_backend"):
+            extra += f" merge={e['merge_backend']}"
         lines.append(f"  p{p}: {e.get('server')} "
                      f"[{_alive_tag(e.get('alive'))}]{extra}{_press_tag(e)}")
     replicas = state.get("replicas") or {}

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from geomx_tpu.compat import shard_map
 
 BLOCK = 256  # quantization block (VPU-lane friendly; per-block scale)
 

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Merge-backend smoke lane: run the kvstore/failover/eviction test
+# subset with the server merge lanes forced onto the JAX backend
+# (GEOMX_MERGE_BACKEND shakes directly-constructed Configs too, the way
+# GEOMX_SERVER_SHARDS does for the striped-merge path), so the device
+# merge path cannot silently rot while tier-1 runs the numpy default.
+# JAX_PLATFORMS=cpu: the point is the backend MACHINERY (staged H2D,
+# donated-argument accumulate, mesh psum under the virtual 8-device
+# conftest mesh), not accelerator hardware.
+#
+# Env: PYTEST_ARGS (extra pytest flags), GEOMX_MERGE_BACKEND (default jax)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export JAX_PLATFORM_NAME=cpu
+export GEOMX_MERGE_BACKEND=${GEOMX_MERGE_BACKEND:-jax}
+
+exec python -m pytest -q -m 'not slow' -p no:cacheprovider \
+  tests/test_kvstore.py tests/test_failover.py tests/test_eviction.py \
+  tests/test_sharded_merge.py tests/test_recovery.py \
+  tests/test_merge_backend.py \
+  ${PYTEST_ARGS:-}

@@ -18,8 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
-from jax.experimental.pallas import tpu as pltpu
+from geomx_tpu.compat import shard_map
+from geomx_tpu.compat import force_tpu_interpret_mode
 from jax.sharding import PartitionSpec as P
 
 from geomx_tpu.ops.block_attention import (
@@ -49,7 +49,7 @@ def _qkv(seed=0, dtype=jnp.float32):
 def test_flash_block_forward_matches_ref(q_off, k_off):
     q, k, v = _qkv()
     offs = jnp.array([q_off, k_off], jnp.int32)
-    with pltpu.force_tpu_interpret_mode():
+    with force_tpu_interpret_mode():
         m, l, o = jax.tree_util.tree_map(
             np.asarray, flash_block_attention(q, k, v, offs, True))
     rm, rl, ro = jax.tree_util.tree_map(
@@ -64,7 +64,7 @@ def test_flash_block_forward_matches_ref(q_off, k_off):
 def test_flash_block_noncausal_forward():
     q, k, v = _qkv(seed=3)
     offs = jnp.array([0, 0], jnp.int32)
-    with pltpu.force_tpu_interpret_mode():
+    with force_tpu_interpret_mode():
         m, l, o = jax.tree_util.tree_map(
             np.asarray, flash_block_attention(q, k, v, offs, False))
     rm, rl, ro = jax.tree_util.tree_map(
@@ -90,7 +90,7 @@ def test_flash_block_grads_match_ref(q_off, k_off):
         m, l, o = _block_attn_ref(q, k, v, offs, True)
         return jnp.sum(o ** 2) + jnp.sum(l ** 2) + jnp.sum(m ** 2)
 
-    with pltpu.force_tpu_interpret_mode():
+    with force_tpu_interpret_mode():
         gf = jax.tree_util.tree_map(
             np.asarray, jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v))
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
@@ -115,7 +115,7 @@ def test_ring_attention_flash_matches_dense():
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )
-    with pltpu.force_tpu_interpret_mode():
+    with force_tpu_interpret_mode():
         out = f(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-3, atol=1e-3)
@@ -136,7 +136,7 @@ def test_ring_attention_flash_grads_match_dense():
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )
-    with pltpu.force_tpu_interpret_mode():
+    with force_tpu_interpret_mode():
         gf = jax.tree_util.tree_map(np.asarray, jax.grad(
             lambda a, b, c: jnp.sum(ring(a, b, c) ** 2),
             argnums=(0, 1, 2))(q, k, v))

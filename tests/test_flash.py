@@ -12,7 +12,7 @@ VJP (the path the train step uses).
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.pallas import tpu as pltpu
+from geomx_tpu.compat import force_tpu_interpret_mode
 
 from geomx_tpu.models.transformer import (
     TransformerConfig, _single_device_attention,
@@ -32,7 +32,7 @@ def _qkv(dtype=jnp.float32, seed=0):
 def test_flash_forward_matches_dense_interpret():
     cfg = TransformerConfig(attn_impl="flash")
     q, k, v = _qkv()
-    with pltpu.force_tpu_interpret_mode():
+    with force_tpu_interpret_mode():
         o = np.asarray(_single_device_attention(cfg, q, k, v))
     r = np.asarray(dense_attention(q, k, v, causal=True))
     np.testing.assert_allclose(o, r, rtol=1e-4, atol=1e-4)
@@ -49,7 +49,7 @@ def test_flash_backward_matches_dense_interpret():
     def loss_ref(q, k, v):
         return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
 
-    with pltpu.force_tpu_interpret_mode():
+    with force_tpu_interpret_mode():
         gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
         gf = jax.tree_util.tree_map(np.asarray, gf)
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
@@ -63,7 +63,7 @@ def test_flash_bf16_within_tolerance_interpret():
     """bf16 inputs — the dtype the MFU bench actually times."""
     cfg = TransformerConfig(attn_impl="flash")
     q, k, v = _qkv(jnp.bfloat16, seed=2)
-    with pltpu.force_tpu_interpret_mode():
+    with force_tpu_interpret_mode():
         o = np.asarray(
             _single_device_attention(cfg, q, k, v).astype(jnp.float32))
     r = np.asarray(dense_attention(q, k, v, causal=True)

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import shard_map
+from geomx_tpu.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from geomx_tpu.models.transformer import (
