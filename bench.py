@@ -955,6 +955,78 @@ def child_merge():
 
     w_np, s_np, _ = run("numpy")
     w_jx, s_jx, bs = run("jax")
+
+    # ---- full round close: merge -> optimize -> serve-snapshot ------------
+    # The pure-merge phase above never completes a round, so it measures
+    # accumulate-only machinery.  This phase drives the GLOBAL server
+    # through complete rounds — optimizer update included — then pays
+    # one serve materialization, the event-driven D2H the device
+    # optimizer stage defers everything to (docs/merge-backends.md).
+    close_elems = int(os.environ.get("BENCH_MERGE_CLOSE_ELEMS",
+                                     str(min(elems, 5_000_000))))
+    close_parties, close_rounds = 4, 3
+
+    def run_close(backend: str):
+        import hashlib
+
+        from geomx_tpu.optim import make_optimizer
+
+        cfg = Config(topology=Topology(num_parties=close_parties,
+                                       workers_per_party=1),
+                     merge_backend=backend)
+        sim = Simulation(cfg)
+        try:
+            gs = sim.global_servers[0]
+            gs.server.response = lambda *a, **k: None
+            with gs._mu:
+                gs.optimizer = make_optimizer({"type": "sgd", "lr": 0.1})
+                gs._optimizer_configured = True
+                gs._activate_dev_opt_locked()
+                gs.store[0] = np.zeros(close_elems, np.float32)
+            senders = [sim.topology.server(p)
+                       for p in range(close_parties)]
+            ts = [0]
+
+            def one_round():
+                for i, s in enumerate(senders):
+                    ts[0] += 1
+                    m = Message(sender=s, recipient=gs.po.node,
+                                push=True, request=True,
+                                timestamp=ts[0], cmd=Cmd.DEFAULT,
+                                keys=np.array([0], np.int64),
+                                vals=np.full(close_elems, float(i + 1),
+                                             np.float32),
+                                lens=np.array([close_elems], np.int64))
+                    gs._handle(m, KVPairs(m.keys, m.vals, m.lens),
+                               gs.server)
+                gs._shards.drain()
+
+            one_round()  # warmup (jit compile, device adoption)
+            t0 = time.perf_counter()
+            for _ in range(close_rounds):
+                one_round()
+            wall = time.perf_counter() - t0
+            st_pre = gs._backend.stats()
+            t1 = time.perf_counter()
+            w = gs.store[0]  # THE serve-snapshot materialization
+            serve_ms = (time.perf_counter() - t1) * 1e3
+            st = gs._backend.stats()
+            return {
+                "wall_s": round(wall, 3),
+                "rounds": close_rounds,
+                "serve_snapshot_ms": round(serve_ms, 3),
+                "opt_device": gs.stats().get("opt_device", ""),
+                "round_close_d2h_bytes": st_pre.get("d2h_bytes", 0),
+                "d2h_bytes_after_serve": st.get("d2h_bytes", 0),
+                "weights_md5": hashlib.md5(
+                    np.ascontiguousarray(w).tobytes()).hexdigest(),
+            }
+        finally:
+            sim.shutdown()
+
+    close_np = run_close("numpy")
+    close_jx = run_close("jax")
+
     gb = elems * 4 * pushers * pushes / 1e9
     print(json.dumps({
         "elems": elems, "pushers": pushers, "pushes_per": pushes,
@@ -965,6 +1037,19 @@ def child_merge():
         "speedup": round(w_np / max(w_jx, 1e-9), 2),
         "sums_bit_identical": s_np == s_jx,
         "jax_backend": bs,  # names the platform that actually ran
+        # full round close (merge->optimize->serve-snapshot): the
+        # number the device optimizer stage is judged by.  On a no-TPU
+        # host this measures the CPU-jax MACHINERY (the staging memcpy
+        # with no collective win) — read device: "cpu" as "not a TPU
+        # number"; parity of the trajectories is the real assertion
+        "round_close": {
+            "elems": close_elems, "parties": close_parties,
+            "numpy": close_np, "jax": close_jx,
+            "speedup": round(close_np["wall_s"]
+                             / max(close_jx["wall_s"], 1e-9), 2),
+            "weights_bit_identical":
+                close_np["weights_md5"] == close_jx["weights_md5"],
+        },
         "cpus": os.cpu_count(),
     }))
 
@@ -2231,6 +2316,16 @@ def _compact(record: dict) -> dict:
             "speedup": mg["speedup"],
             "parity": mg.get("sums_bit_identical"),
             "device": (mg.get("jax_backend") or {}).get("merge_device")}
+        rc = mg.get("round_close") or {}
+        if rc.get("speedup") is not None:
+            # full round close (merge->optimize->serve-snapshot) under
+            # the device optimizer stage; d2h is what the serve events
+            # paid — the hot path itself pays none
+            out["merge_backend_speedup"]["round_close"] = rc["speedup"]
+            out["merge_backend_speedup"]["round_close_parity"] = rc.get(
+                "weights_bit_identical")
+            out["round_close_d2h_bytes"] = (rc.get("jax") or {}).get(
+                "round_close_d2h_bytes")
     sd = record.get("serde") or {}
     if sd.get("speedup_encode"):
         out["serde_speedup"] = {"encode": sd["speedup_encode"],

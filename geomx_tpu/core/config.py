@@ -455,6 +455,31 @@ class Config:
     #                                instead of the exact f32 psum.
     #                                Opt-in: bounded quantization error
     #                                per round (docs/merge-backends.md)
+    merge_residual: bool = True  # error-feedback residual for the
+    #                              quantized rung (EQuARX, PAPERS.md):
+    #                              each device slot keeps residual =
+    #                              pre-quant minus dequantized and folds
+    #                              it into the NEXT round's contribution
+    #                              before quantizing, so the int8
+    #                              collective is accuracy-neutral over a
+    #                              run instead of systematically zeroing
+    #                              sub-threshold gradient components.
+    #                              Only meaningful with merge_quantized;
+    #                              GEOMX_MERGE_RESIDUAL=0 disables (the
+    #                              drift-control test does)
+    merge_opt_device: bool = True  # device-resident optimizer stage for
+    #                                the jax merge backend: SET_OPTIMIZER
+    #                                specs the DeviceOptimizer family
+    #                                supports (sgd/momentum/nag/adam)
+    #                                keep per-key weights + moments on
+    #                                device and close each round with
+    #                                one jitted donated update — no D2H
+    #                                on the hot path; host copies happen
+    #                                only at serve/checkpoint/handoff
+    #                                events (docs/merge-backends.md).
+    #                                No effect under the numpy backend;
+    #                                GEOMX_MERGE_OPT_DEVICE=0 keeps the
+    #                                jax backend's optimizer on the host
     heartbeat_interval_s: float = 0.0   # 0 = off
     heartbeat_timeout_s: float = 10.0
     # --- crash-tolerant membership (heartbeat-driven ACTUATION; requires
@@ -773,6 +798,8 @@ class Config:
             merge_backend=os.environ.get("GEOMX_MERGE_BACKEND", "auto")
             or "auto",
             merge_quantized=_env_bool("GEOMX_MERGE_QUANTIZED"),
+            merge_residual=_env_bool("GEOMX_MERGE_RESIDUAL", True),
+            merge_opt_device=_env_bool("GEOMX_MERGE_OPT_DEVICE", True),
             heartbeat_interval_s=_env_float(
                 "GEOMX_HEARTBEAT_INTERVAL", _env_float("PS_HEARTBEAT_INTERVAL", 0.0)
             ),

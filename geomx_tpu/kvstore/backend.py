@@ -71,9 +71,12 @@ class MergeBackend:
     name = "abstract"
     max_lanes: Optional[int] = None
 
-    def seed(self, v: np.ndarray, donated: bool):
+    def seed(self, v: np.ndarray, donated: bool, key: Optional[int] = None):
         """First push of a round: build and return the accumulator
-        (f32-promoted; adopt ``v`` only under the donation contract)."""
+        (f32-promoted; adopt ``v`` only under the donation contract).
+        ``key`` is the ps-key the round belongs to — backends that keep
+        cross-round per-key state (the quantized rung's error-feedback
+        residual) key it here; the numpy path ignores it."""
         raise NotImplementedError
 
     def accumulate(self, acc, v: np.ndarray):
@@ -95,6 +98,21 @@ class MergeBackend:
     def stats(self) -> dict:
         """Observability: merged into the server's QUERY_STATS body."""
         return {"merge_backend": self.name}
+
+    def make_device_optimizer(self, spec: dict):
+        """Optimizer stage of the round close: return a device-resident
+        optimizer for ``spec`` (a ``make_optimizer`` config dict), or
+        None when this backend keeps the optimizer on the host (the
+        numpy path always does; the jax path returns one for the
+        supported family when ``merge_opt_device`` is on).  The server
+        treats a non-None return as "this backend closes rounds without
+        materializing": weights + moments stay device-resident and host
+        copies happen only at serve/checkpoint/handoff events (see
+        :class:`geomx_tpu.kvstore.jax_backend.DeviceOptimizer` for the
+        full contract, including ``export_state``/``import_state`` —
+        the hooks every snapshot path goes through so the trajectory
+        survives failover and reassignment)."""
+        return None
 
     def stop(self) -> None:  # release device handles, if any
         pass
@@ -125,7 +143,8 @@ class NumpyBackend(MergeBackend):
         self._threads = int(getattr(config, "server_merge_threads", 0)
                             or 0)
 
-    def seed(self, v: np.ndarray, donated: bool) -> np.ndarray:
+    def seed(self, v: np.ndarray, donated: bool,
+             key: Optional[int] = None) -> np.ndarray:
         return _adopt_or_copy(v, donated)
 
     def accumulate(self, acc: np.ndarray, v: np.ndarray) -> np.ndarray:
@@ -198,6 +217,21 @@ def resolve_merge_backend(config) -> str:
         raise ValueError(
             f"unknown merge_backend {choice!r} (auto|numpy|jax)")
     return "jax" if _accelerator_live() else "numpy"
+
+
+def resolve_opt_device(config) -> bool:
+    """Whether the jax backend should run the device-resident optimizer
+    stage: ``Config.merge_opt_device`` (default on), with
+    ``GEOMX_MERGE_OPT_DEVICE`` honored as the env override for
+    directly-constructed Configs (so a whole suite can pin the stage
+    off the way GEOMX_MERGE_BACKEND pins the lanes on).  Irrelevant
+    under the numpy backend — the host optimizer is the only stage."""
+    if not bool(getattr(config, "merge_opt_device", True)):
+        return False
+    env = os.environ.get("GEOMX_MERGE_OPT_DEVICE", "").strip().lower()
+    if env:
+        return env not in ("0", "false", "no", "off")
+    return True
 
 
 def make_merge_backend(config, node: str = "?") -> MergeBackend:

@@ -13,6 +13,14 @@ good checkpoint.
 format as bytes — the hot-standby replication stream ships exactly what
 a checkpoint would hold, over the wire instead of disk, so the standby's
 restore path and the crash-restart restore path stay one code path.
+
+The pickled optimizer is ALWAYS the host-numpy ``ServerOptimizer``:
+a server running the device-resident optimizer stage
+(kvstore/jax_backend.py) exports its trajectory through
+``GlobalServer._export_opt_locked()`` before any state reaches this
+module, and re-imports on restore — the slab format is engine-agnostic
+by construction, so checkpoints round-trip between numpy and device
+servers in both directions.
 """
 
 from __future__ import annotations

@@ -18,18 +18,40 @@ device:
   ``jax.lax.psum`` — whole-party aggregation as one XLA collective
   over ICI, exactly how ``dp.make_party_step`` reduces inside a jit;
 - the opt-in EQuARX rung (``Config.merge_quantized``) routes that
-  collective through :func:`quantized_psum_mean` instead, so intra-DC
-  traffic gets the same int8 compression treatment the WAN ladder has
-  (error <= 2 * block_absmax / 254 per element — see
-  parallel/quantized_allreduce.py; never use it under optimizers that
-  assume exact sums without error feedback).
+  collective through the int8 block-quantized psum, and (since
+  ISSUE 11) keeps a per-key **error-feedback residual** per device
+  slot (``Config.merge_residual``, default on): residual = pre-quant
+  minus dequantized, folded into the next round's contribution before
+  quantizing, so the int8 collective is accuracy-neutral over a run
+  instead of systematically zeroing sub-threshold components (see
+  :func:`geomx_tpu.parallel.quantized_allreduce.quantized_psum_mean_ef`);
+- the **device-resident optimizer stage** (``Config.merge_opt_device``,
+  default on): for the supported family (plain/momentum SGD, NAG,
+  Adam) the round close no longer materializes the accumulator to
+  host — :class:`DeviceOptimizer` holds per-key weights and moments on
+  device and applies one jitted ``donate_argnums`` update over the
+  device accumulator.  Host copies happen only at *events*: pulls /
+  dissemination (serve), checkpoint slabs, replication snapshots and
+  HANDOFF drains, all of which go through ``export_state`` /
+  ``DeviceWeight.host()`` and bill ``d2h_bytes`` — the steady-state
+  training contract is that ``d2h_bytes`` stays flat between such
+  events (asserted by tests/test_device_opt.py).
 
 Accumulators are :class:`_DeviceAccum` handles; the servers only touch
 them through the backend methods plus ``.nbytes``.  Row-sparse
 scatters stay host-side (``np.add.at`` has no device analog worth the
 transfer) — :meth:`materialize` hands host arrays through unchanged
 and :meth:`accumulate` falls back to the host kernel when it meets
-one, so mixed dense/row-sparse rounds of one key stay correct.
+one, so mixed dense/row-sparse rounds of one key stay correct (the
+device optimizer re-stages a host-seeded round's accumulator, one H2D,
+and carries on device-resident).
+
+Bit-compatibility: every :class:`DeviceOptimizer` update mirrors its
+numpy reference (:mod:`geomx_tpu.optim.server_opt`) operation-for-
+operation — same op order, same f32 scalar casts — so for
+exact-representable gradients the device trajectory is BITWISE equal
+to the host one (pinned by tests/test_device_opt.py), and a trajectory
+exported at a failover/handoff snapshot restores into either engine.
 """
 
 from __future__ import annotations
@@ -55,15 +77,18 @@ class _DeviceAccum:
     """One key's in-flight round on the device: up to one pre-reduced
     buffer per mesh device (``spread`` mode) or a single folded buffer
     (single-device mode).  Confined to the key's merge lane — no lock.
-    """
+    ``key`` anchors cross-round backend state (the quantized rung's
+    error-feedback residual); None when the server predates the keyed
+    seed API."""
 
-    __slots__ = ("parts", "elems", "spread", "count")
+    __slots__ = ("parts", "elems", "spread", "count", "key")
 
-    def __init__(self, part, elems: int, spread: bool):
+    def __init__(self, part, elems: int, spread: bool, key=None):
         self.parts: List = [part]
         self.elems = elems
         self.spread = spread
         self.count = 1
+        self.key = key
 
     @property
     def nbytes(self) -> int:  # device-resident f32 bytes (stats())
@@ -99,6 +124,11 @@ class JaxBackend(MergeBackend):
         self._threads = int(getattr(config, "server_merge_threads", 0)
                             or 0)
         self._quantized = bool(getattr(config, "merge_quantized", False))
+        from geomx_tpu.kvstore.backend import resolve_opt_device
+
+        self._ef = (self._quantized
+                    and bool(getattr(config, "merge_residual", True)))
+        self._opt_device = resolve_opt_device(config)
         self._platform = self._devices[0].platform
         # donated-argument accumulate: XLA writes the sum back into the
         # accumulator's buffer — the device analog of acc += v
@@ -109,9 +139,16 @@ class JaxBackend(MergeBackend):
         self._scale = jax.jit(lambda a, s: a * s, donate_argnums=(0,))
         self._mesh_cache: Dict[int, object] = {}
         self._reducers: Dict[tuple, object] = {}
+        # per-key error-feedback residual for the quantized collective:
+        # key -> (slot count, [k, elems] global array sharded over the
+        # same devices the pre-reduced parts live on).  Mutated only on
+        # the key's merge lane; the dict itself is GIL-safe per key.
+        self._residuals: Dict[int, tuple] = {}
         self._mu = threading.Lock()  # counters + caches (leaf lock)
         self.h2d_bytes = 0
+        self.d2h_bytes = 0
         self.merge_device_ms = 0.0
+        self.opt_device_ms = 0.0
 
     # ---- staging ------------------------------------------------------------
     def _stage(self, v: np.ndarray, device):
@@ -125,7 +162,7 @@ class JaxBackend(MergeBackend):
             self.h2d_bytes += arr.nbytes
         return staged
 
-    def seed(self, v: np.ndarray, donated: bool):
+    def seed(self, v: np.ndarray, donated: bool, key=None):
         # the donation contract is honored trivially here: the wire
         # buffer is consumed by the single staged H2D copy and never
         # aliased or mutated afterwards
@@ -133,7 +170,7 @@ class JaxBackend(MergeBackend):
         spread = (len(self._devices) > 1
                   and len(v) >= _MESH_MIN_ELEMS)
         acc = _DeviceAccum(self._stage(v, self._devices[0]), len(v),
-                           spread)
+                           spread, key=key)
         self._bill(t0)
         return acc
 
@@ -179,6 +216,8 @@ class JaxBackend(MergeBackend):
             return acc
         t0 = time.perf_counter()
         host = np.asarray(self._reduced(acc))  # block + one D2H
+        with self._mu:
+            self.d2h_bytes += host.nbytes
         if not host.flags.writeable:
             # the CPU jax backend hands out a read-only view of the
             # device buffer; the server OWNS the materialized round
@@ -190,7 +229,7 @@ class JaxBackend(MergeBackend):
     def _reduced(self, acc: "_DeviceAccum"):
         if len(acc.parts) == 1:
             return acc.parts[0]
-        part = self._mesh_reduce(acc.parts, acc.elems)
+        part = self._mesh_reduce(acc.parts, acc.elems, acc.key)
         acc.parts = [part]
         return part
 
@@ -208,8 +247,8 @@ class JaxBackend(MergeBackend):
                 self._mesh_cache[k] = mesh
         return mesh
 
-    def _reducer(self, k: int, elems: int):
-        key = (k, elems, self._quantized)
+    def _reducer(self, k: int, elems: int, ef: bool):
+        key = (k, elems, self._quantized, ef)
         red = self._reducers.get(key)
         if red is not None:
             return red
@@ -219,7 +258,21 @@ class JaxBackend(MergeBackend):
 
         jax = self._jax
         mesh = self._submesh(k)
-        if self._quantized:
+        if self._quantized and ef:
+            from geomx_tpu.parallel.quantized_allreduce import (
+                quantized_psum_mean_ef)
+
+            def body(x, r):  # [1, elems] + residual per device slot
+                out, r_new = quantized_psum_mean_ef(x[0], r[0], "party", k)
+                # quantized mean * k = the party SUM the round-close
+                # consumers expect; the residual is already in that
+                # weight-1 contribution domain
+                return (out * np.float32(k))[None], r_new[None]
+
+            red = jax.jit(shard_map(
+                body, mesh=mesh, in_specs=(P("party"), P("party")),
+                out_specs=(P("party"), P("party")), check_vma=False))
+        elif self._quantized:
             from geomx_tpu.parallel.quantized_allreduce import (
                 quantized_psum_mean)
 
@@ -229,22 +282,46 @@ class JaxBackend(MergeBackend):
                 # num_contributors itself)
                 return (quantized_psum_mean(x[0], "party", k)
                         * np.float32(k))[None]
+
+            red = jax.jit(shard_map(body, mesh=mesh, in_specs=P("party"),
+                                    out_specs=P("party"), check_vma=False))
         else:
             def body(x):
                 return jax.lax.psum(x, "party")
 
-        red = jax.jit(shard_map(body, mesh=mesh, in_specs=P("party"),
-                                out_specs=P("party"), check_vma=False))
+            red = jax.jit(shard_map(body, mesh=mesh, in_specs=P("party"),
+                                    out_specs=P("party"), check_vma=False))
         with self._mu:
             self._reducers[key] = red
         return red
 
-    def _mesh_reduce(self, parts: List, elems: int):
+    def _residual_for(self, key, k: int, elems: int):
+        """The [k, elems] error-feedback residual global array for this
+        key, sharded over the first k devices like the pre-reduced
+        parts; fresh zeros when the slot count changed (a party fold
+        re-shapes the round — stale per-slot residuals for a different
+        k would compensate the wrong shards)."""
+        ent = self._residuals.get(key)
+        if ent is not None and ent[0] == k and ent[1].shape[1] == elems:
+            return ent[1]
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self._submesh(k), P("party"))
+        zeros = [self._jax.device_put(np.zeros((1, elems), np.float32),
+                                      self._devices[i]) for i in range(k)]
+        r = self._jax.make_array_from_single_device_arrays(
+            (k, elems), sharding, zeros)
+        self._residuals[key] = (k, r)
+        return r
+
+    def _mesh_reduce(self, parts: List, elems: int, key=None):
         """Cross-slot party aggregation as one XLA collective: assemble
         the [k, elems] global array from the per-device resident
         buffers (no copies — each shard is already where the sharding
-        wants it) and psum over the ``party`` axis.  Returns the summed
-        [elems] buffer on device 0."""
+        wants it) and psum over the ``party`` axis.  Under the
+        quantized rung with error feedback the per-slot residual rides
+        in and the updated residual is kept for the key's next round.
+        Returns the summed [elems] buffer on device 0."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         k = len(parts)
@@ -253,8 +330,30 @@ class JaxBackend(MergeBackend):
         global_arr = self._jax.make_array_from_single_device_arrays(
             (k, elems), sharding,
             [p.reshape(1, elems) for p in parts])
-        out = self._reducer(k, elems)(global_arr)  # [k, elems], rows equal
-        return out[0]
+        ef = self._ef and key is not None
+        if ef:
+            r = self._residual_for(key, k, elems)
+            out, r_new = self._reducer(k, elems, True)(global_arr, r)
+            self._residuals[key] = (k, r_new)
+        else:
+            out = self._reducer(k, elems, False)(global_arr)
+        # out is [k, elems] with equal rows; commit row 0 to device 0 so
+        # downstream single-device consumers (the jitted optimizer
+        # update, the donated scale) see one device, not the mesh
+        return self._jax.device_put(out[0], self._devices[0])
+
+    # ---- optimizer stage ----------------------------------------------------
+    def make_device_optimizer(self, spec: dict):
+        """A :class:`DeviceOptimizer` for ``spec`` when the stage is
+        enabled and the type is in the supported family, else None (the
+        server keeps the host optimizer — DCASGD and friends need
+        per-sender host bookkeeping the device stage doesn't model)."""
+        if not self._opt_device:
+            return None
+        cls = _DEVICE_OPTS.get(str(spec.get("type", "")).lower())
+        if cls is None:
+            return None
+        return cls(self, spec)
 
     # ---- observability ------------------------------------------------------
     def _bill(self, t0: float) -> None:
@@ -262,11 +361,296 @@ class JaxBackend(MergeBackend):
         with self._mu:
             self.merge_device_ms += dt
 
+    def _bill_opt(self, t0: float) -> None:
+        dt = (time.perf_counter() - t0) * 1e3
+        with self._mu:
+            self.opt_device_ms += dt
+
+    def _bill_d2h(self, nbytes: int) -> None:
+        with self._mu:
+            self.d2h_bytes += int(nbytes)
+
     def stats(self) -> dict:
         with self._mu:
             return {"merge_backend": self.name,
                     "merge_device": self._platform,
                     "merge_devices": len(self._devices),
                     "merge_quantized": self._quantized,
+                    "merge_residual": self._ef,
+                    "merge_opt_device": self._opt_device,
                     "merge_device_ms": round(self.merge_device_ms, 3),
-                    "h2d_bytes": self.h2d_bytes}
+                    "opt_device_ms": round(self.opt_device_ms, 3),
+                    "h2d_bytes": self.h2d_bytes,
+                    "d2h_bytes": self.d2h_bytes}
+
+
+class DeviceWeight:
+    """One key's weights, device-resident between round closes.
+
+    The server's store holds this handle instead of a host ndarray
+    while the device optimizer owns the key; any host consumer (pull
+    serving, dissemination, checkpoint/replication/handoff snapshots,
+    the pull compressor) goes through :meth:`host`, which performs —
+    and bills to ``d2h_bytes`` — at most one device→host materialization
+    per round close (cached until the next update replaces the handle).
+    The update never donates the weight buffer: an in-flight pull
+    response may still alias a previous ``host()`` view, and a donated
+    (deleted) buffer under it would be a use-after-free on accelerator
+    backends."""
+
+    __slots__ = ("ref", "_be", "_host")
+
+    def __init__(self, be: "JaxBackend", ref):
+        self.ref = ref
+        self._be = be
+        self._host: Optional[np.ndarray] = None
+
+    @property
+    def nbytes(self) -> int:  # store_bytes accounting without a D2H
+        return int(self.ref.nbytes)
+
+    def __len__(self) -> int:
+        return int(self.ref.shape[0])
+
+    def host(self) -> np.ndarray:
+        if self._host is None:
+            h = np.asarray(self.ref)  # one D2H (zero-copy view on cpu)
+            self._be._bill_d2h(h.nbytes)
+            self._host = h
+        return self._host
+
+
+class DeviceOptimizer:
+    """Device-resident optimizer stage for the jax merge lanes.
+
+    Holds per-key optimizer state (momentum / Adam moments) as device
+    arrays and closes a round with ONE jitted update over the device
+    accumulator — the gradient and the state buffers are donated, the
+    weights are not (see :class:`DeviceWeight`), and nothing touches
+    the host.  Confinement mirrors the merge contract: :meth:`step`
+    runs only on the key's merge lane (stripe held); the snapshot hooks
+    (:meth:`export_state` / :meth:`import_state` / :meth:`import_key`)
+    run only under the server's all-stripes barrier.
+
+    Every update mirrors its :mod:`geomx_tpu.optim.server_opt` numpy
+    reference operation-for-operation (same op order, same weak-scalar
+    f32 casts numpy 2.x applies), so exact-representable gradients
+    produce BITWISE-identical trajectories on either engine — which is
+    what lets a failover/handoff snapshot round-trip through the numpy
+    pickle format and continue on a promoted standby with no
+    trajectory discontinuity."""
+
+    kind = "abstract"
+
+    def __init__(self, be: "JaxBackend", spec: dict):
+        self._be = be
+        self._jax = be._jax
+        self._jnp = be._jnp
+        self.spec = dict(spec)
+        self.lr = float(spec.get("lr", 0.01))
+        self.wd = float(spec.get("wd", 0.0))
+        self._st: Dict[int, dict] = {}
+
+    # ---- hot path -----------------------------------------------------------
+    def step(self, k: int, raw_w, accum, scale: float) -> DeviceWeight:
+        """One round close for key ``k``: semantically
+        ``ServerOptimizer.update_scaled(k, weight, accum, scale)`` with
+        weights/state/accumulator all device-resident.  ``raw_w`` is
+        the store's raw entry — a :class:`DeviceWeight` in steady state,
+        a host ndarray on the key's first device round (adopted with
+        one H2D); ``accum`` is the merge accumulator (device handle, or
+        a host array when a row-sparse scatter seeded the round)."""
+        t0 = time.perf_counter()
+        w = self._weight_ref(raw_w)
+        g = self._grad_ref(accum)
+        new = self._update(k, w, g, float(scale))
+        self._be._bill_opt(t0)
+        return DeviceWeight(self._be, new)
+
+    def add_delta(self, raw_w, accum) -> DeviceWeight:
+        """HFA milestone-delta close: ``weight + accum`` on device (no
+        optimizer state involved — the delta is pre-divided)."""
+        t0 = time.perf_counter()
+        w = self._weight_ref(raw_w)
+        g = self._grad_ref(accum)
+        new = w + g  # NOT the donated add: w must stay alive (aliases)
+        self._be._bill_opt(t0)
+        return DeviceWeight(self._be, new)
+
+    def _weight_ref(self, raw):
+        if isinstance(raw, DeviceWeight):
+            return raw.ref
+        return self._be._stage(np.ascontiguousarray(raw, np.float32),
+                               self._be._devices[0])
+
+    def _grad_ref(self, accum):
+        if isinstance(accum, _DeviceAccum):
+            return self._be._reduced(accum)
+        return self._be._stage(np.ascontiguousarray(accum, np.float32),
+                               self._be._devices[0])
+
+    def _update(self, k: int, w, g, scale: float):
+        raise NotImplementedError
+
+    # ---- snapshot hooks (failover / reassignment / warm boot) ---------------
+    def export_state(self):
+        """The equivalent host :class:`ServerOptimizer` with all per-key
+        state materialized (one D2H per state tensor, billed) — what
+        every snapshot path (checkpoint, replication stream, HANDOFF
+        drain) serializes, so the wire/slab format stays the numpy
+        pickle and a standby on EITHER engine can restore it."""
+        from geomx_tpu.optim import make_optimizer
+
+        opt = make_optimizer(dict(self.spec))
+        for k, st in self._st.items():
+            out = {}
+            for name, v in st.items():
+                if isinstance(v, (int, float)):
+                    out[name] = v
+                else:
+                    h = np.array(v)  # D2H + own the copy (pickled)
+                    self._be._bill_d2h(h.nbytes)
+                    out[name] = h
+            opt.state[k] = out
+        return opt
+
+    def import_state(self, opt) -> None:
+        """Adopt a restored host optimizer's per-key state wholesale
+        (checkpoint restore / replication install / promotion)."""
+        self._st.clear()
+        for k, st in getattr(opt, "state", {}).items():
+            self.import_key(int(k), st)
+
+    def import_key(self, k: int, st: dict) -> None:
+        """Adopt one key's host state (HANDOFF range merge — the
+        shipped key's momentum/moments move with the range)."""
+        out = {}
+        for name, v in st.items():
+            if isinstance(v, np.ndarray):
+                out[name] = self._be._stage(v, self._be._devices[0])
+            else:
+                out[name] = v
+        self._st[k] = out
+
+    def drop_key(self, k: int) -> None:
+        """Discard one key's trajectory (overwrite-INIT restore abort —
+        mirrors ``self.optimizer.state.pop(k, None)``)."""
+        self._st.pop(k, None)
+
+    def stats(self) -> dict:
+        return {"opt_device": self.kind, "opt_device_keys": len(self._st)}
+
+
+class DeviceSgd(DeviceOptimizer):
+    kind = "sgd"
+
+    def __init__(self, be, spec):
+        super().__init__(be, spec)
+        self.momentum = float(spec.get("momentum", 0.0))
+        jax = self._jax
+        if self.momentum == 0.0 and self.wd == 0.0:
+            # numpy Sgd.update_scaled's fast path: new_w = g·c + w with
+            # c = f32(-(lr·scale)) — two passes, grad donated
+            self._upd = jax.jit(lambda g, w, c: g * c + w,
+                                donate_argnums=(0,))
+        elif self.momentum == 0.0:
+            def f(w, g, scale, lr, wd):
+                g = g * scale
+                g = g + wd * w
+                return w - lr * g
+
+            self._upd = jax.jit(f, donate_argnums=(1,))
+        else:
+            def f(w, mom, g, scale, lr, wd, momentum):
+                g = g * scale
+                g = g + wd * w
+                mom = momentum * mom - lr * g
+                return w + mom, mom
+
+            self._upd = jax.jit(f, donate_argnums=(1, 2))
+
+    def _update(self, k, w, g, scale):
+        if self.momentum == 0.0 and self.wd == 0.0:
+            return self._upd(g, w, np.float32(-(self.lr * scale)))
+        if self.momentum == 0.0:
+            return self._upd(w, g, np.float32(scale),
+                             np.float32(self.lr), np.float32(self.wd))
+        st = self._st.get(k)
+        if st is None:
+            st = {"mom": self._jnp.zeros_like(w)}
+            self._st[k] = st
+        new_w, st["mom"] = self._upd(
+            w, st["mom"], g, np.float32(scale), np.float32(self.lr),
+            np.float32(self.wd), np.float32(self.momentum))
+        return new_w
+
+
+class DeviceNag(DeviceOptimizer):
+    kind = "nag"
+
+    def __init__(self, be, spec):
+        super().__init__(be, spec)
+        self.momentum = float(spec.get("momentum", 0.9))
+
+        def f(w, mom, g, scale, lr, wd, momentum):
+            g = g * scale
+            g = g + wd * w
+            mom = momentum * mom + g
+            return w - lr * (g + momentum * mom), mom
+
+        self._upd = self._jax.jit(f, donate_argnums=(1, 2))
+
+    def _update(self, k, w, g, scale):
+        st = self._st.get(k)
+        if st is None:
+            st = {"mom": self._jnp.zeros_like(w)}
+            self._st[k] = st
+        new_w, st["mom"] = self._upd(
+            w, st["mom"], g, np.float32(scale), np.float32(self.lr),
+            np.float32(self.wd), np.float32(self.momentum))
+        return new_w
+
+
+class DeviceAdam(DeviceOptimizer):
+    kind = "adam"
+
+    def __init__(self, be, spec):
+        super().__init__(be, spec)
+        self.beta1 = float(spec.get("beta1", 0.9))
+        self.beta2 = float(spec.get("beta2", 0.999))
+        self.eps = float(spec.get("eps", 1e-8))
+        jnp = self._jnp
+
+        def f(w, m, v, g, scale, b1, one_b1, b2, one_b2, corr1, corr2,
+              lr, eps, wd):
+            g = g * scale
+            g = g + wd * w
+            m = b1 * m + one_b1 * g
+            v = b2 * v + (one_b2 * g) * g
+            mhat = m / corr1
+            vhat = v / corr2
+            return w - lr * mhat / (jnp.sqrt(vhat) + eps), m, v
+
+        self._upd = self._jax.jit(f, donate_argnums=(1, 2, 3))
+
+    def _update(self, k, w, g, scale):
+        st = self._st.get(k)
+        if st is None:
+            st = {"m": self._jnp.zeros_like(w),
+                  "v": self._jnp.zeros_like(w), "t": 0}
+            self._st[k] = st
+        st["t"] += 1
+        # bias corrections computed host-side in f64 then f32-cast —
+        # precisely the weak-scalar cast numpy applies to the division
+        new_w, st["m"], st["v"] = self._upd(
+            w, st["m"], st["v"], g, np.float32(scale),
+            np.float32(self.beta1), np.float32(1 - self.beta1),
+            np.float32(self.beta2), np.float32(1 - self.beta2),
+            np.float32(1 - self.beta1 ** st["t"]),
+            np.float32(1 - self.beta2 ** st["t"]),
+            np.float32(self.lr), np.float32(self.eps),
+            np.float32(self.wd))
+        return new_w
+
+
+_DEVICE_OPTS = {"sgd": DeviceSgd, "nag": DeviceNag, "adam": DeviceAdam}

@@ -173,11 +173,15 @@ class Replicator:
         self._spawn_ship_locked()
 
     def _spawn_ship_locked(self):
-        import copy
-
         gs = self.gs
+        # the optimizer-stage snapshot hook: a device-resident
+        # trajectory (kvstore/jax_backend.py DeviceOptimizer) is
+        # exported to the numpy pickle format here, so the standby can
+        # restore it on either engine; store.items() likewise
+        # materializes device-resident weights (a replication ship IS a
+        # snapshot event in the zero-D2H steady-state contract)
         store_snap = {k: v.copy() for k, v in gs.store.items()}
-        opt_snap = copy.deepcopy(gs.optimizer)
+        opt_snap = gs._export_opt_locked()
         meta = {
             "sync_mode": gs.sync_mode,
             "compression": dict(gs.compression),

@@ -121,6 +121,48 @@ def _store_payload(arrs: List[np.ndarray]) -> np.ndarray:
     return np.concatenate([np.asarray(a, np.float32) for a in arrs])
 
 
+class WeightStore(dict):
+    """``GlobalServer.store`` — a dict whose raw entries are host
+    ndarrays OR device-resident weight handles
+    (:class:`geomx_tpu.kvstore.jax_backend.DeviceWeight`, duck-typed by
+    "not an ndarray, has .host()").
+
+    Reads through the mapping interface always hand back a host f32
+    array: ``store[k]`` / ``.get`` / ``.items()`` materialize a device
+    entry on demand (one D2H, cached in the handle until the next
+    round close replaces it) — which makes every existing host
+    consumer (pull serving, dissemination, checkpoint/replication/
+    handoff snapshots, the pull compressor) an explicit
+    *materialization event* without touching its code.  Paths that
+    must NOT pay a D2H use the raw accessors: ``.values()`` stays raw
+    (both entry kinds expose ``.nbytes`` — the stats accounting),
+    ``.length(k)`` reads a length without materializing, ``.raw(k)``
+    hands the round close the device handle.  Plain host writes
+    (``store[k] = arr``) simply replace the handle — the host array
+    becomes the truth and the next device round re-adopts it."""
+
+    def __getitem__(self, k):
+        v = dict.__getitem__(self, k)
+        if isinstance(v, np.ndarray):
+            return v
+        return v.host()
+
+    def get(self, k, default=None):
+        try:
+            return self[k]
+        except KeyError:
+            return default
+
+    def items(self):
+        return [(k, self[k]) for k in self]
+
+    def raw(self, k):
+        return dict.__getitem__(self, k)
+
+    def length(self, k) -> int:
+        return len(dict.__getitem__(self, k))
+
+
 def _mutable(arr: np.ndarray) -> np.ndarray:
     """THE gate for in-place mutation of a stored array.
 
@@ -902,7 +944,7 @@ class LocalServer:
                 if hfa_n:
                     st.hfa_inv += num_merge / hfa_n
                 if st.accum is None:
-                    st.accum = self._backend.seed(v, msg.donated)
+                    st.accum = self._backend.seed(v, msg.donated, key=k)
                     # fold joins in at the round boundary
                     st.expected = self._workers_target
                 else:
@@ -1930,6 +1972,12 @@ class LocalServer:
 
             system_gauge(f"{self.po.node}.merge_device_ms").set(ms)
             system_gauge(f"{self.po.node}.h2d_bytes").set(h2d or 0)
+            # device->host traffic + optimizer-stage time: the
+            # steady-state zero-D2H contract is audited on these
+            system_gauge(f"{self.po.node}.d2h_bytes").set(
+                out.get("d2h_bytes") or 0)
+            system_gauge(f"{self.po.node}.opt_device_ms").set(
+                out.get("opt_device_ms") or 0)
         return out
 
     def leave_global(self, timeout: float = 30.0) -> dict:
@@ -2027,7 +2075,9 @@ class GlobalServer:
         self.config = config or postoffice.config
         topo = postoffice.topology
         self.num_contributors = topo.num_global_workers
-        self.store: Dict[int, np.ndarray] = {}
+        # host ndarrays and/or device-resident weight handles; reads
+        # through the mapping interface always materialize to host
+        self.store: Dict[int, np.ndarray] = WeightStore()
         self._keys: Dict[int, _GlobalKeyState] = {}
         # key-sharded merge (see LocalServer): stripe(k) guards key k,
         # ``with self._mu:`` is the all-stripes barrier for party
@@ -2072,8 +2122,18 @@ class GlobalServer:
         self._optimizer_configured = False  # flips on SET_OPTIMIZER; a
         #                                     central-worker deployment
         #                                     gates training on it
+        # device-resident optimizer stage (kvstore/jax_backend.py):
+        # non-None when the merge backend runs the round close on
+        # device — weights+moments stay device-resident, host copies
+        # only at serve/checkpoint/handoff events.  ``self.optimizer``
+        # stays the host-semantics shell (type tag, DCASGD fallback,
+        # the pickle format every snapshot round-trips through)
+        self._dev_opt = None
         self.sync_mode = self.config.sync_global_mode
         self.compression: dict = {"type": "none"}
+        # a run that never configures an optimizer still closes rounds
+        # on device under the jax backend (default Sgd is in the family)
+        self._activate_dev_opt_locked()
         self.pull_comp = None  # BroadcastCompressor under bsc/mpq
         self.subscriber_prunes = 0  # departed/evicted subscribers whose
         #                             tracked pull-compressor views were
@@ -2364,7 +2424,7 @@ class GlobalServer:
                             # for a round that will never complete
                             st.accum = None
                             st.count = 0
-                            self.optimizer.state.pop(k, None)
+                            self._drop_opt_key_locked(k)
                             for ent in st.parked_pushes:
                                 ent[1].discard(k)
                                 if not ent[1]:
@@ -2476,7 +2536,12 @@ class GlobalServer:
         lens = []
         for k, _ in pairs:
             with self._mu.stripe(k):
-                lens.append(len(self.store[k]))
+                # raw length — reading through __getitem__ would
+                # materialize a device-resident weight just to size the
+                # decode buffer
+                lens.append(self.store.length(k)
+                            if isinstance(self.store, WeightStore)
+                            else len(self.store[k]))
         pool = codec_pool(self.config) if len(pairs) > 1 else None
         with self._tr.span("codec.decode"):
             if pool is None:
@@ -2545,7 +2610,7 @@ class GlobalServer:
             with self._mu.stripe(k):
                 st = self._keys.setdefault(k, _GlobalKeyState())
                 if st.accum is None:
-                    st.accum = self._backend.seed(v, msg.donated)
+                    st.accum = self._backend.seed(v, msg.donated, key=k)
                     opened = True
                 else:
                     st.accum = self._backend.accumulate(st.accum, v)
@@ -2601,20 +2666,36 @@ class GlobalServer:
             st.parked_pushes.clear()
             return
         with self._tr.span("global.opt"):
-            # the weighted mean at round close consumes a HOST array
-            # (identity on numpy; device sync + one D2H under jax)
-            accum = self._backend.materialize(st.accum)
-            if hfa_delta:
-                # milestone deltas come pre-divided by num_global_workers;
-                # apply additively (ref: HandleHFAAccumulate :959-972)
-                self.store[k] = self.store[k] + accum
+            dev = self._dev_opt
+            if dev is not None:
+                # device-resident round close: the accumulator never
+                # leaves the device — one jitted donated update over it
+                # (grad+state donated; weights functionally replaced).
+                # ZERO D2H here; the store entry becomes a DeviceWeight
+                # that host consumers materialize on demand
+                raw = self.store.raw(k)
+                if hfa_delta:
+                    self.store[k] = dev.add_delta(raw, st.accum)
+                else:
+                    self.store[k] = dev.step(
+                        k, raw, st.accum, 1.0 / self.num_contributors)
             else:
-                # accum is donated: update_scaled may build the new
-                # weights in it, skipping the /num temporary and the
-                # result allocation (big-tensor hot path)
-                self.store[k] = self.optimizer.update_scaled(
-                    k, self.store[k], accum,
-                    1.0 / self.num_contributors)
+                # the weighted mean at round close consumes a HOST
+                # array (identity on numpy; device sync + one D2H
+                # under jax without the device optimizer stage)
+                accum = self._backend.materialize(st.accum)
+                if hfa_delta:
+                    # milestone deltas come pre-divided by
+                    # num_global_workers; apply additively (ref:
+                    # HandleHFAAccumulate :959-972)
+                    self.store[k] = self.store[k] + accum
+                else:
+                    # accum is donated: update_scaled may build the new
+                    # weights in it, skipping the /num temporary and the
+                    # result allocation (big-tensor hot path)
+                    self.store[k] = self.optimizer.update_scaled(
+                        k, self.store[k], accum,
+                        1.0 / self.num_contributors)
         st.accum = None
         st.count = 0
         with self._ack_mu:
@@ -2737,7 +2818,14 @@ class GlobalServer:
             for k, v in kvs.slices():
                 k = int(k)
                 grad = v.astype(np.float32)  # copy: donated below
-                if isinstance(self.optimizer, DCASGD):
+                if self._dev_opt is not None:
+                    # async tier on the device stage: one H2D of the
+                    # push, jitted update, weights stay device-resident
+                    # (DCASGD never constructs a device optimizer — its
+                    # per-sender backups are host bookkeeping)
+                    self.store[k] = self._dev_opt.step(
+                        k, self.store.raw(k), grad, 1.0)
+                elif isinstance(self.optimizer, DCASGD):
                     self.store[k] = self.optimizer.update(
                         k, self.store[k], grad, sender=str(msg.sender))
                 else:
@@ -2980,13 +3068,12 @@ class GlobalServer:
 
     def _spawn_ckpt_write_locked(self):
         self._ckpt_busy = True
-        import copy
         import os
 
         from geomx_tpu.kvstore import checkpoint as ckpt
 
         store_snap = {k: v.copy() for k, v in self.store.items()}
-        opt_snap = copy.deepcopy(self.optimizer)
+        opt_snap = self._export_opt_locked()
         meta = {"sync_mode": self.sync_mode,
                 "compression": dict(self.compression)}
         path = os.path.join(self.config.checkpoint_dir,
@@ -3012,13 +3099,62 @@ class GlobalServer:
         threading.Thread(target=write, daemon=True,
                          name=f"auto-ckpt-{self.po.node}").start()
 
+    def _activate_dev_opt_locked(self):
+        """(Re)derive the device optimizer stage from the current host
+        ``self.optimizer`` (caller holds ``_mu``): when the merge
+        backend offers one for this optimizer's spec, import any
+        existing per-key trajectory onto the device and hand the state
+        ownership over (the host shell keeps hyper-parameters and the
+        type tag; single ownership keeps export unambiguous).  Standbys
+        defer — every replication snapshot would otherwise re-stage the
+        whole state H2D; promotion activates instead."""
+        self._dev_opt = None
+        if self.is_standby:
+            return
+        from geomx_tpu.optim import spec_of
+
+        spec = spec_of(self.optimizer)
+        if spec is None:
+            return  # custom subclass / unsupported: host path
+        dev = self._backend.make_device_optimizer(spec)
+        if dev is None:
+            return
+        dev.import_state(self.optimizer)
+        self.optimizer.state = {}
+        self._dev_opt = dev
+
+    def _export_opt_locked(self) -> ServerOptimizer:
+        """THE optimizer-stage snapshot hook (caller holds ``_mu``):
+        every path that serializes this server's optimizer — periodic
+        checkpoint, Ctrl.CHECKPOINT save, the replication stream, a
+        HANDOFF drain — goes through here, so a device-resident
+        trajectory is materialized into the equivalent host optimizer
+        (numpy pickle format unchanged on the wire/slab) and survives
+        failover, reassignment and warm boot on either engine."""
+        if self._dev_opt is not None:
+            return self._dev_opt.export_state()
+        import copy
+
+        return copy.deepcopy(self.optimizer)
+
+    def _drop_opt_key_locked(self, k: int):
+        """Discard one key's optimizer trajectory (overwrite-INIT
+        restore abort), whichever engine holds it."""
+        self.optimizer.state.pop(k, None)
+        if self._dev_opt is not None:
+            self._dev_opt.drop_key(k)
+
     def _install_state_locked(self, store: dict, opt: dict, meta: dict):
         """Adopt a full state snapshot (checkpoint restore OR a
         replication snapshot from the primary).  Caller holds ``_mu``."""
-        self.store = {k: np.array(v) for k, v in store.items()}
+        self.store = WeightStore(
+            {k: np.array(v) for k, v in store.items()})
         for k in self.store:
             self._keys.setdefault(k, _GlobalKeyState())
         self.optimizer = opt["optimizer"]
+        # a restored trajectory re-enters the device stage (no-op on
+        # the host path / on a standby, which defers to promotion)
+        self._activate_dev_opt_locked()
         # a restored optimizer IS a configured optimizer: central-
         # worker deployments gate training on this flag, and a
         # restarted shard reporting False would wedge them
@@ -3064,7 +3200,10 @@ class GlobalServer:
                     shipped_opt, "state", {}):
                 # per-key optimizer state (momentum/Adam moments) moves
                 # with the range; this server's own keys keep theirs
-                self.optimizer.state[k] = shipped_opt.state[k]
+                if self._dev_opt is not None:
+                    self._dev_opt.import_key(k, shipped_opt.state[k])
+                else:
+                    self.optimizer.state[k] = shipped_opt.state[k]
             if self.pull_comp is not None:
                 self.pull_comp.ensure_base(k, self.store[k])
             for m in self._serve_parked_pulls_locked(k):
@@ -3076,6 +3215,7 @@ class GlobalServer:
             # shard with a default-SGD one
             self.optimizer = shipped_opt
             self._optimizer_configured = True
+            self._activate_dev_opt_locked()
         rd = meta.get("recent_done")
         if rd:
             self._recent.seed_done(rd)
@@ -3121,8 +3261,6 @@ class GlobalServer:
         return True
 
     def _drain_thread(self, msg: Message, term: int, target: NodeId):
-        import copy
-
         from geomx_tpu.kvstore import checkpoint as ckpt
         from geomx_tpu.kvstore.replication import HANDOFF_CUSTOMER_ID
 
@@ -3146,7 +3284,7 @@ class GlobalServer:
             with self._mu:
                 self._draining = True
                 store_snap = {k: v.copy() for k, v in self.store.items()}
-                opt_snap = copy.deepcopy(self.optimizer)
+                opt_snap = self._export_opt_locked()
                 meta = {
                     "sync_mode": self.sync_mode,
                     "compression": dict(self.compression),
@@ -3294,6 +3432,10 @@ class GlobalServer:
                 self.is_standby = False
                 self._fenced = False  # a promote supersedes any fence
                 self.promotions += 1
+                # the replicated trajectory enters the device stage NOW
+                # (deferred while standby): the promoted holder resumes
+                # the momentum/moments the primary was training with
+                self._activate_dev_opt_locked()
                 parked, self._parked_standby = self._parked_standby, []
                 for k in list(self.store):
                     for m in self._serve_parked_pulls_locked(k):
@@ -3384,8 +3526,10 @@ class GlobalServer:
         if msg.cmd == Ctrl.SET_OPTIMIZER:
             # ref: master worker pickles the optimizer, executes on the
             # global server (kvstore.py:452-499, kvstore_dist_server.h:357-364)
-            self.optimizer = make_optimizer(body)
-            self._optimizer_configured = True
+            with self._mu:
+                self.optimizer = make_optimizer(body)
+                self._optimizer_configured = True
+                self._activate_dev_opt_locked()
         elif msg.cmd == Ctrl.SET_COMPRESSION:
             from geomx_tpu.compression import (compression_allowed,
                                                make_push_codec)
@@ -3451,11 +3595,9 @@ class GlobalServer:
                 if body["action"] == "save":
                     # snapshot under the lock, serialize/write outside it —
                     # a multi-GB savez must not stall every party's round
-                    import copy
-
                     with self._mu:
                         store_snap = {k: v.copy() for k, v in self.store.items()}
-                        opt_snap = copy.deepcopy(self.optimizer)
+                        opt_snap = self._export_opt_locked()
                         meta = {"sync_mode": self.sync_mode,
                                 "compression": dict(self.compression)}
                     ckpt.save_server_state(
@@ -3490,6 +3632,11 @@ class GlobalServer:
             # this through the master worker finishing first)
             "optimizer": type(self.optimizer).__name__.lower(),
             "optimizer_configured": self._optimizer_configured,
+            # device-resident optimizer stage: which DeviceOptimizer
+            # closes rounds ("" = host optimizer), and how many keys'
+            # trajectories live on device right now
+            **(self._dev_opt.stats() if self._dev_opt is not None
+               else {"opt_device": ""}),
             # forced dense resyncs of the BSC pull compressor: a
             # nonzero steady-state rate means the pull direction is
             # degrading to uncompressed (e.g. sustained overlapping
@@ -3540,6 +3687,12 @@ class GlobalServer:
 
             system_gauge(f"{self.po.node}.merge_device_ms").set(ms)
             system_gauge(f"{self.po.node}.h2d_bytes").set(h2d or 0)
+            # device->host traffic + optimizer-stage time: the
+            # steady-state zero-D2H contract is audited on these
+            system_gauge(f"{self.po.node}.d2h_bytes").set(
+                out.get("d2h_bytes") or 0)
+            system_gauge(f"{self.po.node}.opt_device_ms").set(
+                out.get("opt_device_ms") or 0)
         return out
 
     def stop(self):

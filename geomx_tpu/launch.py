@@ -901,6 +901,14 @@ def main(argv=None):
         if bs.get("h2d_bytes"):
             feats.append(f"h2d_bytes={bs['h2d_bytes']} "
                          f"merge_device_ms={bs.get('merge_device_ms')}")
+        # device-resident optimizer stage (docs/merge-backends.md):
+        # round closes that never left the device + the D2H the serve/
+        # checkpoint events actually paid
+        dev_opt = getattr(role_obj, "_dev_opt", None)
+        if dev_opt is not None:
+            feats.append(f"opt_device={dev_opt.kind} "
+                         f"opt_device_ms={bs.get('opt_device_ms')} "
+                         f"d2h_bytes={bs.get('d2h_bytes')}")
     # global-tier failover observables (replication stream, promotions,
     # term fencing, client-side retarget+replay)
     for attr, tag in (("failover_events", "failover_events"),

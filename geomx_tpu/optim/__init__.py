@@ -1,4 +1,4 @@
 from geomx_tpu.optim.server_opt import (  # noqa: F401
     AdaDelta, AdaGrad, Adam, DCASGD, Nag, RmsProp, ServerOptimizer, Sgd,
-    Signum, make_optimizer,
+    Signum, make_optimizer, spec_of,
 )

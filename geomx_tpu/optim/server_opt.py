@@ -217,6 +217,27 @@ _REGISTRY = {"sgd": Sgd, "adam": Adam, "dcasgd": DCASGD, "nag": Nag,
              "signum": Signum}
 
 
+def spec_of(opt: ServerOptimizer) -> Optional[dict]:
+    """The plain config dict that would reconstruct ``opt`` (inverse of
+    :func:`make_optimizer`, hyper-parameters only — per-key ``state``
+    travels separately).  Used by the device-resident optimizer stage
+    (kvstore/jax_backend.py) to rebuild the equivalent host optimizer
+    for checkpoint/replication/handoff snapshots and to re-activate a
+    device optimizer from a restored host one.  Returns None for types
+    outside the registry (a custom subclass shipped over the command
+    channel keeps its own pickle path)."""
+    for name, cls in _REGISTRY.items():
+        if type(opt) is cls:
+            break
+    else:
+        return None
+    spec = {"type": name, "lr": opt.lr, "wd": opt.wd}
+    for attr in ("momentum", "beta1", "beta2", "eps", "lamda", "rho"):
+        if hasattr(opt, attr):
+            spec[attr] = getattr(opt, attr)
+    return spec
+
+
 def make_optimizer(config: dict) -> ServerOptimizer:
     """Build from a plain dict (shipped over the command channel), e.g.
     ``{"type": "adam", "lr": 0.01}``."""

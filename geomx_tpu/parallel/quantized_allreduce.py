@@ -88,6 +88,64 @@ def quantized_psum_mean(x: jnp.ndarray, axis_name: str,
     return full[:n]
 
 
+def quantized_psum_mean_ef(x: jnp.ndarray, residual: jnp.ndarray,
+                           axis_name: str, axis_size: int):
+    """:func:`quantized_psum_mean` with EQuARX-style error feedback:
+    returns ``(mean, new_residual)``.
+
+    Each participant folds its residual into this round's contribution
+    BEFORE quantizing and keeps the quantization error it just incurred
+    for the next round, so the systematic part of the int8 error (e.g.
+    sub-threshold components of a block whose absmax is dominated by
+    one large element quantize to exactly 0 every round) accumulates in
+    the residual until it crosses the quantization step instead of
+    being lost forever — the property that makes the quantized rung
+    accuracy-neutral over a training run rather than merely bounded per
+    round.
+
+    Residual domain: the SUM each contribution enters with weight 1
+    (``mean * axis_size``).  Two terms are captured:
+
+    - leg 1 (reduce-scatter): ``(x + r) - dequant(quant(x + r))`` —
+      the participant's own full-length quantization error;
+    - leg 2 (broadcast): the re-quantization error of the shard this
+      device owns, scaled by ``axis_size`` because the shard sum it
+      distorts lands in the output with weight ``axis_size`` relative
+      to a single contribution — held by the shard owner alone (one
+      compensator per error, never double-counted).
+
+    The caller threads ``new_residual`` back in next round (zeros to
+    start).  Without it this function degrades exactly to
+    :func:`quantized_psum_mean` applied to ``x + residual``."""
+    x_adj = x + residual
+    n = x.shape[0]
+    chunk = ((n + axis_size * BLOCK - 1) // (axis_size * BLOCK)) * BLOCK
+    pad = chunk * axis_size - n
+    xp = jnp.pad(x_adj, (0, pad))
+    q, s = _quantize_blocks(xp)
+    # leg-1 error feedback: what the int8 wire just lost of OUR vector
+    leg1 = xp - _dequantize_blocks(q, s)
+    q = q.reshape(axis_size, chunk)
+    s = s.reshape(axis_size, chunk // BLOCK)
+    q_peers = jax.lax.all_to_all(q, axis_name, split_axis=0,
+                                 concat_axis=0, tiled=False)
+    s_peers = jax.lax.all_to_all(s, axis_name, split_axis=0,
+                                 concat_axis=0, tiled=False)
+    part = jax.vmap(_dequantize_blocks)(q_peers, s_peers)
+    shard_sum = jnp.sum(part, axis=0) / float(axis_size)   # mean
+    q2, s2 = _quantize_blocks(shard_sum)
+    # leg-2 error feedback: the re-quantization error of the shard WE
+    # own (mean domain; every peer receives it, we alone compensate)
+    err2 = shard_sum - _dequantize_blocks(q2, s2)
+    d = jax.lax.axis_index(axis_name)
+    leg2 = jax.lax.dynamic_update_slice(
+        jnp.zeros_like(xp), err2 * float(axis_size), (d * chunk,))
+    q_all = jax.lax.all_gather(q2, axis_name, axis=0)
+    s_all = jax.lax.all_gather(s2, axis_name, axis=0)
+    full = jax.vmap(_dequantize_blocks)(q_all, s_all).reshape(-1)
+    return full[:n], (leg1 + leg2)[:n]
+
+
 def make_party_step_quantized(grad_fn: Callable, mesh: Mesh) -> Callable:
     """Drop-in for :func:`geomx_tpu.parallel.dp.make_party_step` that
     reduces gradients with :func:`quantized_psum_mean` instead of the

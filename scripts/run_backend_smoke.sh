@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
-# Merge-backend smoke lane: run the kvstore/failover/eviction test
-# subset with the server merge lanes forced onto the JAX backend
+# Merge-backend smoke lane: run the kvstore/failover/eviction/recovery
+# test subset with the server merge lanes forced onto the JAX backend
 # (GEOMX_MERGE_BACKEND shakes directly-constructed Configs too, the way
 # GEOMX_SERVER_SHARDS does for the striped-merge path), so the device
 # merge path cannot silently rot while tier-1 runs the numpy default.
@@ -8,16 +8,27 @@
 # donated-argument accumulate, mesh psum under the virtual 8-device
 # conftest mesh), not accelerator hardware.
 #
-# Env: PYTEST_ARGS (extra pytest flags), GEOMX_MERGE_BACKEND (default jax)
+# Since ISSUE 11 the sweep runs with the DEVICE OPTIMIZER STAGE on
+# (GEOMX_MERGE_OPT_DEVICE=1, the default — pinned here so a default
+# flip can't silently shrink the lane) and includes the checkpoint/
+# restore and device-optimizer suites: every failover, eviction,
+# reassignment and warm-boot path runs with device-resident weights +
+# moments, proving the export_state/import_state snapshot hooks carry
+# the trajectory across all of them.
+#
+# Env: PYTEST_ARGS (extra pytest flags), GEOMX_MERGE_BACKEND (default jax),
+#      GEOMX_MERGE_OPT_DEVICE (default 1)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 export JAX_PLATFORM_NAME=cpu
 export GEOMX_MERGE_BACKEND=${GEOMX_MERGE_BACKEND:-jax}
+export GEOMX_MERGE_OPT_DEVICE=${GEOMX_MERGE_OPT_DEVICE:-1}
 
 exec python -m pytest -q -m 'not slow' -p no:cacheprovider \
   tests/test_kvstore.py tests/test_failover.py tests/test_eviction.py \
   tests/test_sharded_merge.py tests/test_recovery.py \
-  tests/test_merge_backend.py \
+  tests/test_sharded_global.py \
+  tests/test_merge_backend.py tests/test_device_opt.py \
   ${PYTEST_ARGS:-}
