@@ -1657,6 +1657,90 @@ def child_shards():
     }))
 
 
+def child_parties():
+    """Party-count scaling sweep (ISSUE 12 tentpole): round wall time
+    and per-process THREAD COUNT at {4, 16, 64, 128} parties x 4
+    workers on the event-driven lightweight simulation — the
+    measurement substrate every other scale claim (device-resident
+    round close, serving plane, ESync elasticity, shard-count scaling)
+    is judged against.  The thread curve is the refactor's win
+    condition: O(1) in party count (reactor loops + handler pool)
+    where the thread-per-endpoint harness runs O(nodes).  The smallest
+    points also run under the legacy threads transport for the
+    contrast curve (128 legacy parties would mean thousands of OS
+    threads fighting the GIL — exactly what the sweep exists to
+    retire, so legacy stops at 16)."""
+    import threading
+
+    import numpy as np
+
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.kvstore import Simulation
+
+    points = [int(x) for x in os.environ.get(
+        "BENCH_PARTY_POINTS", "4,16,64,128").split(",") if x]
+    legacy_points = [int(x) for x in os.environ.get(
+        "BENCH_PARTY_LEGACY_POINTS", "4,16").split(",") if x]
+    wpp = int(os.environ.get("BENCH_PARTY_WORKERS", "4"))
+    N = int(os.environ.get("BENCH_PARTY_ELEMS", "65536"))
+
+    def run_point(parties: int, lightweight: bool) -> dict:
+        # flight off: 770 preallocated event rings are pure construction
+        # ballast at 128 parties and record nothing the sweep reads
+        cfg = Config(topology=Topology(num_parties=parties,
+                                       workers_per_party=wpp),
+                     enable_flight=False)
+        t0 = time.perf_counter()
+        sim = Simulation(cfg, lightweight=lightweight)
+        build_s = time.perf_counter() - t0
+        try:
+            ws = sim.all_workers()
+            for w in ws:
+                w.init(0, np.zeros(N, np.float32))
+            ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+            g = np.ones(N, np.float32)
+
+            def one_round() -> float:
+                t0 = time.perf_counter()
+                for w in ws:
+                    w.push(0, g)
+                for w in ws:
+                    w.pull_sync(0)
+                    w.wait_all()
+                return time.perf_counter() - t0
+
+            cold = one_round()
+            dt = min(one_round(), one_round())
+            return {"round_wall_s": round(dt, 3),
+                    "round_wall_s_cold": round(cold, 3),
+                    "build_s": round(build_s, 2),
+                    "workers": parties * wpp,
+                    "process_threads": threading.active_count()}
+        finally:
+            sim.shutdown()
+
+    sweep, legacy = {}, {}
+    for p in points:
+        sweep[str(p)] = run_point(p, lightweight=True)
+    for p in legacy_points:
+        legacy[str(p)] = run_point(p, lightweight=False)
+    print(json.dumps({
+        "tensor_elems": N,
+        "workers_per_party": wpp,
+        "party_scaling": {k: v["round_wall_s"] for k, v in sweep.items()},
+        "round_wall_s": {k: v["round_wall_s"] for k, v in sweep.items()},
+        "process_threads": {k: v["process_threads"]
+                            for k, v in sweep.items()},
+        "threads_at_128p": sweep.get("128", {}).get("process_threads"),
+        "legacy_threads": {k: v["process_threads"]
+                           for k, v in legacy.items()},
+        "legacy_round_wall_s": {k: v["round_wall_s"]
+                                for k, v in legacy.items()},
+        "sweep": sweep,
+        "legacy_sweep": legacy,
+    }))
+
+
 def child_obs():
     """Metrics-pump overhead guard (ISSUE 7 satellite): enabled-vs-
     disabled round wall on the flagship-shaped 2-party push/pull
@@ -2243,6 +2327,7 @@ def _build_record() -> dict:
                       ("stress", "stress"), ("lm", "lm"),
                       ("scaling", "scaling"), ("parity", "parity"),
                       ("serde", "serde"), ("shards", "shards"),
+                      ("parties", "parties"),
                       ("merge", "merge"),
                       ("serve", "serve"), ("probe", "probe")):
         if name in _results:
@@ -2301,6 +2386,12 @@ def _compact(record: dict) -> dict:
     sh = record.get("shards") or {}
     if sh.get("flagship_50m_round_wall_s"):
         out["shards_round_wall_s"] = sh["flagship_50m_round_wall_s"]
+    pt = record.get("parties") or {}
+    if pt.get("party_scaling"):
+        out["party_scaling"] = pt["party_scaling"]
+        out["party_threads"] = pt.get("process_threads")
+        if pt.get("threads_at_128p") is not None:
+            out["threads_at_128p"] = pt["threads_at_128p"]
     ob = record.get("obs") or {}
     if ob.get("overhead_pct") is not None:
         out["obs_overhead_pct"] = ob["overhead_pct"]
@@ -2481,8 +2572,8 @@ def main():
                     choices=["cnn", "mfu", "mfu_sweep", "quant", "wan",
                              "overlap", "overlap_tpu", "stress", "probe",
                              "flash_autotune", "lm", "scaling", "parity",
-                             "serde", "shards", "obs", "flight", "serve",
-                             "merge"])
+                             "serde", "shards", "parties", "obs",
+                             "flight", "serve", "merge"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -2507,7 +2598,8 @@ def main():
          "overlap_tpu": child_overlap_tpu, "stress": child_stress,
          "probe": child_probe, "lm": child_lm, "scaling": child_scaling,
          "parity": child_parity, "serde": child_serde,
-         "shards": child_shards, "obs": child_obs,
+         "shards": child_shards, "parties": child_parties,
+         "obs": child_obs,
          "flight": child_flight, "serve": child_serve,
          "merge": child_merge,
          "flash_autotune": child_flash_autotune}[args.child]()
@@ -2609,6 +2701,7 @@ def main():
         _do("parity", 280, cpu_env)
         _do("stress", 180, cpu_env)
         _do("shards", 240, cpu_env)
+        _do("parties", 240, cpu_env)
         _do("merge", 180, cpu_env)
         _do("obs", 180, cpu_env)
         _do("flight", 180, cpu_env)

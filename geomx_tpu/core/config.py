@@ -403,6 +403,32 @@ class Config:
     #                               failover to the rounds since the last
     #                               shipped snapshot
 
+    # --- event-driven transport core (transport/reactor.py).  "threads"
+    # (default) keeps the pre-reactor behavior: recv/send/resend threads
+    # per Van, one accept loop + one recv thread PER CONNECTION in the
+    # TcpFabric, a sleep-loop thread per monitor/pump.  "reactor" routes
+    # every TcpFabric endpoint through a per-process Reactor (a small
+    # fixed pool of selector loop threads + one timer wheel) and flips
+    # in-proc Simulations into lightweight-party mode (below), so the
+    # process runs O(GEOMX_REACTOR_LOOPS + handler pool) threads instead
+    # of O(nodes + connections).  "" = follow GEOMX_TRANSPORT (default
+    # threads until the reactor path has soaked — scripts/
+    # run_reactor_smoke.sh runs the parity suites under it).
+    transport: str = ""
+    reactor_loops: int = 0  # selector loop threads; 0 = auto
+    #                         (GEOMX_REACTOR_LOOPS, min(4, cpus))
+    lightweight: bool = False  # lightweight-party mode for the in-proc
+    #                            Simulation: all nodes share the process
+    #                            Reactor — per-node van-recv / customer
+    #                            threads become serial dispatch channels
+    #                            on the shared handler pool, heartbeat /
+    #                            resend / monitor loops become timer-
+    #                            wheel entries, and server merge lanes
+    #                            run inline (server_shards forced to 1,
+    #                            like deterministic) — so an O(100)-party
+    #                            topology fits one host.  Implied by
+    #                            transport=reactor for Simulations;
+    #                            GEOMX_LIGHTWEIGHT=1 forces it alone.
     # --- misc runtime
     deterministic: bool = False  # NaiveEngine-analog debug mode (ref:
     #                              src/engine/naive_engine.cc,
@@ -707,6 +733,17 @@ class Config:
                              "(0 = manual refresh)")
         if self.server_shards < 0:
             raise ValueError("server_shards must be >= 0 (0 = auto)")
+        if self.transport not in ("", "threads", "reactor"):
+            raise ValueError(
+                f"transport must be '', 'threads' or 'reactor', got "
+                f"{self.transport!r}")
+        if self.reactor_loops < 0:
+            raise ValueError("reactor_loops must be >= 0 (0 = auto)")
+        # lightweight-mode env fallback (mirrors GEOMX_GLOBAL_SHARDS):
+        # directly-constructed Configs go lightweight under
+        # GEOMX_LIGHTWEIGHT=1 without threading the knob through fixtures
+        if not self.lightweight:
+            self.lightweight = _env_bool("GEOMX_LIGHTWEIGHT", False)
         if self.trace_sample_every < 0:
             raise ValueError("trace_sample_every must be >= 0 (0 = off)")
         if self.trace_batch_events < 1:
@@ -795,6 +832,9 @@ class Config:
             ),
             server_merge_threads=_env_int("GEOMX_SERVER_MERGE_THREADS", 0),
             server_shards=_env_int("GEOMX_SERVER_SHARDS", 0),
+            transport=os.environ.get("GEOMX_TRANSPORT", ""),
+            reactor_loops=_env_int("GEOMX_REACTOR_LOOPS", 0),
+            lightweight=_env_bool("GEOMX_LIGHTWEIGHT", False),
             merge_backend=os.environ.get("GEOMX_MERGE_BACKEND", "auto")
             or "auto",
             merge_quantized=_env_bool("GEOMX_MERGE_QUANTIZED"),

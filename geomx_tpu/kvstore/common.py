@@ -35,6 +35,12 @@ def resolve_server_shards(config) -> int:
     reorder handler side effects run-to-run)."""
     if getattr(config, "deterministic", False):
         return 1
+    if getattr(config, "lightweight", False):
+        # lightweight-party mode: inline merge lanes (no thread per
+        # server) — an O(100)-server topology must not spawn O(100 x
+        # lanes) lane threads; cross-server merge parallelism comes
+        # from the reactor's shared handler pool instead
+        return 1
     n = int(getattr(config, "server_shards", 0) or 0)
     if n <= 0:
         # env fallback even for directly-constructed Configs: lets a

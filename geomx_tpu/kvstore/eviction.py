@@ -80,19 +80,23 @@ class _HeartbeatActuator:
         self._replies: Dict[str, dict] = {}
         self._stop = threading.Event()
         postoffice.add_control_hook(self._on_control)
-        self._thread = threading.Thread(
-            target=self._run, daemon=True,
-            name=f"{type(self).__name__}-{postoffice.node}")
-        self._thread.start()
+        # one timer-wheel entry on the shared reactor when the fabric
+        # rides one (lightweight / reactor transport); a dedicated
+        # sleep-loop thread otherwise — identical sweep cadence
+        from geomx_tpu.transport.reactor import Periodic
 
-    def _run(self):
-        while not self._stop.wait(self._interval):
-            if not self.po.config.enable_eviction:
-                continue
-            try:
-                self._check()
-            except Exception:  # a sweep error must not kill the detector
-                _LOG.exception("%s: membership sweep failed", self.po.node)
+        self._ticker = Periodic(
+            self._interval, self._sweep,
+            name=f"{type(self).__name__}-{postoffice.node}",
+            reactor=getattr(postoffice.van.fabric, "reactor", None))
+
+    def _sweep(self):
+        if self._stop.is_set() or not self.po.config.enable_eviction:
+            return
+        try:
+            self._check()
+        except Exception:  # a sweep error must not kill the detector
+            _LOG.exception("%s: membership sweep failed", self.po.node)
 
     def _check(self):  # pragma: no cover - subclass hook
         raise NotImplementedError
@@ -146,6 +150,7 @@ class _HeartbeatActuator:
 
     def stop(self):
         self._stop.set()
+        self._ticker.stop()
 
 
 class WorkerEvictionMonitor(_HeartbeatActuator):

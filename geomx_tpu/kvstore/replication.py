@@ -288,29 +288,34 @@ class GlobalFailoverMonitor:
                           else max(postoffice.config.heartbeat_interval_s,
                                    0.1))
         postoffice.add_control_hook(self._on_control)
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True,
-            name=f"failover-monitor-{postoffice.node}")
-        self._thread.start()
+        # timer-wheel entry on a reactor fabric, sleep-loop thread
+        # otherwise (transport/reactor.py) — same sweep cadence
+        from geomx_tpu.transport.reactor import Periodic
+
+        self._ticker = Periodic(
+            self._interval, self._tick,
+            name=f"failover-monitor-{postoffice.node}",
+            reactor=getattr(postoffice.van.fabric, "reactor", None))
 
     # ---- detection ----------------------------------------------------------
-    def _loop(self):
-        while not self._stop.wait(self._interval):
-            try:
-                dead = set(self.po.dead_nodes())
-            except Exception:
-                continue
-            for rank in range(self.topology.num_standby_globals):
-                primary = NodeId(Role.GLOBAL_SERVER, rank)
-                if rank in self._promoted:
-                    if str(primary) in dead:
-                        # keep fencing: a zombie restarting at any later
-                        # point must hear who owns the shard now
-                        self._broadcast_new_primary(
-                            rank, old=primary, repeats=1)
-                    continue
+    def _tick(self):
+        if self._stop.is_set():
+            return
+        try:
+            dead = set(self.po.dead_nodes())
+        except Exception:
+            return
+        for rank in range(self.topology.num_standby_globals):
+            primary = NodeId(Role.GLOBAL_SERVER, rank)
+            if rank in self._promoted:
                 if str(primary) in dead:
-                    self.promote(rank)
+                    # keep fencing: a zombie restarting at any later
+                    # point must hear who owns the shard now
+                    self._broadcast_new_primary(
+                        rank, old=primary, repeats=1)
+                continue
+            if str(primary) in dead:
+                self.promote(rank)
 
     # ---- promotion ----------------------------------------------------------
     def promote(self, rank: int, reason: str = "heartbeat timeout") -> bool:
@@ -522,3 +527,4 @@ class GlobalFailoverMonitor:
 
     def stop(self):
         self._stop.set()
+        self._ticker.stop()

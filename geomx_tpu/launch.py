@@ -719,6 +719,15 @@ def main(argv=None):
                          "serial merge lanes per server (0 = auto "
                          "min(8, cpus); 1 = the single-lock server; "
                          "see docs/perf.md)")
+    ap.add_argument("--transport",
+                    default=os.environ.get("GEOMX_TRANSPORT", ""),
+                    choices=["", "threads", "reactor"],
+                    help="transport engine: threads (default) = the "
+                         "thread-per-endpoint fabric; reactor = every "
+                         "endpoint in the process serviced by a shared "
+                         "selector-loop pool + timer wheel "
+                         "(GEOMX_REACTOR_LOOPS sizes it; see "
+                         "docs/perf.md 'Event-driven transport')")
     ap.add_argument("--merge-backend",
                     default=os.environ.get("GEOMX_MERGE_BACKEND", "auto"),
                     choices=["auto", "numpy", "jax"],
@@ -772,6 +781,8 @@ def main(argv=None):
                             central_worker=central)
     if args.serve_staleness > 0:
         cfg.serve_staleness_s = args.serve_staleness
+    if args.transport:
+        cfg.transport = args.transport
     cfg.compression = args.compression
     # ESync exchanges weights like HFA — servers must run in HFA mode
     # (ref: examples/cnn.py wires --esync the same way)

@@ -55,9 +55,12 @@ from geomx_tpu.utils.metrics import system_counter, system_gauge
 
 # the pressure gauges every sampled reading mirrors into the registry
 # (documented in docs/metrics.md; the status console's pressure column
-# and the PR 7 pump read them back)
+# and the PR 7 pump read them back).  process_threads is registered on
+# every node; the reactor_* pair only when the node's fabric rides the
+# shared reactor (GEOMX_TRANSPORT=reactor / lightweight sims)
 PRESSURE_GAUGES = ("lock_wait_s", "lane_depth", "van_sendq_depth",
-                   "codec_pool_busy")
+                   "codec_pool_busy", "process_threads",
+                   "reactor_loop_lag_ms", "reactor_fds")
 
 
 class FlightEv(enum.IntEnum):

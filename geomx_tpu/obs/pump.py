@@ -57,22 +57,27 @@ class MetricsPump:
         self.shipped = 0
         self.ship_errors = 0
         self._stop = threading.Event()
-        self._thread = None
+        self._ticker = None
         if self.config.obs_interval_s > 0:
-            self._thread = threading.Thread(
-                target=self._run, daemon=True,
-                name=f"metrics-pump-{self.node}")
-            self._thread.start()
+            # timer-wheel entry on a reactor fabric, sleep-loop thread
+            # otherwise (transport/reactor.py) — same ship cadence
+            from geomx_tpu.transport.reactor import Periodic
 
-    def _run(self):
-        while not self._stop.wait(self.config.obs_interval_s):
-            try:
-                self.ship()
-            except Exception:  # a sweep error must not kill the loop
-                import logging
+            self._ticker = Periodic(
+                self.config.obs_interval_s, self._tick,
+                name=f"metrics-pump-{self.node}",
+                reactor=getattr(postoffice.van.fabric, "reactor", None))
 
-                logging.getLogger(__name__).exception(
-                    "%s: metrics pump sweep failed", self.node)
+    def _tick(self):
+        if self._stop.is_set():
+            return
+        try:
+            self.ship()
+        except Exception:  # a sweep error must not kill the loop
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "%s: metrics pump sweep failed", self.node)
 
     # ---- sampling -----------------------------------------------------------
     def sample(self) -> dict:
@@ -147,3 +152,5 @@ class MetricsPump:
 
     def stop(self):
         self._stop.set()
+        if self._ticker is not None:
+            self._ticker.stop()

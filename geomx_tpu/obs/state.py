@@ -305,9 +305,13 @@ def _press_tag(entry: dict) -> str:
         bits.append(f"lock={p['lock_wait_s'] * 1e3:.1f}ms")
     for key, short in (("lane_depth", "lane"),
                        ("van_sendq_depth", "sq"),
-                       ("codec_pool_busy", "codec")):
+                       ("codec_pool_busy", "codec"),
+                       ("process_threads", "thr"),
+                       ("reactor_fds", "rfds")):
         if key in p:
             bits.append(f"{short}={int(p[key])}")
+    if "reactor_loop_lag_ms" in p:
+        bits.append(f"rlag={p['reactor_loop_lag_ms']:.1f}ms")
     return " press[" + " ".join(bits) + "]" if bits else ""
 
 

@@ -61,8 +61,27 @@ class Customer:
             else None
         )
         self._threads = []
+        # lightweight-party mode (transport/reactor.py): handler threads
+        # become serial channels on the shared reactor pool — identical
+        # per-customer FIFO order (and the same split pull lane as a
+        # SECOND channel), O(1) threads in node count
+        fabric = postoffice.van.fabric
+        self._light = bool((not self._inline)
+                           and getattr(fabric, "lightweight", False))
+        self._chan = None
+        self._pull_chan = None
         postoffice.register_customer(self, owns_app=owns_app)
-        if not self._inline:
+        if self._light:
+            reactor = fabric.reactor
+            self._chan = reactor.channel(
+                self._process,
+                name=f"customer-{postoffice.node}-{app_id}.{customer_id}")
+            if split_pull_queue:
+                self._pull_chan = reactor.channel(
+                    self._process,
+                    name=f"customer-pull-{postoffice.node}"
+                         f"-{app_id}.{customer_id}")
+        elif not self._inline:
             t = threading.Thread(
                 target=self._loop, args=(self._q,),
                 name=f"customer-{postoffice.node}-{app_id}.{customer_id}",
@@ -183,27 +202,41 @@ class Customer:
 
                 traceback.print_exc()
             return
+        if self._light:
+            is_pull = (self._pull_chan is not None and msg.request
+                       and msg.pull and not msg.push)
+            (self._pull_chan if is_pull else self._chan).put(msg)
+            return
         if self._pull_q is not None and msg.request and msg.pull and not msg.push:
             self._pull_q.put(msg)
         else:
             self._q.put(msg)
+
+    def _process(self, msg: Message):
+        """One handler invocation (the loop body, also the lightweight
+        channels' callback)."""
+        try:
+            if _tctx.ACTIVE and msg.trace_id > 0:
+                self._invoke_traced(msg)
+            else:
+                self._handler(msg)
+        except Exception:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
 
     def _loop(self, q: "queue.Queue[Optional[Message]]"):
         while True:
             msg = q.get()
             if msg is None:
                 return
-            try:
-                if _tctx.ACTIVE and msg.trace_id > 0:
-                    self._invoke_traced(msg)
-                else:
-                    self._handler(msg)
-            except Exception:  # pragma: no cover
-                import traceback
-
-                traceback.print_exc()
+            self._process(msg)
 
     def stop(self):
+        if self._chan is not None:
+            self._chan.close()
+        if self._pull_chan is not None:
+            self._pull_chan.close()
         self._q.put(None)
         if self._pull_q is not None:
             self._pull_q.put(None)

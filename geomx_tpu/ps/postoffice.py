@@ -97,6 +97,7 @@ class Postoffice:
         self._heartbeats: Dict[str, float] = {}
         self._hb_boots: Dict[str, int] = {}
         self._hb_thread: Optional[threading.Thread] = None
+        self._hb_task = None  # reactor timer-wheel entry (reactor mode)
         self._hb_stop = threading.Event()
         self._hb_epoch = 0.0
         self._dead_replies: Dict[int, dict] = {}
@@ -131,6 +132,18 @@ class Postoffice:
             self.add_control_hook(self.flight.on_control)
             self.flight.add_pressure("van_sendq_depth",
                                      self.van._pq.qsize)
+            # scheduler pressure: total OS threads in the process (the
+            # reading the reactor refactor exists to flatten — O(nodes)
+            # under the thread-per-endpoint harness, O(1) under the
+            # reactor) and, when this fabric rides the shared reactor,
+            # its loop-lag / fd-count health
+            self.flight.add_pressure("process_threads",
+                                     threading.active_count)
+            reactor = getattr(fabric, "reactor", None)
+            if reactor is not None:
+                self.flight.add_pressure("reactor_loop_lag_ms",
+                                         reactor.loop_lag_ms)
+                self.flight.add_pressure("reactor_fds", reactor.fd_count)
 
     # ---- lifecycle ----------------------------------------------------------
     def start(self):
@@ -142,14 +155,31 @@ class Postoffice:
             self._hb_epoch = _time.monotonic()
             if (self.config.heartbeat_interval_s > 0
                     and not self.node.role.is_scheduler):
-                self._hb_stop = threading.Event()
-                self._hb_thread = threading.Thread(
-                    target=self._heartbeat_loop, args=(self._hb_stop,),
-                    daemon=True, name=f"heartbeat-{self.node}")
-                self._hb_thread.start()
+                reactor = getattr(self.van.fabric, "reactor", None)
+                if reactor is not None:
+                    # heartbeat as a timer-wheel entry instead of a
+                    # per-node sleep thread (O(100)-party harness)
+                    targets = self._heartbeat_targets()
+                    self._hb_task = reactor.call_every(
+                        self.config.heartbeat_interval_s,
+                        lambda: self._heartbeat_tick(targets),
+                        name=f"heartbeat-{self.node}")
+                    # the thread path pings immediately on start;
+                    # call_every first fires after one interval — keep
+                    # the first-contact timing identical
+                    self._heartbeat_tick(targets)
+                else:
+                    self._hb_stop = threading.Event()
+                    self._hb_thread = threading.Thread(
+                        target=self._heartbeat_loop, args=(self._hb_stop,),
+                        daemon=True, name=f"heartbeat-{self.node}")
+                    self._hb_thread.start()
 
     def stop(self):
         if self._started:
+            if self._hb_task is not None:
+                self._hb_task.cancel()
+                self._hb_task = None
             if self._hb_thread is not None:
                 self._hb_stop.set()
                 self._hb_thread.join(timeout=2)
@@ -196,18 +226,15 @@ class Postoffice:
                 pass
 
     # ---- dispatch -----------------------------------------------------------
-    def _heartbeat_loop(self, stop_ev: threading.Event):
-        """Periodic HEARTBEAT to my scheduler(s) (ref: van.cc:1128-1140).
-        Local servers are dual-identity and ping BOTH their party scheduler
-        and the global scheduler (whose dead-node table covers them);
-        workers ping the party scheduler; global servers ping the global
-        scheduler."""
+    def _heartbeat_targets(self):
+        """My scheduler target set.  Local servers are dual-identity and
+        ping BOTH their party scheduler and the global scheduler (whose
+        dead-node table covers them); workers ping the party scheduler;
+        global-tier roles and replicas ping the global scheduler (the
+        table makes replicas evictable and their freshness visible)."""
         targets = []
         if self.node.role in (Role.GLOBAL_SERVER, Role.STANDBY_GLOBAL,
                               Role.REPLICA):
-            # replicas are WAN-domain members like the global tier: the
-            # global scheduler's table makes them evictable (subscriber
-            # prune) and their freshness visible in the status console
             targets.append((self.topology.global_scheduler(), Domain.GLOBAL))
         else:
             targets.append(
@@ -215,21 +242,33 @@ class Postoffice:
             if self.node.role is Role.SERVER:
                 targets.append(
                     (self.topology.global_scheduler(), Domain.GLOBAL))
+        return targets
+
+    def _heartbeat_tick(self, targets):
+        """One HEARTBEAT round to my scheduler(s) — the loop body, also
+        the reactor timer-wheel entry."""
         import time as _time
 
+        for sched, domain in targets:
+            try:
+                # the send stamp makes the ping echo-able: the
+                # scheduler replies with (echo_t, sched_t) and this
+                # node derives RTT + clock offset from the pair
+                self.van.send(Message(
+                    recipient=sched, control=Control.HEARTBEAT,
+                    domain=domain, body={"t": _time.monotonic()}))
+            except (KeyError, OSError):
+                # scheduler not up yet (startup race on TCP) — a
+                # transient failure must not kill the heartbeat loop
+                pass
+
+    def _heartbeat_loop(self, stop_ev: threading.Event):
+        """Periodic HEARTBEAT thread (ref: van.cc:1128-1140) — the
+        legacy-transport path; reactor fabrics schedule
+        :meth:`_heartbeat_tick` on the shared timer wheel instead."""
+        targets = self._heartbeat_targets()
         while not stop_ev.is_set():
-            for sched, domain in targets:
-                try:
-                    # the send stamp makes the ping echo-able: the
-                    # scheduler replies with (echo_t, sched_t) and this
-                    # node derives RTT + clock offset from the pair
-                    self.van.send(Message(
-                        recipient=sched, control=Control.HEARTBEAT,
-                        domain=domain, body={"t": _time.monotonic()}))
-                except (KeyError, OSError):
-                    # scheduler not up yet (startup race on TCP) — a
-                    # transient failure must not kill the heartbeat thread
-                    pass
+            self._heartbeat_tick(targets)
             stop_ev.wait(self.config.heartbeat_interval_s)
 
     def dead_nodes(self, timeout_s: Optional[float] = None) -> List[str]:

@@ -29,7 +29,215 @@ from typing import Dict, Optional, Tuple
 from geomx_tpu.core.config import Config, NodeId, Topology
 from geomx_tpu.transport import message as _message
 from geomx_tpu.transport.message import Message
+from geomx_tpu.transport.reactor import Reactor, resolve_transport
 from geomx_tpu.transport.van import FaultPolicy, _Mailbox
+
+
+class _RecvConn:
+    """Reactor-mode inbound connection: a readiness-driven scatter-gather
+    ``recv_into`` state machine (8-byte length header, then the frame
+    body into ONE writeable bytearray) replacing the per-connection recv
+    thread.  The completed buffer goes straight to
+    ``Message.from_bytes`` — zero-copy views over the receive buffer,
+    exactly the wire-v2 contract the thread path honors."""
+
+    __slots__ = ("fabric", "sock", "box", "_hdr", "_hdr_view", "_hdr_got",
+                 "_buf", "_view", "_got", "_need", "_reg")
+
+    def __init__(self, fabric: "TcpFabric", sock: socket.socket,
+                 box: _Mailbox):
+        self.fabric = fabric
+        self.sock = sock
+        self.box = box
+        self._hdr = bytearray(8)
+        self._hdr_view = memoryview(self._hdr)
+        self._hdr_got = 0
+        self._buf: Optional[bytearray] = None
+        self._view: Optional[memoryview] = None
+        self._got = 0
+        self._need = 0
+        sock.setblocking(False)
+        self._reg = fabric.reactor.register(sock, read_cb=self._on_readable)
+
+    def _on_readable(self):
+        try:
+            while True:
+                if self._buf is None:
+                    n = self.sock.recv_into(self._hdr_view[self._hdr_got:],
+                                            8 - self._hdr_got)
+                    if n == 0:
+                        self.close()
+                        return
+                    self._hdr_got += n
+                    if self._hdr_got < 8:
+                        continue
+                    (need,) = struct.unpack("<q", self._hdr)
+                    self._hdr_got = 0
+                    if need <= 0:
+                        continue  # defensive: empty frame
+                    self._buf = bytearray(need)
+                    self._view = memoryview(self._buf)
+                    self._got = 0
+                    self._need = need
+                else:
+                    n = self.sock.recv_into(self._view[self._got:],
+                                            self._need - self._got)
+                    if n == 0:
+                        self.close()
+                        return
+                    self._got += n
+                    if self._got < self._need:
+                        continue
+                    buf = self._buf
+                    self._buf = self._view = None
+                    # the frame buffer is a WRITEABLE bytearray this
+                    # state machine never touches again: from_bytes
+                    # returns zero-copy np.frombuffer views over it and
+                    # the ``donated`` contract lets servers adopt them
+                    try:
+                        self.box.put(Message.from_bytes(buf))
+                    except Exception:
+                        # a malformed frame poisons the stream framing —
+                        # drop the connection like the thread path does
+                        # when the decode raises out of its loop
+                        import logging
+
+                        logging.getLogger(__name__).exception(
+                            "reactor recv: frame decode failed")
+                        self.close()
+                        return
+        except (BlockingIOError, InterruptedError):
+            return  # drained: wait for the next readiness event
+        except OSError:
+            self.close()
+
+    def close(self):
+        self._reg.close()
+        with self.fabric._registry_mu:
+            try:
+                self.fabric._accepted.remove(self)
+            except ValueError:
+                pass
+
+
+class _SendConn:
+    """Reactor-mode outbound connection: non-blocking sends with a
+    per-connection write queue drained on write readiness.  The caller
+    tries an optimistic ``sendmsg`` first (the common, uncongested
+    case costs no loop round-trip); leftovers queue and arm write
+    interest.  Backpressure: a sender whose queue passes the high
+    watermark BLOCKS until the loop drains it below — the same
+    flow-control a blocking socket applied, without a thread per
+    connection."""
+
+    HIGH_WATER = int(os.environ.get("GEOMX_REACTOR_SENDQ_MAX",
+                                    str(64 << 20)))
+    _IOV = 64  # buffers per sendmsg call (stays far under IOV_MAX)
+
+    __slots__ = ("sock", "broken", "_mu", "_cv", "_bufs", "_queued",
+                 "_reg")
+
+    def __init__(self, sock: socket.socket, reactor: Reactor):
+        self.sock = sock
+        self.broken = False
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._bufs: list = []
+        self._queued = 0
+        sock.setblocking(False)
+        self._reg = reactor.register(sock, read_cb=self._on_readable,
+                                     write_cb=self._on_writable)
+
+    # outgoing conns receive nothing: readable means peer EOF/reset
+    def _on_readable(self):
+        try:
+            data = self.sock.recv(4096)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            data = b""
+        if not data:
+            self._break_locked_notify()
+
+    def _break_locked_notify(self):
+        with self._cv:
+            self.broken = True
+            self._bufs.clear()
+            self._queued = 0
+            self._cv.notify_all()
+        self._reg.close()
+
+    @staticmethod
+    def _advance(bufs: list, sent: int) -> None:
+        while sent > 0 and bufs:
+            n = bufs[0].nbytes
+            if sent >= n:
+                sent -= n
+                bufs.pop(0)
+            else:
+                bufs[0] = bufs[0][sent:]
+                sent = 0
+
+    def send(self, frames) -> None:
+        """Queue one message's frames atomically (whole-frame-list
+        append under the lock keeps concurrent senders' messages from
+        interleaving).  Raises OSError when the connection is broken —
+        the fabric's redial-once path takes over."""
+        bufs = [memoryview(f).cast("B") for f in frames]
+        with self._cv:
+            if self.broken:
+                raise OSError(errno.EPIPE, "reactor send conn broken")
+            if not self._bufs:
+                # optimistic fast path: push what the kernel will take
+                try:
+                    while bufs:
+                        sent = self.sock.sendmsg(bufs[:self._IOV])
+                        self._advance(bufs, sent)
+                except (BlockingIOError, InterruptedError):
+                    pass
+                except OSError:
+                    self.broken = True
+                    self._reg.close()
+                    raise
+            if bufs:
+                self._queued += sum(b.nbytes for b in bufs)
+                self._bufs.extend(bufs)
+                self._reg.want_write(True)
+                while self._queued > self.HIGH_WATER and not self.broken:
+                    self._cv.wait(timeout=1.0)  # backpressure
+                if self.broken:
+                    raise OSError(errno.EPIPE, "reactor send conn broke "
+                                               "under backpressure")
+
+    def _on_writable(self):
+        with self._cv:
+            try:
+                while self._bufs:
+                    sent = self.sock.sendmsg(self._bufs[:self._IOV])
+                    self._queued -= sent
+                    self._advance(self._bufs, sent)
+            except (BlockingIOError, InterruptedError):
+                pass
+            except OSError:
+                # broken mid-drain: the queued tail dies with the
+                # stream (the resend layer recovers reliable traffic)
+                self.broken = True
+                self._bufs.clear()
+                self._queued = 0
+                self._cv.notify_all()
+                self._reg.close()
+                return
+            if not self._bufs:
+                self._reg.want_write(False)
+            self._cv.notify_all()
+
+    def close(self):
+        with self._cv:
+            self.broken = True
+            self._bufs.clear()
+            self._queued = 0
+            self._cv.notify_all()
+        self._reg.close()
 
 
 def default_address_plan(topology: Topology, base_port: int = 9200,
@@ -81,6 +289,15 @@ class TcpFabric:
             fault = FaultPolicy.from_config(config) if config else FaultPolicy()
         self.fault = fault
         self.plan = plan
+        # event-driven mode (GEOMX_TRANSPORT=reactor / Config.transport):
+        # every endpoint in the process is serviced by the shared
+        # per-process Reactor — non-blocking accept, readiness-driven
+        # recv state machines, write queues — instead of accept/recv
+        # threads per listener/connection.  "threads" (default) keeps
+        # the pre-reactor path bit-for-bit.
+        self.mode = resolve_transport(config)
+        self.reactor = Reactor.shared() if self.mode == "reactor" else None
+        self._reactor_regs: list = []  # listener/udp registrations
         self._boxes: Dict[str, _Mailbox] = {}
         self._listeners = []
         self._conns: Dict[str, socket.socket] = {}
@@ -178,12 +395,54 @@ class TcpFabric:
             self._sys_dropped = system_counter(f"{s}.tcp_dropped")
             self._sys_udp_dropped = system_counter(f"{s}.tcp_udp_dropped")
         self._listeners.append(srv)
-        threading.Thread(target=self._accept_loop, args=(srv, box),
-                         name=f"tcp-accept-{s}", daemon=True).start()
         self._udp_recv.append(udp)
-        threading.Thread(target=self._udp_recv_loop, args=(udp, box),
-                         name=f"udp-recv-{s}", daemon=True).start()
+        if self.reactor is not None:
+            # reactor mode: no accept thread, no UDP thread, no thread
+            # per accepted connection — the shared loops service all of
+            # them via readiness callbacks
+            srv.setblocking(False)
+            udp.setblocking(False)
+            self._reactor_regs.append(self.reactor.register(
+                srv, read_cb=lambda: self._accept_ready(srv, box)))
+            self._reactor_regs.append(self.reactor.register(
+                udp, read_cb=lambda: self._udp_ready(udp, box)))
+        else:
+            threading.Thread(target=self._accept_loop, args=(srv, box),
+                             name=f"tcp-accept-{s}", daemon=True).start()
+            threading.Thread(target=self._udp_recv_loop, args=(udp, box),
+                             name=f"udp-recv-{s}", daemon=True).start()
         return box
+
+    # ---- reactor-mode readiness callbacks -----------------------------------
+    def _accept_ready(self, srv: socket.socket, box: _Mailbox):
+        while not self._stop:
+            try:
+                conn, _ = srv.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            rc = _RecvConn(self, conn, box)
+            with self._registry_mu:
+                self._accepted.append(rc)
+
+    def _udp_ready(self, sock: socket.socket, box: _Mailbox):
+        while not self._stop:
+            try:
+                data, _ = sock.recvfrom(65535)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            if not data:
+                continue  # shutdown poke
+            try:
+                msg = Message.from_bytes(data)
+            except Exception:
+                continue  # truncated/corrupt datagram: lossy by design
+            with self._registry_mu:
+                self.udp_datagrams_recv += 1
+            box.put(msg)
 
     def _udp_recv_loop(self, sock: socket.socket, box: _Mailbox):
         while not self._stop:
@@ -197,7 +456,7 @@ class TcpFabric:
                 continue  # truncated/corrupt datagram: lossy by design
             with self._registry_mu:
                 self.udp_datagrams_recv += 1
-            box.q.put(msg)
+            box.put(msg)
 
     def _udp_sock(self, channel: int) -> socket.socket:
         with self._registry_mu:
@@ -240,7 +499,7 @@ class TcpFabric:
                 # np.frombuffer views over it, and the message's
                 # ``donated`` contract lets the server adopt them as
                 # its accumulators without a defensive copy
-                box.q.put(Message.from_bytes(data))
+                box.put(Message.from_bytes(data))
         except OSError:
             return  # connection torn down (peer reset or fabric shutdown)
         finally:
@@ -295,7 +554,7 @@ class TcpFabric:
         dest = str(msg.recipient)
         box = self._boxes.get(dest)
         if box is not None:  # local shortcut (several roles per process)
-            box.q.put(msg)
+            box.put(msg)
             return True
         if dest not in self.plan:
             raise KeyError(f"no mailbox for {msg.recipient}")
@@ -328,10 +587,13 @@ class TcpFabric:
             mu = self._conn_mus.setdefault(dest, threading.Lock())
         with mu:
             conn = self._conns.get(dest)
-            if conn is None:
+            if conn is None or getattr(conn, "broken", False):
+                if conn is not None:  # async write failure marked it
+                    conn.close()
+                    self._conns.pop(dest, None)
                 conn = self._dial(dest)
             try:
-                self._sendmsg_all(conn, frames)
+                self._send_on(conn, frames)
             except OSError:
                 # peer restarted: redial once; drop the dead socket from
                 # the registry first so a failed redial doesn't leave it
@@ -341,8 +603,17 @@ class TcpFabric:
                 conn.close()
                 self._conns.pop(dest, None)
                 conn = self._dial(dest)
-                self._sendmsg_all(conn, frames)
+                self._send_on(conn, frames)
         return True
+
+    def _send_on(self, conn, frames) -> None:
+        """One message onto ``conn`` — blocking ``sendmsg`` loop on the
+        thread path, write-queue submit (with backpressure) on a
+        reactor ``_SendConn``."""
+        if isinstance(conn, _SendConn):
+            conn.send(frames)
+        else:
+            self._sendmsg_all(conn, frames)
 
     @staticmethod
     def _sendmsg_all(conn: socket.socket, frames) -> None:
@@ -399,6 +670,11 @@ class TcpFabric:
                     raise
                 time.sleep(0.1)
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self.reactor is not None:
+            # wrap in the write-queue state machine; the reactor's loop
+            # drains it on write readiness — no send thread, no redials
+            # hidden inside the loop
+            conn = _SendConn(conn, self.reactor)
         with self._registry_mu:
             if self._stop:  # lost the race against shutdown()
                 conn.close()
@@ -462,14 +738,24 @@ class TcpFabric:
                 sock.sendto(b"", ("127.0.0.1", port))
             except OSError:
                 pass
+        # reactor mode: unregister the listener/udp fds from the shared
+        # loops (closing their sockets as a side effect — the reactor
+        # itself is process-lifetime and keeps running for other users)
+        for reg in self._reactor_regs:
+            reg.close()
+        self._reactor_regs.clear()
+        # snapshot under the lock, close OUTSIDE it: a reactor
+        # _RecvConn.close re-enters _registry_mu to delist itself
         with self._registry_mu:
-            for c in (list(self._conns.values()) + self._accepted
-                      + self._udp_recv + list(self._udp_send.values())):
-                try:
-                    c.close()
-                except OSError:
-                    pass
+            targets = (list(self._conns.values()) + list(self._accepted)
+                       + list(self._udp_recv)
+                       + list(self._udp_send.values()))
             self._conns.clear()
             self._accepted.clear()
             self._udp_recv.clear()
             self._udp_send.clear()
+        for c in targets:
+            try:
+                c.close()
+            except OSError:
+                pass

@@ -163,8 +163,42 @@ class FaultPolicy:
 
 
 class _Mailbox:
+    """Per-node inbox.  Legacy path: a queue.Queue drained by the Van's
+    recv thread.  Lightweight/reactor path: a SerialChannel sink is
+    attached (``Van.start``) and ``put`` routes straight into it — same
+    FIFO order, dispatched on the shared handler pool instead of a
+    dedicated thread.  Fabrics must deliver via :meth:`put` (never
+    ``q.put`` directly) so both paths work."""
+
     def __init__(self):
         self.q: "queue.Queue[Message]" = queue.Queue()
+        self._sink = None
+        self._mu = threading.Lock()
+
+    def put(self, msg: Message) -> None:
+        with self._mu:
+            sink = self._sink
+            if sink is not None:
+                # inside the lock: a concurrent detach must not race a
+                # put into a channel being closed
+                sink.put(msg)
+                return
+        self.q.put(msg)
+
+    def attach_sink(self, sink) -> None:
+        """Route future (and already-queued) messages into ``sink`` —
+        queued backlog first, preserving arrival order."""
+        with self._mu:
+            while True:
+                try:
+                    sink.put(self.q.get_nowait())
+                except queue.Empty:
+                    break
+            self._sink = sink
+
+    def detach_sink(self) -> None:
+        with self._mu:
+            self._sink = None
 
 
 class InProcFabric:
@@ -183,12 +217,22 @@ class InProcFabric:
         fault: Optional[FaultPolicy] = None,
         config: Optional[Config] = None,
         serial: Optional[bool] = None,
+        reactor=None,
+        lightweight: bool = False,
     ):
         if fault is None:
             fault = FaultPolicy.from_config(config) if config else FaultPolicy()
         self.fault = fault
         self.serial = bool(serial if serial is not None
                            else (config.deterministic if config else False))
+        # lightweight-party mode (transport/reactor.py): vans/customers
+        # on this fabric dispatch through serial channels on the shared
+        # reactor instead of per-node threads, and timer loops (resend,
+        # heartbeat, monitors) land on the reactor's timer wheel.
+        # Deterministic mode wins: the serial fabric's single dispatcher
+        # is already thread-free and globally ordered.
+        self.reactor = reactor
+        self.lightweight = bool(lightweight) and reactor is not None
         self._boxes: Dict[str, _Mailbox] = {}
         self._lock = threading.Lock()
         self._heap = []  # (due, tiebreak, msg)
@@ -305,7 +349,7 @@ class InProcFabric:
             box = self._boxes.get(str(msg.recipient))
         if box is None:
             raise KeyError(f"no mailbox for {msg.recipient}")
-        box.q.put(msg)
+        box.put(msg)
 
     def _timer_loop(self):
         while True:
@@ -389,6 +433,8 @@ class Van:
         self._box = fabric.register(node)
         self._receiver: Optional[Callable[[Message], None]] = None
         self._recv_thread: Optional[threading.Thread] = None
+        self._chan = None  # lightweight-mode serial dispatch channel
+        self._resend_task = None  # timer-wheel resend entry
         self._send_thread: Optional[threading.Thread] = None
         self._pq: "queue.PriorityQueue" = queue.PriorityQueue()
         self._pq_tie = itertools.count()
@@ -453,6 +499,13 @@ class Van:
             # deterministic mode: the fabric's single dispatcher calls
             # _handle_inbound in global FIFO order — no recv thread
             self.fabric.set_serial_receiver(self.node, self._handle_inbound)
+        elif getattr(self.fabric, "lightweight", False):
+            # lightweight-party mode: a serial channel on the shared
+            # reactor pool replaces the per-node recv thread — same
+            # per-node FIFO order, O(1) threads in node count
+            self._chan = self.fabric.reactor.channel(
+                self._handle_inbound, name=f"van-{self.node}")
+            self._box.attach_sink(self._chan)
         else:
             self._recv_thread = threading.Thread(
                 target=self._recv_loop, name=f"van-recv-{self.node}",
@@ -465,10 +518,18 @@ class Van:
             )
             self._send_thread.start()
         if self._resend_timeout > 0:
-            self._resend_thread = threading.Thread(
-                target=self._resend_loop, name=f"van-resend-{self.node}", daemon=True
-            )
-            self._resend_thread.start()
+            reactor = getattr(self.fabric, "reactor", None)
+            if reactor is not None:
+                # timer-wheel entry instead of a per-node sleep thread
+                self._resend_task = reactor.call_every(
+                    self._resend_timeout / 2, self._resend_sweep,
+                    name=f"van-resend-{self.node}")
+            else:
+                self._resend_thread = threading.Thread(
+                    target=self._resend_loop,
+                    name=f"van-resend-{self.node}", daemon=True
+                )
+                self._resend_thread.start()
 
     def stop(self):
         if not self._running:
@@ -476,6 +537,9 @@ class Van:
             #         a second self-stopper would sit in the mailbox and
             #         instantly kill a revived zombie's receive loop
         self._running = False
+        if self._resend_task is not None:
+            self._resend_task.cancel()
+            self._resend_task = None
         if getattr(self.fabric, "serial", False):
             # unregister so a "killed" node stops processing — without
             # this a deterministic-mode restart test would keep the ghost
@@ -483,12 +547,22 @@ class Van:
             remove = getattr(self.fabric, "remove_serial_receiver", None)
             if remove is not None:
                 remove(self.node, self._handle_inbound)
-        stopper = Message(sender=self.node, recipient=self.node, control=Control.TERMINATE)
-        self._box.q.put(stopper)
+        if self._chan is not None:
+            # detach FIRST (later arrivals fall into the unread queue —
+            # a stopped node processes nothing further), then drop the
+            # channel's backlog
+            self._box.detach_sink()
+            self._chan.close()
+            self._chan = None
+        else:
+            stopper = Message(sender=self.node, recipient=self.node,
+                              control=Control.TERMINATE)
+            self._box.put(stopper)
         if self._use_send_thread:
             self._pq.put((0, next(self._pq_tie), None))
         if self._recv_thread:
             self._recv_thread.join(timeout=5)
+            self._recv_thread = None
 
     def kill(self):
         """Thread-level SIGKILL for tests: stop receiving AND silently
@@ -703,23 +777,30 @@ class Van:
     def _resend_loop(self):
         while self._running:
             time.sleep(self._resend_timeout / 2)
-            now = time.monotonic()
-            for sig, entry in list(self._pending_acks.items()):
-                if not self._running:
-                    return
-                msg, last_send, num_retry = entry
-                # exponential-ish backoff like the reference:
-                # timeout * (1 + num_retry)  (ref: resender.h)
-                if now - last_send < self._resend_timeout * (1 + num_retry):
-                    continue
-                if num_retry >= self._max_retries:
-                    logging.getLogger(__name__).warning(
-                        "giving up on message sig=%s to %s after %d retries",
-                        sig, msg.recipient, num_retry,
-                    )
-                    self._pending_acks.pop(sig, None)
-                    continue
-                entry[1] = now
-                entry[2] = num_retry + 1
-                self._account_send(msg)  # retransmits are real wire bytes
-                self._deliver_guarded(msg)
+            self._resend_sweep()
+
+    def _resend_sweep(self):
+        """One pass over the un-ACKed window (the resend thread's loop
+        body, also the timer-wheel entry in reactor mode)."""
+        if not self._running:
+            return
+        now = time.monotonic()
+        for sig, entry in list(self._pending_acks.items()):
+            if not self._running:
+                return
+            msg, last_send, num_retry = entry
+            # exponential-ish backoff like the reference:
+            # timeout * (1 + num_retry)  (ref: resender.h)
+            if now - last_send < self._resend_timeout * (1 + num_retry):
+                continue
+            if num_retry >= self._max_retries:
+                logging.getLogger(__name__).warning(
+                    "giving up on message sig=%s to %s after %d retries",
+                    sig, msg.recipient, num_retry,
+                )
+                self._pending_acks.pop(sig, None)
+                continue
+            entry[1] = now
+            entry[2] = num_retry + 1
+            self._account_send(msg)  # retransmits are real wire bytes
+            self._deliver_guarded(msg)

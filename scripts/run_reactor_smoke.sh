@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Event-driven transport smoke lane (ISSUE 12 satellite): run the
+# transport + kvstore/failover/eviction/sharded-global parity subset
+# with GEOMX_TRANSPORT=reactor, so the reactor fabric (selector loops,
+# write queues, timer wheel) and the lightweight-party dispatch path
+# cannot silently rot while tier-1 runs the default threads transport.
+# In-proc Simulations flip into lightweight mode under this knob;
+# TcpFabric tests exercise the real non-blocking wire path.
+#
+# Env: PYTEST_ARGS (extra pytest flags), GEOMX_REACTOR_LOOPS (loop pool
+# size, default auto = min(4, cpus)), GEOMX_REACTOR_WORKERS (handler
+# pool).  The 128-party soak is separate: pytest -m scale.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export JAX_PLATFORM_NAME=cpu
+export GEOMX_TRANSPORT=reactor
+
+exec python -m pytest -q -m 'not slow' -p no:cacheprovider \
+  tests/test_reactor.py tests/test_transport.py tests/test_tcp.py \
+  tests/test_wire_v2.py tests/test_ps.py tests/test_kvstore.py \
+  tests/test_failover.py tests/test_eviction.py \
+  tests/test_sharded_global.py tests/test_recovery.py \
+  ${PYTEST_ARGS:-}

@@ -31,6 +31,54 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+# Thread names that are allowed to outlive a Simulation: process-
+# lifetime shared pools (fixed-size, O(1) in node count, by design
+# never torn down) plus interpreter/jax internals.
+_PROCESS_LIFETIME_THREADS = (
+    "geomx-reactor",   # shared reactor loops + handler pool
+    "geomx-codec",     # shared codec pool (kvstore/common.py)
+    "axpy-calibrate",  # eager native-merge calibration
+    "fabric-serial",   # deterministic-mode dispatcher (shut by fabric)
+    "pydevd", "ThreadPoolExecutor",  # debugger / stdlib internals
+)
+
+
+def _leaked_threads(before):
+    import threading
+
+    out = []
+    for t in threading.enumerate():
+        if t in before or not t.is_alive():
+            continue
+        if any(t.name.startswith(p) for p in _PROCESS_LIFETIME_THREADS):
+            continue
+        out.append(t)
+    return out
+
+
+@pytest.fixture
+def thread_leak_guard():
+    """Snapshot ``threading.enumerate()`` before the test body and
+    assert the process returns to baseline after it (ISSUE 12
+    satellite): per-connection recv threads, per-node van/customer/
+    timer threads and monitor loops must all be gone once the
+    Simulation/fabric shuts down.  Stop-flagged sleep loops exit within
+    their interval, so the check polls briefly before failing."""
+    import threading
+    import time
+
+    before = set(threading.enumerate())
+    yield
+    deadline = time.monotonic() + 15.0
+    leaked = _leaked_threads(before)
+    while leaked and time.monotonic() < deadline:
+        time.sleep(0.05)
+        leaked = _leaked_threads(before)
+    assert not leaked, (
+        "threads leaked past shutdown: "
+        + ", ".join(sorted(t.name for t in leaked)))
+
+
 @pytest.fixture(autouse=True)
 def _fresh_system_metrics():
     """Every test starts from an empty system-metrics registry.

@@ -30,10 +30,13 @@ EXPANSIONS = {
     "{self.node}.health_{r}_alerts": [
         f"health_{r}_alerts" for r in RULES],
     # the flight recorder's pressure gauges (obs/flight.py
-    # add_pressure): the van's send-queue probe is registered by the
-    # Postoffice, the merge-side trio by attach_server_pressure
+    # add_pressure): the van's send-queue / process-thread / reactor
+    # probes are registered by the Postoffice, the merge-side trio by
+    # attach_server_pressure
     "{self.node}.{name}": ["lock_wait_s", "lane_depth",
-                           "van_sendq_depth", "codec_pool_busy"],
+                           "van_sendq_depth", "codec_pool_busy",
+                           "process_threads", "reactor_loop_lag_ms",
+                           "reactor_fds"],
 }
 
 

@@ -1,0 +1,497 @@
+"""Event-driven transport core (ISSUE 12): reactor loops, timer wheel,
+lightweight-party mode.
+
+Pins the properties the O(100)-party harness rests on:
+
+1.  ``SerialChannel`` preserves per-channel FIFO order on the shared
+    pool — the ordering guarantee the per-node recv/customer threads
+    provided;
+2.  the reactor ``TcpFabric`` keeps the wire-v2 zero-copy contract
+    (decoded arrays alias the receive buffer, adopt uncopied) and the
+    UDP lossy channel;
+3.  lightweight simulations are BITWISE equal to the threads transport
+    (integer grads → exact sums) while running O(1) threads in node
+    count, with heartbeat/resend/monitor loops absorbed by the timer
+    wheel (no per-node timer threads);
+4.  both transports return the process to its thread baseline after
+    ``Simulation.shutdown()`` (the thread-leak guard satellite);
+5.  the reactor pressure gauges (``process_threads`` /
+    ``reactor_loop_lag_ms`` / ``reactor_fds``) land in the flight
+    recorder and the system-metrics registry.
+
+The 128-party / 512-worker soak is marked ``scale`` (and ``slow``) so
+it stays out of tier-1 but runs on demand: ``pytest -m scale``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomx_tpu.core.config import Config, Topology
+from geomx_tpu.kvstore import Simulation
+from geomx_tpu.transport.reactor import (Periodic, Reactor,
+                                         resolve_transport)
+
+
+def free_base_port(span: int = 16):
+    """A base port with ``span`` consecutive free ports, outside the
+    kernel ephemeral range (see tests/test_tcp.py for the rationale)."""
+    import random
+    import socket
+
+    for _ in range(200):
+        base = random.randrange(18000, 28000)
+        try:
+            socks = []
+            try:
+                for i in range(span):
+                    s = socket.socket()
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                    s.bind(("127.0.0.1", base + i))
+                    socks.append(s)
+            finally:
+                for s in socks:
+                    s.close()
+            return base
+        except OSError:
+            continue
+    raise RuntimeError("no free port span found")
+
+
+# ---------------------------------------------------------------------------
+# reactor primitives
+# ---------------------------------------------------------------------------
+
+def test_resolve_transport_precedence(monkeypatch):
+    monkeypatch.delenv("GEOMX_TRANSPORT", raising=False)
+    assert resolve_transport(None) == "threads"
+    monkeypatch.setenv("GEOMX_TRANSPORT", "reactor")
+    assert resolve_transport(None) == "reactor"
+    # an explicit Config field wins over the env
+    cfg = Config(topology=Topology(), transport="threads")
+    assert resolve_transport(cfg) == "threads"
+    monkeypatch.setenv("GEOMX_TRANSPORT", "bogus")
+    with pytest.raises(ValueError):
+        resolve_transport(None)
+    with pytest.raises(ValueError):
+        Config(topology=Topology(), transport="bogus")
+
+
+def test_serial_channel_preserves_fifo_under_concurrency():
+    """N producers race one channel: the consumer must observe every
+    producer's items in that producer's put order (the per-node message
+    order the dedicated recv thread guaranteed)."""
+    r = Reactor(loops=1, workers=4, name="t-reactor-fifo")
+    try:
+        got = []
+        mu = threading.Lock()
+
+        def consume(item):
+            with mu:
+                got.append(item)
+
+        chan = r.channel(consume, name="t-chan")
+        n_producers, per = 8, 200
+
+        def produce(pid):
+            for i in range(per):
+                chan.put((pid, i))
+
+        ts = [threading.Thread(target=produce, args=(p,))
+              for p in range(n_producers)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with mu:
+                if len(got) == n_producers * per:
+                    break
+            time.sleep(0.01)
+        with mu:
+            assert len(got) == n_producers * per
+            seen = {p: -1 for p in range(n_producers)}
+            for pid, i in got:
+                assert i == seen[pid] + 1, (
+                    f"producer {pid} reordered: {i} after {seen[pid]}")
+                seen[pid] = i
+        chan.close()
+        chan.put(("late", 0))  # closed channel drops silently
+    finally:
+        r.stop()
+
+
+def test_timer_wheel_fires_and_cancels():
+    r = Reactor(loops=1, workers=2, name="t-reactor-timer")
+    try:
+        fired = []
+        task = r.call_every(0.05, lambda: fired.append(time.monotonic()),
+                            name="t-tick")
+        deadline = time.monotonic() + 5
+        while len(fired) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(fired) >= 3, "repeating timer never fired"
+        task.cancel()
+        time.sleep(0.15)
+        n = len(fired)
+        time.sleep(0.2)
+        assert len(fired) == n, "cancelled timer kept firing"
+        # Periodic helper on the same wheel
+        hits = []
+        p = Periodic(0.05, lambda: hits.append(1), name="t-per", reactor=r)
+        deadline = time.monotonic() + 5
+        while not hits and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert hits, "Periodic-on-reactor never fired"
+        p.stop()
+        assert r.loop_lag_ms() >= 0.0
+        assert isinstance(r.fd_counts(), list)
+    finally:
+        r.stop()
+
+
+# ---------------------------------------------------------------------------
+# reactor TcpFabric: wire parity + zero-copy + UDP
+# ---------------------------------------------------------------------------
+
+def _tcp_pair(base_port):
+    """Two reactor-mode fabrics over one plan (a real wire between
+    them — same-fabric delivery would take the in-proc shortcut)."""
+    from geomx_tpu.transport import Van
+    from geomx_tpu.transport.tcp import TcpFabric, default_address_plan
+
+    topo = Topology(num_parties=1, workers_per_party=1)
+    plan = default_address_plan(topo, base_port=base_port)
+    cfg = Config(topology=topo, transport="reactor")
+    fab_a = TcpFabric(dict(plan), config=cfg)
+    fab_b = TcpFabric(dict(plan), config=cfg)
+    a, b = topo.workers(0)[0], topo.server(0)
+    return cfg, fab_a, fab_b, Van(a, fab_a, config=cfg), \
+        Van(b, fab_b, config=cfg), a, b
+
+
+def test_tcp_reactor_roundtrip_zero_copy():
+    """The PR 5 zero-copy contract survives the reactor recv state
+    machine: decoded vals are writeable views over the receive buffer
+    and the server adopt gate takes them WITHOUT a copy."""
+    from geomx_tpu.kvstore.server import _adopt_or_copy
+    from geomx_tpu.transport.message import Domain, Message
+
+    cfg, fab_a, fab_b, van_a, van_b, a, b = _tcp_pair(free_base_port())
+    try:
+        got, ev = [], threading.Event()
+        van_a.start(lambda m: None)
+        van_b.start(lambda m: (got.append(m), ev.set()))
+        vals = np.arange(1_000_000, dtype=np.float32)
+        van_a.send(Message(recipient=b, domain=Domain.LOCAL,
+                           keys=np.array([7], np.int64), vals=vals,
+                           lens=np.array([vals.size], np.int64),
+                           push=True, request=True))
+        assert ev.wait(15), "reactor fabric never delivered"
+        m = got[0]
+        np.testing.assert_array_equal(m.vals, vals)
+        assert m.donated, "wire decode lost the donated flag"
+        assert m.vals.flags.writeable
+        assert m.vals.base is not None, "decode copied off the buffer"
+        assert m.vals.ctypes.data % 8 == 0, "payload lost its alignment"
+        adopted = _adopt_or_copy(m.vals, m.donated)
+        assert adopted is m.vals, "adopt gate copied a donated wire view"
+    finally:
+        van_a.stop()
+        van_b.stop()
+        fab_a.shutdown()
+        fab_b.shutdown()
+
+
+def test_tcp_reactor_many_messages_and_udp():
+    """Ordering + completeness over the framed stream (200 messages
+    through the recv state machine) and the lossy UDP channel."""
+    from geomx_tpu.transport.message import Domain, Message
+
+    cfg, fab_a, fab_b, van_a, van_b, a, b = _tcp_pair(free_base_port())
+    try:
+        seen, done = [], threading.Event()
+
+        def on_b(m):
+            seen.append(int(m.keys[0]))
+            if len(seen) >= 200:
+                done.set()
+
+        van_a.start(lambda m: None)
+        van_b.start(on_b)
+        for i in range(200):
+            van_a.send(Message(recipient=b, domain=Domain.LOCAL,
+                               keys=np.array([i], np.int64),
+                               vals=np.full(64, i, np.float32),
+                               lens=np.array([64], np.int64),
+                               push=True, request=True))
+        assert done.wait(20), f"only {len(seen)}/200 frames arrived"
+        assert seen == list(range(200)), "stream reordered or torn"
+        # lossy channel: datagram-sized payload rides UDP end to end
+        got_udp = threading.Event()
+        van_a.stop()
+        van_a.start(lambda m: got_udp.set())
+        van_b.send(Message(recipient=a, domain=Domain.LOCAL, channel=1,
+                           keys=np.array([1], np.int64),
+                           vals=np.ones(64, np.float32),
+                           lens=np.array([64], np.int64),
+                           push=True, request=True))
+        assert got_udp.wait(10), "UDP lossy channel never delivered"
+        assert fab_b.udp_datagrams_sent >= 1
+        assert fab_a.udp_datagrams_recv >= 1
+    finally:
+        van_a.stop()
+        van_b.stop()
+        fab_a.shutdown()
+        fab_b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# lightweight-party mode: parity + thread elision
+# ---------------------------------------------------------------------------
+
+def _fsa_weights(lightweight: bool, deterministic: bool = False,
+                 rounds: int = 3):
+    """One small FSA run; integer-valued grads + power-of-two lr make
+    every merge/optimizer op exact, so transports must agree BITWISE."""
+    cfg = Config(topology=Topology(num_parties=2, workers_per_party=2),
+                 deterministic=deterministic, enable_flight=False)
+    sim = Simulation(cfg, lightweight=lightweight)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(256, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.5})
+        g = np.full(256, 2.0, np.float32)
+        out = None
+        for _ in range(rounds):
+            for w in ws:
+                w.push(0, g)
+            for w in ws:
+                out = w.pull_sync(0)
+                w.wait_all()
+        return np.array(out, copy=True)
+    finally:
+        sim.shutdown()
+
+
+def test_lightweight_fsa_bitwise_parity_vs_threads():
+    w_threads = _fsa_weights(lightweight=False)
+    w_light = _fsa_weights(lightweight=True)
+    assert w_threads.dtype == w_light.dtype
+    assert np.array_equal(w_threads, w_light), (
+        "lightweight mode diverged from the threads transport")
+
+
+def test_deterministic_bit_identical_across_transports():
+    """Deterministic mode (serial fabric) must stay bit-identical
+    whatever the transport knob says — the reactor path defers to the
+    NaiveEngine-analog dispatcher."""
+    a = _fsa_weights(lightweight=False, deterministic=True)
+    b = _fsa_weights(lightweight=True, deterministic=True)
+    assert np.array_equal(a, b)
+
+
+def test_lightweight_thread_count_is_o1_in_party_count():
+    """The tentpole claim: per-process thread count bounded by the
+    reactor pool, not node count.  8 parties x 2 workers = 35 nodes;
+    the thread-per-endpoint harness spends ~10 threads per party on
+    this topology, lightweight mode must not grow with parties."""
+    before = threading.active_count()
+    cfg = Config(topology=Topology(num_parties=8, workers_per_party=2),
+                 enable_flight=False)
+    sim = Simulation(cfg, lightweight=True)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(64, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.5})
+        for w in ws:
+            w.push(0, np.ones(64, np.float32))
+        for w in ws:
+            w.pull_sync(0)
+            w.wait_all()
+        grown = threading.active_count() - before
+        # reactor loops + lazily-spawned pool workers, NOT ~80 per-node
+        # threads (the legacy harness at this topology)
+        budget = sim.reactor.loops + sim.reactor.workers + 4
+        assert grown <= budget, (
+            f"lightweight sim grew {grown} threads "
+            f"(> reactor budget {budget}) — per-node stacks are back")
+    finally:
+        sim.shutdown()
+
+
+def test_timer_wheel_absorbs_heartbeat_and_resend_threads():
+    """With heartbeats + the resender on, a lightweight sim must run
+    ZERO per-node timer threads (heartbeat-* / van-resend-*) and zero
+    per-node dispatch threads (van-recv-* / customer-*) — they all
+    live on the shared wheel/pool — while heartbeats still arrive at
+    the schedulers."""
+    before = set(threading.enumerate())  # earlier tests' stop-flagged
+    #                                      loops may still be winding down
+    cfg = Config(topology=Topology(num_parties=2, workers_per_party=2),
+                 heartbeat_interval_s=0.05, resend_timeout_ms=200,
+                 enable_flight=False)
+    sim = Simulation(cfg, lightweight=True)
+    try:
+        banned = ("heartbeat-", "van-resend-", "van-recv-", "customer-",
+                  "WorkerEvictionMonitor", "LocalServerRecoveryMonitor",
+                  "metrics-pump-")
+        names = [t.name for t in threading.enumerate() if t not in before]
+        offenders = [n for n in names
+                     if any(n.startswith(b) for b in banned)]
+        assert not offenders, f"per-node loops survived: {offenders}"
+        sched_po = sim.offices[str(sim.topology.scheduler(0))]
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with sched_po._lock:
+                if len(sched_po._heartbeats) >= 3:  # 2 workers + server
+                    break
+            time.sleep(0.02)
+        with sched_po._lock:
+            assert len(sched_po._heartbeats) >= 3, (
+                "timer-wheel heartbeats never reached the scheduler")
+    finally:
+        sim.shutdown()
+
+
+def test_reactor_pressure_gauges_registered():
+    """process_threads / reactor_loop_lag_ms / reactor_fds land in the
+    flight recorder's pressure sweep AND the system-metrics registry
+    (the press[...] console column and the pump read them back)."""
+    from geomx_tpu.utils.metrics import system_snapshot
+
+    cfg = Config(topology=Topology(num_parties=1, workers_per_party=1))
+    sim = Simulation(cfg, lightweight=True)
+    try:
+        po = sim.offices[str(sim.topology.global_scheduler())]
+        assert po.flight is not None
+        readings = po.flight.sample_pressure()
+        assert readings.get("process_threads", 0) >= 1
+        assert "reactor_loop_lag_ms" in readings
+        assert readings.get("reactor_fds") is not None
+        snap = system_snapshot(prefix=f"{po.node}.", skip_unset=True)
+        assert f"{po.node}.process_threads" in snap
+        assert f"{po.node}.reactor_fds" in snap
+    finally:
+        sim.shutdown()
+
+
+def test_legacy_path_has_no_reactor_gauges():
+    """The threads transport must not grow reactor gauges (disabled
+    path = the pre-reactor recorder surface, exactly)."""
+    cfg = Config(topology=Topology(num_parties=1, workers_per_party=1))
+    sim = Simulation(cfg, lightweight=False)
+    try:
+        po = sim.offices[str(sim.topology.global_scheduler())]
+        readings = po.flight.sample_pressure()
+        assert "reactor_loop_lag_ms" not in readings
+        assert "reactor_fds" not in readings
+        assert "process_threads" in readings  # useful everywhere
+    finally:
+        sim.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# thread-leak guard (satellite): both transports return to baseline
+# ---------------------------------------------------------------------------
+
+def _leak_probe_sim(lightweight: bool):
+    cfg = Config(topology=Topology(num_parties=2, workers_per_party=2),
+                 heartbeat_interval_s=0.2, resend_timeout_ms=500,
+                 enable_flight=False)
+    sim = Simulation(cfg, lightweight=lightweight)
+    ws = sim.all_workers()
+    for w in ws:
+        w.init(0, np.zeros(64, np.float32))
+    ws[0].set_optimizer({"type": "sgd", "lr": 0.5})
+    for w in ws:
+        w.push(0, np.ones(64, np.float32))
+    for w in ws:
+        w.pull_sync(0)
+        w.wait_all()
+    sim.shutdown()
+
+
+def test_thread_leak_guard_legacy_transport(thread_leak_guard):
+    _leak_probe_sim(lightweight=False)
+
+
+def test_thread_leak_guard_lightweight_transport(thread_leak_guard):
+    _leak_probe_sim(lightweight=True)
+
+
+def test_thread_leak_guard_tcp_reactor_fabric(thread_leak_guard):
+    """Reactor TCP fabric shutdown unregisters every fd and leaves no
+    per-connection threads behind (there were none to begin with)."""
+    from geomx_tpu.transport import Van
+    from geomx_tpu.transport.message import Domain, Message
+    from geomx_tpu.transport.tcp import TcpFabric, default_address_plan
+
+    topo = Topology(num_parties=1, workers_per_party=1)
+    plan = default_address_plan(topo, base_port=free_base_port())
+    cfg = Config(topology=topo, transport="reactor")
+    fab = TcpFabric(plan, config=cfg)
+    a, b = topo.workers(0)[0], topo.server(0)
+    van_a, van_b = Van(a, fab, config=cfg), Van(b, fab, config=cfg)
+    ev = threading.Event()
+    van_a.start(lambda m: None)
+    van_b.start(lambda m: ev.set())
+    van_a.send(Message(recipient=b, domain=Domain.LOCAL,
+                       keys=np.array([1], np.int64),
+                       vals=np.ones(8, np.float32),
+                       lens=np.array([8], np.int64), push=True,
+                       request=True))
+    assert ev.wait(10)
+    before_fds = fab.reactor.fd_count()
+    assert before_fds >= 2  # 1 listener + 1 udp per registered node
+    van_a.stop()
+    van_b.stop()
+    fab.shutdown()
+    deadline = time.monotonic() + 5
+    while fab.reactor.fd_count() > 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert fab.reactor.fd_count() == 0, (
+        "fabric shutdown left fds registered on the shared reactor")
+
+
+# ---------------------------------------------------------------------------
+# the O(100)-party soak (out of tier-1: pytest -m scale)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.scale
+@pytest.mark.slow
+def test_128_party_512_worker_soak():
+    """Acceptance: a 128-party / 512-worker lightweight topology
+    completes a multi-round FSA run on one host with O(1) threads."""
+    before = threading.active_count()
+    cfg = Config(topology=Topology(num_parties=128, workers_per_party=4),
+                 enable_flight=False)
+    sim = Simulation(cfg, lightweight=True)
+    try:
+        ws = sim.all_workers()
+        assert len(ws) == 512
+        for w in ws:
+            w.init(0, np.zeros(4096, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.5})
+        g = np.full(4096, 2.0, np.float32)
+        out = None
+        for _ in range(3):
+            for w in ws:
+                w.push(0, g)
+            for w in ws:
+                out = w.pull_sync(0)
+                w.wait_all()
+        # 3 rounds of exact integer math: -lr * mean_grad * rounds
+        assert out is not None and np.all(out == out[0])
+        grown = threading.active_count() - before
+        budget = sim.reactor.loops + sim.reactor.workers + 8
+        assert grown <= budget, (
+            f"{grown} threads at 128 parties (budget {budget}) — "
+            "thread count is not O(1) in party count")
+    finally:
+        sim.shutdown()
