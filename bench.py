@@ -1851,6 +1851,131 @@ def child_flight():
     }))
 
 
+def child_churn():
+    """Elastic-membership churn cost (ISSUE 13): round wall and
+    stall-round count under a fixed seeded ChurnPlan at {8, 16, 24}
+    parties (lightweight reactor substrate) vs a stable control, plus
+    the drain-latency acceptance reading — the median
+    notice→member-folded latency must be a small fraction of the
+    eviction timeout (the whole point of the graceful path: membership
+    changes cost a drain, not a heartbeat-expiry window)."""
+    import numpy as np
+
+    from geomx_tpu.chaos import ChurnPhase, ChurnPlan
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.kvstore import Simulation
+
+    points = [int(x) for x in os.environ.get(
+        "BENCH_CHURN_POINTS", "8,16,24").split(",") if x]
+    N = int(os.environ.get("BENCH_CHURN_ELEMS", "65536"))
+    rounds = int(os.environ.get("BENCH_CHURN_ROUNDS", "24"))
+    seed = int(os.environ.get("GEOMX_CHURN_SEED", "7"))
+    hb_timeout = float(os.environ.get("GEOMX_HEARTBEAT_TIMEOUT", "1.0"))
+
+    def run_point(parties: int, churn: bool) -> dict:
+        cfg = Config(topology=Topology(num_parties=parties,
+                                       workers_per_party=2),
+                     enable_flight=False, lightweight=True,
+                     heartbeat_interval_s=0.05,
+                     heartbeat_timeout_s=hb_timeout,
+                     request_retry_s=0.5, enable_preempt=True)
+        sim = Simulation(cfg, lightweight=True)
+        try:
+            alive = {(w.party, w.rank): w for w in sim.all_workers()}
+            for w in alive.values():
+                w.init(0, np.zeros(N, np.float32))
+            next(iter(alive.values())).set_optimizer(
+                {"type": "sgd", "lr": 0.1})
+            g = np.ones(N, np.float32)
+            # a fixed seeded tape, spread evenly across the measured
+            # rounds (one event kind sequence for every point — the
+            # plan IS the workload contract)
+            plan = ChurnPlan(phases=(ChurnPhase(
+                float(rounds), departure_rate=6.0 / rounds,
+                join_rate=4.0 / rounds, notice_fraction=0.5),),
+                seed=seed, min_workers_per_party=1)
+            tape = plan.schedule() if churn else []
+            import random as _random
+
+            rng = _random.Random(seed + 1)
+            drains: list = []
+
+            def inject(kind: str):
+                if kind == "depart":
+                    cands = {}
+                    for (p, r) in alive:
+                        cands.setdefault(p, []).append(r)
+                    cands = {p: rs for p, rs in cands.items()
+                             if len(rs) > plan.min_workers_per_party}
+                    if not cands:
+                        return
+                    p = rng.choice(sorted(cands))
+                    r = rng.choice(sorted(cands[p]))
+                    if rng.random() < 0.5:
+                        reply = sim.notice_worker(p, r, timeout=10)
+                        if reply and reply.get("ok"):
+                            drains.append(float(reply["latency_s"]))
+                    sim.kill_worker(p, r)
+                    del alive[(p, r)]
+                else:  # join
+                    p = rng.choice(range(parties))
+                    kv = sim.add_worker(p)
+                    kv.init(0, np.zeros(N, np.float32))
+                    alive[(p, kv.po.node.rank)] = kv
+
+            walls = []
+            for i in range(rounds):
+                while tape and tape[0][0] <= i:
+                    _, kind, _ph = tape.pop(0)
+                    inject(kind)
+                t0 = time.perf_counter()
+                for w in list(alive.values()):
+                    w.push(0, g)
+                for w in list(alive.values()):
+                    w.pull_sync(0)
+                    w.wait_all()
+                walls.append(time.perf_counter() - t0)
+            med = sorted(walls)[len(walls) // 2]
+            stall = sum(1 for w in walls if w > max(4 * med, 0.05))
+            return {"round_wall_s": round(med, 4),
+                    "total_wall_s": round(sum(walls), 3),
+                    "stall_rounds": stall,
+                    "drain_latencies_s": [round(d, 4) for d in drains],
+                    "final_workers": len(alive)}
+        finally:
+            sim.shutdown()
+
+    sweep = {}
+    all_drains = []
+    for p in points:
+        control = run_point(p, churn=False)
+        churned = run_point(p, churn=True)
+        all_drains.extend(churned["drain_latencies_s"])
+        sweep[str(p)] = {
+            "control": control, "churn": churned,
+            "churn_overhead_pct": round(
+                100.0 * (churned["total_wall_s"]
+                         - control["total_wall_s"])
+                / max(control["total_wall_s"], 1e-9), 2),
+        }
+    drain_med = (sorted(all_drains)[len(all_drains) // 2]
+                 if all_drains else None)
+    biggest = str(max(points))
+    print(json.dumps({
+        "tensor_elems": N, "rounds": rounds, "seed": seed,
+        "sweep": sweep,
+        "churn_overhead_pct": sweep[biggest]["churn_overhead_pct"],
+        "stall_rounds": sweep[biggest]["churn"]["stall_rounds"],
+        "drain_latency_s": drain_med,
+        "eviction_timeout_s": hb_timeout,
+        # the acceptance ratio: the graceful fold must cost a small
+        # fraction of what heartbeat expiry would have
+        "drain_vs_eviction_timeout": (
+            round(drain_med / hb_timeout, 4)
+            if drain_med is not None else None),
+    }))
+
+
 def child_serve():
     """Read-serving replica tier (ISSUE 8): ``pulls_per_sec`` at 1/2/4
     replicas under CONCURRENT training — the serving tier's brand-new
@@ -2401,6 +2526,11 @@ def _compact(record: dict) -> dict:
     sv = record.get("serve") or {}
     if sv.get("pulls_per_sec"):
         out["serve_pulls_per_sec"] = sv["pulls_per_sec"]
+    ch = record.get("churn") or {}
+    if ch.get("churn_overhead_pct") is not None:
+        out["churn_overhead_pct"] = ch["churn_overhead_pct"]
+        out["drain_latency_s"] = ch.get("drain_latency_s")
+        out["churn_stall_rounds"] = ch.get("stall_rounds")
     mg = record.get("merge") or {}
     if mg.get("speedup") is not None:
         out["merge_backend_speedup"] = {
@@ -2573,7 +2703,7 @@ def main():
                              "overlap", "overlap_tpu", "stress", "probe",
                              "flash_autotune", "lm", "scaling", "parity",
                              "serde", "shards", "parties", "obs",
-                             "flight", "serve", "merge"])
+                             "flight", "serve", "merge", "churn"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -2601,7 +2731,7 @@ def main():
          "shards": child_shards, "parties": child_parties,
          "obs": child_obs,
          "flight": child_flight, "serve": child_serve,
-         "merge": child_merge,
+         "merge": child_merge, "churn": child_churn,
          "flash_autotune": child_flash_autotune}[args.child]()
         return
 
@@ -2706,6 +2836,7 @@ def main():
         _do("obs", 180, cpu_env)
         _do("flight", 180, cpu_env)
         _do("serve", 210, cpu_env)
+        _do("churn", 240, cpu_env)
 
     cpu_thread = threading.Thread(target=cpu_chain, daemon=True)
     cpu_thread.start()

@@ -1,0 +1,9 @@
+"""Scripted chaos drivers (beyond the reference, whose fault story is
+manual process kills).  ``churn`` turns the PR 2 crash tooling + the
+graceful preemption drain into a seeded, repeatable spot-churn engine
+for elasticity soaks (docs/deployment.md "Elasticity & preemption")."""
+
+from geomx_tpu.chaos.churn import (ChurnOrchestrator, ChurnPhase,
+                                   ChurnPlan)
+
+__all__ = ["ChurnOrchestrator", "ChurnPhase", "ChurnPlan"]

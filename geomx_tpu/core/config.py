@@ -518,6 +518,23 @@ class Config:
     enable_eviction: bool = True
     eviction_check_interval_s: float = 0.0  # detector sweep period;
     #                                         0 = follow heartbeat_interval_s
+    # --- graceful preemption drain (Control.PREEMPT_NOTICE; see
+    # docs/deployment.md "Elasticity & preemption").  Real spot
+    # preemptions come with a notice (30 s - 2 min): a noticed worker
+    # finishes its in-flight step, flushes un-ACKed pushes and leaves
+    # the party gracefully (the server folds it out IMMEDIATELY instead
+    # of stalling rounds until heartbeat expiry); a noticed local
+    # server drains its WAN round and hands its party fold to the
+    # global tier proactively.  launch.py maps SIGTERM onto this path
+    # when enabled (SIGKILL stays the ungraceful eviction path).  Off
+    # (default): no notice hooks are registered anywhere — the
+    # eviction/rejoin machinery behaves exactly as before.
+    enable_preempt: bool = False
+    preempt_drain_s: float = 30.0  # drain window budget: how long a
+    #                                noticed node may spend flushing
+    #                                before it leaves anyway, and how
+    #                                long the party scheduler holds
+    #                                eviction for a draining member
     # --- distributed tracing (geomx_tpu/trace; beyond the reference —
     # its profiler is per-process only).  trace_sample_every = N traces
     # every N-th synchronization round end-to-end: causal spans ride the
@@ -571,6 +588,13 @@ class Config:
     obs_goodput_frac: float = 0.1   # goodput-collapse fraction of peak
     obs_fence_spike: int = 8        # fenced/evicted events per window
     obs_imbalance_factor: float = 4.0  # slowest-shard busy vs peer mean
+    obs_churn_storm: int = 16       # churn_storm rule: membership events
+    #                                 (leaves+kills+joins, injected or
+    #                                 organic) per collector window before
+    #                                 the health engine pages; the rule
+    #                                 also fires when the churn
+    #                                 orchestrator's survivor gauge
+    #                                 reaches its min-survivor floor
     obs_flight_cooldown_s: float = 60.0  # min seconds between flight-
     #                                 dump broadcasts for ONE (rule,
     #                                 subject): the first firing
@@ -718,6 +742,11 @@ class Config:
         if self.obs_window < 8:
             raise ValueError("obs_window must be >= 8 (rate math needs "
                              "a real ring)")
+        if self.preempt_drain_s <= 0:
+            raise ValueError("preempt_drain_s must be > 0 (the graceful "
+                             "drain window)")
+        if self.obs_churn_storm < 1:
+            raise ValueError("obs_churn_storm must be >= 1")
         if self.obs_stall_factor < 1.0 or self.obs_stall_min_s < 0:
             raise ValueError("round-stall thresholds must be "
                              "obs_stall_factor >= 1, obs_stall_min_s >= 0")
@@ -850,6 +879,8 @@ class Config:
             eviction_check_interval_s=_env_float(
                 "GEOMX_EVICTION_CHECK_INTERVAL", 0.0
             ),
+            enable_preempt=_env_bool("GEOMX_PREEMPT_NOTICE"),
+            preempt_drain_s=_env_float("GEOMX_PREEMPT_DRAIN_S", 30.0),
             trace_sample_every=_env_int("GEOMX_TRACE_SAMPLE_EVERY", 0),
             trace_dir=os.environ.get("GEOMX_TRACE_DIR", ""),
             trace_batch_events=_env_int("GEOMX_TRACE_BATCH_EVENTS", 256),
@@ -870,6 +901,7 @@ class Config:
             obs_goodput_frac=_env_float("GEOMX_OBS_GOODPUT_FRAC", 0.1),
             obs_fence_spike=_env_int("GEOMX_OBS_FENCE_SPIKE", 8),
             obs_imbalance_factor=_env_float("GEOMX_OBS_IMBALANCE", 4.0),
+            obs_churn_storm=_env_int("GEOMX_OBS_CHURN_STORM", 16),
             obs_flight_cooldown_s=_env_float("GEOMX_OBS_FLIGHT_COOLDOWN",
                                              60.0),
             enable_flight=_env_bool("GEOMX_FLIGHT", True),

@@ -103,6 +103,142 @@ class WorkerKVStore:
         self.server_recoveries = 0
         self._last_dead_nodes = 0  # num_dead_nodes graceful degradation
         postoffice.add_control_hook(self._server_back_hook)
+        # graceful preemption drain (Control.PREEMPT_NOTICE; see
+        # docs/deployment.md "Elasticity & preemption").  The notice
+        # flag always exists (training loops poll it cheaply); the wire
+        # hook is registered ONLY under Config.enable_preempt — default
+        # off leaves the membership machinery bit-for-bit legacy.
+        self.preempt_noticed = threading.Event()
+        self.drain_complete = threading.Event()
+        self.preempt_drains = 0
+        self.last_drain_s: Optional[float] = None
+        self._drain_started = False
+        if self.config.enable_preempt:
+            postoffice.add_control_hook(self._preempt_hook)
+
+    def _preempt_hook(self, msg) -> bool:
+        """A spot-preemption notice arrived: drain gracefully.  The
+        reply is sent AFTER the drain completed (flushed + left), so
+        the notifier's reply latency IS the notice→fold latency."""
+        if msg.control is not Control.PREEMPT_NOTICE or not msg.request:
+            return False
+        body = msg.body if isinstance(msg.body, dict) else {}
+        token = body.get("token")
+
+        def reply():
+            try:
+                self.po.van.send(msg.reply_to(
+                    control=Control.PREEMPT_NOTICE, body={
+                        "ok": self.drain_complete.is_set(),
+                        "drain_s": self.last_drain_s,
+                        "node": str(self.po.node), "token": token}))
+            except (KeyError, OSError):
+                pass  # notifier gone — the drain still happened
+
+        self.begin_drain(on_done=reply)
+        return True
+
+    def begin_drain(self, on_done=None) -> bool:
+        """Start the graceful drain (idempotent): announce the drain to
+        the party scheduler (holds eviction for the drain window), wait
+        for the training loop to finish its in-flight step and for every
+        un-ACKed push/pull to settle, then leave the party — the server
+        folds this member out immediately.  Runs off the hook thread;
+        returns False if a drain was already running (``on_done`` still
+        fires after that drain)."""
+        with self._mu:
+            first = not self._drain_started
+            self._drain_started = True
+        self.preempt_noticed.set()
+        if not first:
+            if on_done is not None:
+                threading.Thread(
+                    target=lambda: (self.drain_complete.wait(
+                        self.config.preempt_drain_s + 5.0), on_done()),
+                    daemon=True,
+                    name=f"preempt-wait-{self.po.node}").start()
+            return False
+        # eviction hold: the scheduler must not declare us dead while we
+        # flush (the notice wins the race against heartbeat expiry)
+        try:
+            self.po.van.send(Message(
+                recipient=self.po.topology.scheduler(self.party),
+                control=Control.PREEMPT_NOTICE, domain=Domain.LOCAL,
+                request=False,
+                body={"event": "draining", "node": str(self.po.node)}))
+        except (KeyError, OSError):
+            pass  # scheduler dark: the drain itself still proceeds
+        threading.Thread(target=self._drain_body, args=(on_done,),
+                         daemon=True,
+                         name=f"preempt-drain-{self.po.node}").start()
+        return True
+
+    def _drain_body(self, on_done):
+        t0 = time.monotonic()
+        deadline = t0 + self.config.preempt_drain_s
+        try:
+            # flush un-ACKed work: the training loop breaks at its next
+            # step boundary (it polls preempt_noticed), so poll until
+            # the pending set is empty AND stays empty for one beat —
+            # bounded by the drain window (a wedged round must not
+            # outlive the preemption)
+            settled = 0
+            while time.monotonic() < deadline:
+                with self._mu:
+                    pending = list(self._pending)
+                if not pending:
+                    settled += 1
+                    if settled >= 2:
+                        break
+                    time.sleep(0.02)
+                    continue
+                settled = 0
+                for ts in pending:
+                    try:
+                        self.worker.customer.wait(
+                            ts, timeout=max(0.1, deadline
+                                            - time.monotonic()))
+                    except TimeoutError:
+                        break
+                with self._mu:
+                    self._pending = [t for t in self._pending
+                                     if t not in pending]
+            # the final graceful leave: the server folds us out NOW —
+            # rounds and (via the scheduler's membership tracking)
+            # barriers continue on the survivor set
+            self.leave_party(timeout=max(
+                1.0, deadline - time.monotonic()))
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "%s: preempt drain failed (falling back to the "
+                "eviction path)", self.po.node)
+        else:
+            self.last_drain_s = round(time.monotonic() - t0, 4)
+            self.preempt_drains += 1
+            from geomx_tpu.utils.metrics import system_counter
+
+            system_counter(f"{self.po.node}.preempt_drains").inc()
+            if self.po.flight is not None:
+                from geomx_tpu.obs.flight import FlightEv
+
+                self.po.flight.record(
+                    FlightEv.FOLD, a=int(self.last_drain_s * 1e6),
+                    peer=str(self.po.node), note="preempt_drain")
+            print(f"{self.po.node}: preempt drain complete — left "
+                  f"gracefully in {self.last_drain_s:.3f}s", flush=True)
+        finally:
+            self.drain_complete.set()
+            if on_done is not None:
+                on_done()
+
+    def finish_drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until a started drain finished (the launch.py SIGTERM
+        path calls this after the training loop broke)."""
+        return self.drain_complete.wait(
+            timeout if timeout is not None
+            else self.config.preempt_drain_s + 5.0)
 
     def _server_back_hook(self, msg) -> bool:
         if msg.control is not Control.REJOIN or msg.request:

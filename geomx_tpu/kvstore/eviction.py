@@ -176,12 +176,30 @@ class WorkerEvictionMonitor(_HeartbeatActuator):
         self._baseline: Dict[str, float] = {n: now0 for n in self._members}
         self._evicted: Dict[str, int] = {}  # node -> boot at eviction
         self._evicting: set = set()
+        # graceful-drain hold (Control.PREEMPT_NOTICE {event:
+        # "draining"}): a noticed member gets the drain window to flush
+        # and leave before heartbeat expiry may evict it — the notice
+        # WINS the race against its own expiry.  node -> hold deadline.
+        self._noticed: Dict[str, float] = {}
+        self.notice_holds = 0
         self.evictions = 0
         self._counter = system_counter(
             f"{postoffice.node}.worker_evictions")
         super().__init__(postoffice, check_interval_s)
 
     def _on_extra(self, msg: Message) -> bool:
+        if (msg.control is Control.PREEMPT_NOTICE and not msg.request
+                and isinstance(msg.body, dict)
+                and msg.body.get("event") == "draining"):
+            node_s = str(msg.body.get("node", msg.sender))
+            # the drain window plus a grace beat: the leave RPC that
+            # ENDS the drain lands a moment after the window closes,
+            # and the hold must outlive it or the race re-opens
+            hold = getattr(self.po.config, "preempt_drain_s", 30.0) + 1.0
+            with self._mu:
+                self._noticed[node_s] = time.monotonic() + hold
+                self.notice_holds += 1
+            return True
         if (msg.control is Control.ADD_NODE and not msg.request
                 and isinstance(msg.body, dict)
                 and msg.body.get("event") == "membership"):
@@ -191,11 +209,23 @@ class WorkerEvictionMonitor(_HeartbeatActuator):
             with self._mu:
                 for n in members - self._members:
                     self._baseline[n] = now
+                # members that disappeared WITHOUT an eviction left
+                # gracefully (leave_party / the preempt drain): drop
+                # them from barrier accounting too, or an FSA barrier
+                # already waiting would ride out its full timeout for a
+                # member that promised never to enter
+                departed = [n for n in self._members - members
+                            if n not in self._evicted]
                 self._members = members
+                for n in departed:
+                    self._noticed.pop(n, None)
                 for n in list(self._evicted):
                     if n in members:  # rejoined through the join door
                         del self._evicted[n]
                         readmit.append(n)
+                readmit.extend(n for n in members if n not in readmit)
+            for n in departed:
+                self.po.exclude_node(n)
             for n in readmit:
                 self.po.readmit_node(n)
         return False  # never consumed: the TS schedulers track it too
@@ -204,9 +234,15 @@ class WorkerEvictionMonitor(_HeartbeatActuator):
         info, epoch = self.po.heartbeat_info()
         now = time.monotonic()
         with self._mu:
+            # expired holds fall back to the normal eviction path (a
+            # notice whose drain never finished is just a crash)
+            for n, dl in list(self._noticed.items()):
+                if dl <= now:
+                    del self._noticed[n]
             candidates = [n for n in sorted(self._members)
                           if n not in self._evicted
-                          and n not in self._evicting]
+                          and n not in self._evicting
+                          and n not in self._noticed]
             baselines = dict(self._baseline)
         for n in candidates:
             if NodeId.parse(n).role is not Role.WORKER:
@@ -271,14 +307,58 @@ class LocalServerRecoveryMonitor(_HeartbeatActuator):
 
         self._shards = ShardTargets(postoffice)
         self._folded: Dict[int, int] = {}  # party -> boot at fold
+        # parties whose local server DRAINED proactively (preempt
+        # notice) but whose old incarnation is still heartbeating its
+        # way to death: recovery must wait for the death (heartbeat
+        # expiry) or a NEW boot before warm-booting anyone, or it would
+        # unfold the party back in mid-drain
+        self._pending_death: set = set()
         self._busy: set = set()
         self.party_folds = 0
         self.party_unfolds = 0
+        self.preempt_folds = 0
         self._fold_counter = system_counter(
             f"{postoffice.node}.party_folds")
         self._unfold_counter = system_counter(
             f"{postoffice.node}.party_unfolds")
+        self._preempt_counter = system_counter(
+            f"{postoffice.node}.preempt_folds")
         super().__init__(postoffice, check_interval_s)
+
+    def _on_extra(self, msg: Message) -> bool:
+        """A drained local server already handed its fold to the global
+        tier (Control.PREEMPT_NOTICE {event: "server_drained"}): record
+        the fold with its boot incarnation so the replacement's resumed
+        heartbeats drive the normal rejoin, without this monitor
+        re-folding (the server-side fold is idempotent anyway)."""
+        if (msg.control is not Control.PREEMPT_NOTICE or msg.request
+                or not isinstance(msg.body, dict)
+                or msg.body.get("event") != "server_drained"):
+            return False
+        party = int(msg.body.get("party", -1))
+        if not 0 <= party < self.topology.num_parties:
+            return True
+        boot = int(msg.body.get("boot", 0))
+        with self._mu:
+            already = party in self._folded
+            self._folded[party] = boot
+            self._pending_death.add(party)
+        if not already:
+            self.preempt_folds += 1
+            self._preempt_counter.inc()
+            get_tracer(str(self.po.node)).instant(
+                "preempt.party_fold", party=party,
+                node=str(msg.body.get("node")))
+            if self.po.flight is not None:
+                from geomx_tpu.obs.flight import FlightEv
+
+                self.po.flight.record(FlightEv.FOLD, b=party, d=boot,
+                                      peer=str(msg.body.get("node")),
+                                      note="preempt_fold")
+            print(f"{self.po.node}: party {party} drained on preempt "
+                  "notice — fold recorded, rejoin arms when a "
+                  "replacement heartbeats", flush=True)
+        return True
 
     def _check(self):
         info, epoch = self.po.heartbeat_info()
@@ -290,10 +370,22 @@ class LocalServerRecoveryMonitor(_HeartbeatActuator):
                 if p in self._busy:
                     continue
                 folded = p in self._folded
+                pending = p in self._pending_death
+                boot_at_fold = self._folded.get(p, 0)
             if not folded and age > self._timeout:
                 boot = info.get(node_s, (None, 0))[1]
                 self._spawn(p, self._fold, p, boot)
+            elif folded and pending and age > self._timeout:
+                # the noticed incarnation finally died — from here the
+                # next resumed heartbeat is a replacement to recover
+                with self._mu:
+                    self._pending_death.discard(p)
             elif folded and age <= self._timeout:
+                boot_now = info.get(node_s, (None, 0))[1]
+                if pending and boot_now == boot_at_fold:
+                    continue  # the draining incarnation still breathes
+                with self._mu:
+                    self._pending_death.discard(p)
                 # heartbeats resumed: a replacement process (new boot) or
                 # a revived zombie (same boot, stale replica) — both
                 # warm-boot before the party folds back in

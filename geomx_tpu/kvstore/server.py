@@ -302,6 +302,17 @@ class LocalServer:
         self.warm_boots = 0
         self._rejoin_waiters: List[Message] = []
         self._warm_boot_busy = False
+        # graceful preemption drain (Control.PREEMPT_NOTICE): a noticed
+        # local server drains its in-flight WAN round, hands its party
+        # fold to the global tier proactively (the reversible EVICT
+        # fold, so the PR 2 rejoin path brings the replacement back),
+        # and tells the recovery monitor the fold already happened.
+        # Hook registered only under Config.enable_preempt.
+        self.preempt_server_drains = 0
+        self.last_drain_s: Optional[float] = None
+        self._wan_inflight = 0  # WAN push batches awaiting group acks
+        self._preempt_waiters: List[Message] = []
+        self._preempt_busy = False
         self.store: Dict[int, np.ndarray] = {}
         self._keys: Dict[int, _KeyState] = {}
         # key-sharded server state: ``stripe(k)`` guards key k's merge /
@@ -341,6 +352,8 @@ class LocalServer:
         # scheduler's eviction monitor + warm-boot rejoin after a crash
         postoffice.add_control_hook(self._on_evict)
         postoffice.add_control_hook(self._on_rejoin)
+        if self.config.enable_preempt:
+            postoffice.add_control_hook(self._on_preempt)
         # global-tier failover: the scheduler's NEW_PRIMARY broadcast
         # retargets the up-link and replays un-ACKed WAN requests
         self.failover_events = 0
@@ -648,6 +661,13 @@ class LocalServer:
         del self._members[node_s]
         self._member_addrs.pop(node_s, None)
         self._bootstrapping.discard(node_s)
+        # ESync planner hygiene: forget the departed worker's step/comm
+        # estimates — a slow leaver's stale step_s would otherwise stay
+        # in the max reach-time target forever, permanently inflating
+        # every survivor's assignment (the fold IS the replan trigger;
+        # a joiner is seeded at min_steps until its first report)
+        if self._esync is not None:
+            self._esync.drop(node_s)
         if self._flight is not None:
             self._flight.record(FlightEv.FOLD, peer=node_s,
                                 note="member_fold")
@@ -787,8 +807,28 @@ class LocalServer:
         #                       the adopted state
         keys = set()
         for gs in list(self.up.targets):
-            reply = self.up.send_cmd(gs, Ctrl.LIST_KEYS,
-                                     domain=Domain.GLOBAL) or {}
+            # retried + timeout-bounded: control commands have no
+            # replay layer, and a RELAUNCHED process's first sends can
+            # race the peers' stale half-open conns to its dead
+            # predecessor — a reply lost to a broken-then-redialed
+            # socket would wedge the warm boot (and with it every
+            # queued REJOIN) forever.  LIST_KEYS is read-only, so the
+            # re-send is harmless; the fresh send also forces the
+            # fabric's redial to the live incarnation.
+            reply = None
+            for _ in range(8):
+                ts = self.up.send_cmd(gs, Ctrl.LIST_KEYS,
+                                      domain=Domain.GLOBAL, wait=False)
+                try:
+                    self.up.customer.wait(ts, timeout=2.5)
+                    reply = self.up.cmd_response(ts)
+                    break
+                except TimeoutError:
+                    continue
+            if reply is None:
+                # this shard is dark (mid-failover?) — adopt what the
+                # others have; the monitor's next sweep re-warm-boots
+                continue
             keys.update(int(k) for k in reply.get("keys", ()))
         got: Dict[int, np.ndarray] = {}
         if keys:
@@ -827,6 +867,132 @@ class LocalServer:
         print(f"{self.po.node}: warm boot adopted {len(got)} keys from "
               "the global tier", flush=True)
         return len(got)
+
+    def _on_preempt(self, msg: Message) -> bool:
+        """Control.PREEMPT_NOTICE request: this local server's host is
+        about to be preempted.  Drain off the hook thread (the fold
+        RPCs block on WAN round trips); repeat notices queue behind the
+        running drain like REJOIN retries do and are answered when it
+        finishes."""
+        if msg.control is not Control.PREEMPT_NOTICE or not msg.request:
+            return False
+        with self._mu:
+            self._preempt_waiters.append(msg)
+            if self._preempt_busy:
+                return True
+            self._preempt_busy = True
+        threading.Thread(target=self._preempt_thread, daemon=True,
+                         name=f"preempt-drain-{self.po.node}").start()
+        return True
+
+    def _preempt_thread(self):
+        try:
+            self.preempt_drain()
+            ok = True
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "%s: preempt drain failed (the eviction path covers "
+                "the crash)", self.po.node)
+            ok = False
+        with self._mu:
+            waiters, self._preempt_waiters = self._preempt_waiters, []
+            self._preempt_busy = False
+        for m in waiters:
+            try:
+                self.po.van.send(m.reply_to(
+                    control=Control.PREEMPT_NOTICE, body={
+                        "ok": ok, "drain_s": self.last_drain_s,
+                        "node": str(self.po.node),
+                        "token": (m.body or {}).get("token")}))
+            except (KeyError, OSError):
+                pass  # the notifier vanished; the drain still happened
+
+    def preempt_drain(self, timeout: Optional[float] = None) -> float:
+        """Graceful spot-preemption drain: let the in-flight WAN push
+        round flush its acks, then hand this party's fold to the global
+        tier PROACTIVELY (the reversible ``party_fold`` — the same fold
+        the recovery monitor would synthesize a heartbeat-timeout
+        later) and tell the recovery monitor the fold happened, so the
+        replacement's resumed heartbeats drive the normal warm-boot /
+        unfold / worker-replay rejoin.  Returns the drain seconds."""
+        import uuid
+
+        t0 = time.monotonic()
+        budget = timeout if timeout is not None \
+            else self.config.preempt_drain_s
+        deadline = t0 + budget
+        # 1. flush: wait for open WAN push batches to collect their acks
+        #    (bounded — a dark global tier must not eat the whole notice)
+        while time.monotonic() < deadline:
+            with self._ctr_mu:
+                inflight = self._wan_inflight
+            if inflight <= 0:
+                break
+            time.sleep(0.02)
+        # 2. reversible fold at every shard's CURRENT holder (the
+        #    up-link targets track NEW_PRIMARY retargets)
+        node_s = str(self.po.node)
+        for gs in list(self.up.targets):
+            token = f"{node_s}#{uuid.uuid4().hex[:8]}"
+            cv = threading.Condition()
+            reply: dict = {}
+
+            def hook(m, _token=token, _cv=cv, _reply=reply) -> bool:
+                b = m.body if isinstance(m.body, dict) else {}
+                if (m.control is Control.EVICT and not m.request
+                        and b.get("token") == _token):
+                    with _cv:
+                        _reply.update(b)
+                        _cv.notify_all()
+                    return True
+                return False
+
+            self.po.add_control_hook(hook)
+            try:
+                for _ in range(3):
+                    try:
+                        self.po.van.send(Message(
+                            recipient=gs, control=Control.EVICT,
+                            domain=Domain.GLOBAL, request=True,
+                            body={"action": "party_fold", "node": node_s,
+                                  "token": token}))
+                    except (KeyError, OSError):
+                        pass  # shard dark — the eviction path covers it
+                    with cv:
+                        if cv.wait_for(lambda: bool(reply), timeout=max(
+                                0.1, min(2.0, deadline
+                                         - time.monotonic()))):
+                            break
+            finally:
+                self.po.remove_control_hook(hook)
+        # 3. arm the rejoin path: the recovery monitor records the fold
+        #    (with our boot incarnation) so the REPLACEMENT's resumed
+        #    heartbeats trigger warm boot + unfold + worker replay
+        try:
+            self.po.van.send(Message(
+                recipient=self.po.topology.global_scheduler(),
+                control=Control.PREEMPT_NOTICE, domain=Domain.GLOBAL,
+                request=False,
+                body={"event": "server_drained", "node": node_s,
+                      "party": self.po.node.party,
+                      "boot": self.po.van.boot}))
+        except (KeyError, OSError):
+            pass  # monitor dark: heartbeat expiry re-folds idempotently
+        self.last_drain_s = round(time.monotonic() - t0, 4)
+        self.preempt_server_drains += 1
+        from geomx_tpu.utils.metrics import system_counter
+
+        system_counter(f"{self.po.node}.preempt_server_drains").inc()
+        if self._flight is not None:
+            self._flight.record(FlightEv.FOLD,
+                                a=int(self.last_drain_s * 1e6),
+                                peer=node_s, note="preempt_drain")
+        print(f"{self.po.node}: preempt drain complete — party handed "
+              f"to the global tier in {self.last_drain_s:.3f}s "
+              "(workers park until the replacement rejoins)", flush=True)
+        return self.last_drain_s
 
     def _on_new_primary(self, msg: Message) -> bool:
         """Global-tier failover (Control.NEW_PRIMARY from the global
@@ -1260,6 +1426,9 @@ class LocalServer:
         with self._ctr_mu:  # rounds of disjoint keys dispatch from
             self.wan_push_rounds += 1  # parallel lanes
             wan_round = self.wan_push_rounds
+            self._wan_inflight += 1  # decremented when the batch's
+            #                          groups are all acked (the
+            #                          preempt drain waits on zero)
         if self._flight is not None:
             # the WAN round boundary: the stall forensic's "this party
             # pushed up and is now owed a pull-down"
@@ -1302,6 +1471,10 @@ class LocalServer:
         use_piggyback = (self.config.enable_p3 and push_body is None
                          and self.ts_inter is None and not self._adaptive)
         if use_piggyback:
+            # the piggybacked round has no separate push-ack chain; the
+            # drain's flush reading can't observe it — release now
+            with self._ctr_mu:
+                self._wan_inflight -= 1
             for tag, pairs in groups.items():
                 ks = np.array([k for k, _ in pairs], dtype=np.int64)
                 vals = (pairs[0][1] if len(pairs) == 1
@@ -1322,6 +1495,8 @@ class LocalServer:
                 remaining[0] -= 1
                 done = remaining[0] == 0
             if done:
+                with self._ctr_mu:
+                    self._wan_inflight -= 1
                 pull_down()
 
         for tag, pairs in groups.items():
@@ -1941,6 +2116,11 @@ class LocalServer:
             "evicted_workers": self.evicted_workers,
             "eviction_fenced_pushes": self.eviction_fenced_pushes,
             "warm_boots": self.warm_boots,
+            # elastic-membership observability: the churn_storm health
+            # rule sums these deltas over its collector window
+            "joined_workers": self.joined_workers,
+            "left_workers": self.left_workers,
+            "preempt_server_drains": self.preempt_server_drains,
             "mpq_bsc_picks": getattr(self.push_codec, "bsc_picks", 0),
             "mpq_fp16_picks": getattr(self.push_codec, "fp16_picks", 0),
             "pq_overtakes": van.pq_overtakes,

@@ -407,6 +407,76 @@ class Simulation:
         ls.po.stop()
         return ls
 
+    def _notice_rpc(self, sender_po: Postoffice, target, domain,
+                    timeout: float):
+        """Send Control.PREEMPT_NOTICE from ``sender_po`` and wait for
+        the token-matched drain reply.  Returns the reply body plus the
+        measured notice→drained latency, or None on timeout."""
+        import threading
+        import time as _time
+        import uuid
+
+        from geomx_tpu.transport.message import Control, Message
+
+        assert self.config.enable_preempt, \
+            "preempt notices off: set Config.enable_preempt"
+        token = f"{sender_po.node}#{uuid.uuid4().hex[:8]}"
+        cv = threading.Condition()
+        reply: dict = {}
+
+        def hook(msg) -> bool:
+            b = msg.body if isinstance(msg.body, dict) else {}
+            if (msg.control is Control.PREEMPT_NOTICE and not msg.request
+                    and b.get("token") == token):
+                with cv:
+                    reply.update(b)
+                    cv.notify_all()
+                return True
+            return False
+
+        sender_po.add_control_hook(hook)
+        t0 = _time.monotonic()
+        try:
+            sender_po.van.send(Message(
+                recipient=target, control=Control.PREEMPT_NOTICE,
+                domain=domain, request=True, body={"token": token}))
+            with cv:
+                if not cv.wait_for(lambda: bool(reply), timeout=timeout):
+                    return None
+        finally:
+            sender_po.remove_control_hook(hook)
+        out = dict(reply)
+        out["latency_s"] = round(_time.monotonic() - t0, 4)
+        return out
+
+    def notice_worker(self, party: int, rank: int,
+                      timeout: float = 30.0) -> Optional[dict]:
+        """Deliver a spot-preemption notice to a worker over the wire
+        (what a real preemption-notice daemon or SIGTERM mapping does):
+        the worker finishes its in-flight step, flushes un-ACKed
+        pushes, and leaves the party gracefully — the server folds it
+        out immediately, no heartbeat-expiry stall.  Returns the drain
+        reply ({ok, drain_s, latency_s}); the latency is the
+        notice→member-folded reading the drain-latency acceptance
+        judges.  Requires ``Config.enable_preempt``."""
+        from geomx_tpu.transport.message import Domain
+
+        sched = self.offices[str(self.topology.scheduler(party))]
+        target = NodeId.parse(f"worker:{rank}@p{party}")
+        return self._notice_rpc(sched, target, Domain.LOCAL, timeout)
+
+    def notice_local_server(self, party: int,
+                            timeout: float = 30.0) -> Optional[dict]:
+        """Deliver a spot-preemption notice to a party's local server:
+        it drains its WAN round, hands the party fold to the global
+        tier proactively, and arms the recovery monitor's rejoin path
+        for the replacement.  Requires ``Config.enable_preempt``."""
+        from geomx_tpu.transport.message import Domain
+
+        gsched = self.offices[str(self.topology.global_scheduler())]
+        return self._notice_rpc(gsched, self.topology.server(party),
+                                Domain.GLOBAL, timeout)
+
     def kill_replica(self, rank: int = 0) -> "ModelReplica":
         """Thread-level SIGKILL of a serve replica: its van neither
         receives nor transmits, its heartbeat and refresh pulls die —

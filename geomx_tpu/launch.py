@@ -374,6 +374,62 @@ def _configure_worker(po, kv, args):
     kv.barrier()
 
 
+def install_preempt_handler(po, role_obj, stop_ev):
+    """Map SIGTERM onto the graceful preemption drain (spot preemptions
+    arrive as SIGTERM-with-notice on every major cloud; SIGKILL stays
+    the ungraceful path — heartbeat eviction covers it).  A noticed
+    WORKER finishes its in-flight step (the training loops poll the
+    notice), flushes un-ACKed pushes and leaves the party; a noticed
+    LOCAL SERVER drains its WAN round and hands its party fold to the
+    global tier; every other role just exits in order.  Installed only
+    under ``Config.enable_preempt`` — default-off keeps the legacy
+    SIGTERM semantics (flight dump + immediate death)."""
+    import signal
+
+    from geomx_tpu.kvstore.client import WorkerKVStore
+    from geomx_tpu.kvstore.server import LocalServer
+
+    def handler(signum, frame):
+        print(f"{po.node}: SIGTERM → preempt notice (graceful drain; "
+              "SIGKILL would take the eviction path)", flush=True)
+        if isinstance(role_obj, WorkerKVStore):
+            # the demo loop breaks at its next step boundary and the
+            # drain thread flushes + leaves; main() then exits normally
+            role_obj.begin_drain()
+        elif isinstance(role_obj, LocalServer):
+            def drain():
+                try:
+                    role_obj.preempt_drain()
+                except Exception:
+                    pass  # the eviction path covers a failed drain
+                finally:
+                    stop_ev.set()
+
+            threading.Thread(target=drain, daemon=True,
+                             name=f"preempt-drain-{po.node}").start()
+        else:
+            stop_ev.set()
+
+    try:
+        signal.signal(signal.SIGTERM, handler)
+    except ValueError:
+        pass  # not the main thread (library use)
+
+
+def _drain_if_preempted(po, kv) -> bool:
+    """Worker epilogue for the notice path: when the loop broke on a
+    preempt notice, wait out the drain (flush + graceful leave) and
+    exit WITHOUT the end-of-training barrier or cluster shutdown — the
+    survivors keep training.  Returns True when preempted."""
+    ev = getattr(kv, "preempt_noticed", None)
+    if ev is None or not ev.is_set():
+        return False
+    kv.finish_drain()
+    print(f"{po.node}: preempted — drained and left gracefully "
+          f"(drain_s={kv.last_drain_s})", flush=True)
+    return True
+
+
 def _test_step_sleep_s(node) -> float:
     """Per-node artificial per-step delay for acceptance runs that need
     deterministic heterogeneity (the ESync matrix): env
@@ -456,6 +512,8 @@ def _worker_demo(po, kv, args, join_advertise=None):
         print(f"{po.node}: configured — training begins", flush=True)
     it = ShardedIterator(x, y, args.batch, widx, num_all)
     hist = train(kv, params, it, args.steps, barrier_init=not joining)
+    if _drain_if_preempted(po, kv):
+        return
     if joining:
         kv.wait_all()
         kv.leave_party()
@@ -488,6 +546,8 @@ def _worker_demo_lm(po, kv, args):
 
     hist = run_worker(kv, params, grad_fn, it, args.steps,
                       barrier_init=True, log_fn=log)
+    if _drain_if_preempted(po, kv):
+        return
     # steady tokens/s excludes the first step (jit compile + INIT
     # broadcast dominate it; bench.py's lm child splits the same way)
     if len(stamps) > 1:
@@ -546,6 +606,8 @@ def _worker_demo_esync(po, kv, args):
     hist = run_worker_esync(kv, params, grad_fn, it, args.steps,
                             optimizer=opt, barrier_init=True,
                             max_local_steps=16, rounds_out=rounds_info)
+    if _drain_if_preempted(po, kv):
+        return
     # steps= counts SYNC rounds (the --steps contract); local steps vary
     # per worker by design — that variance is the feature
     print(f"{po.node}: steps={len(rounds_info)} "
@@ -822,6 +884,12 @@ def main(argv=None):
     from geomx_tpu.obs.flight import install_process_hooks
 
     install_process_hooks(po)
+    if cfg.enable_preempt:
+        # spot semantics: SIGTERM = the preemption NOTICE (graceful
+        # drain — installed after the flight hooks, so it owns the
+        # signal; the exit-path dump still lands via atexit).  SIGKILL
+        # keeps the ungraceful eviction/rejoin path.
+        install_preempt_handler(po, role_obj, stop_ev)
     print(f"{node}: up", flush=True)
     if node.role is Role.WORKER:
         if args.workload == "lm":

@@ -92,6 +92,13 @@ class FlightEv(enum.IntEnum):
     #                      count, note=backend name (numpy/jax) — the
     #                      postmortem can tell a device-lane server
     #                      from a host-lane one without its config
+    CHURN = 20           # churn-orchestrator injected event (chaos/
+    #                      churn.py): peer=the targeted node,
+    #                      note=churn_{notice,kill,join,server_kill,
+    #                      server_restart,stall_round} — postmortems
+    #                      attribute stalls to INJECTED vs organic
+    #                      faults by joining these with the fold/evict
+    #                      timeline
 
 
 _EV_NAMES = {int(e): e.name for e in FlightEv}

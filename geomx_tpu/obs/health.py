@@ -37,6 +37,11 @@ Rules (thresholds are ``Config.obs_*`` knobs):
   exceeds the configured read bound (``Config.serve_staleness_s``):
   its refresh loop is falling behind, so reads are parking instead of
   being answered (the serving tier's SLO; geomx_tpu/serve).
+- **churn_storm** — membership transitions (graceful leaves, kills,
+  joins — injected by the churn orchestrator or organic) exceed
+  ``obs_churn_storm`` within the window, or the orchestrator's
+  survivor gauge reaches its min-survivor floor (the next departure
+  stalls training; docs/deployment.md "Elasticity & preemption").
 """
 
 from __future__ import annotations
@@ -60,7 +65,15 @@ _FENCE_KEYS = ("eviction_fenced_pushes", "fenced_rejects",
 
 RULES = ("round_stall", "replication_lag", "shard_imbalance",
          "goodput_collapse", "rtt_outlier", "fence_spike",
-         "replica_staleness")
+         "replica_staleness", "churn_storm")
+
+# membership-transition counters summed by the churn_storm rule: the
+# churn orchestrator's injected-event family (registered on the global
+# scheduler by chaos/churn.py) plus the organic server-side counters,
+# so a storm pages whether it was scripted or real
+_CHURN_KEYS = ("churn_notices", "churn_graceful_leaves",
+               "churn_ungraceful_kills", "churn_joins",
+               "left_workers", "evicted_workers", "joined_workers")
 
 
 def _json_safe(obj):
@@ -149,7 +162,7 @@ class HealthEngine:
         for rule in (self._rule_round_stall, self._rule_replication_lag,
                      self._rule_shard_imbalance, self._rule_goodput_collapse,
                      self._rule_rtt_outlier, self._rule_fence_spike,
-                     self._rule_replica_staleness):
+                     self._rule_replica_staleness, self._rule_churn_storm):
             try:
                 records.extend(rule(now))
             except Exception:  # one broken rule must not mute the rest
@@ -410,6 +423,57 @@ class HealthEngine:
                 message=f"{total:.0f} fenced/evicted events in the "
                         f"window (threshold {self.fence_spike})",
                 events=total, threshold=self.fence_spike)
+            if rec:
+                out.append(rec)
+        return out
+
+    def _rule_churn_storm(self, now: float) -> List[dict]:
+        """Elastic membership under churn is NORMAL (docs/deployment.md
+        "Elasticity & preemption") — but a membership-transition RATE
+        past ``obs_churn_storm`` per collector window means the fleet
+        is thrashing (preemption wave, flapping autoscaler), and a
+        survivor count at the churn plan's min-survivor floor means the
+        next departure stalls training.  Two subjects: ``cluster``
+        (event rate) and ``survivor_floor`` (the orchestrator's
+        ``churn_survivors`` / ``churn_min_survivors`` gauges)."""
+        bound = int(getattr(self.config, "obs_churn_storm", 16))
+        out = []
+        total = 0.0
+        seen = False
+        for node in self.collector.nodes():
+            for key in _CHURN_KEYS:
+                pts = self.collector.series(node, key)
+                if len(pts) >= 2:
+                    seen = True
+                    total += pts[-1][1] - pts[0][1]
+        if seen:
+            rec = self._set_state(
+                "churn_storm", "cluster", total > bound, now,
+                message=f"{total:.0f} membership transitions in the "
+                        f"window (threshold {bound})",
+                events=total, threshold=bound)
+            if rec:
+                out.append(rec)
+        # min-survivor floor: gauges shipped by the churn orchestrator
+        # (absent outside orchestrated runs — nothing to judge then)
+        survivors = floor = None
+        for node in self.collector.nodes():
+            s = self.collector.value(node, "churn_survivors")
+            f = self.collector.value(node, "churn_min_survivors")
+            if isinstance(s, (int, float)) and isinstance(f, (int, float)):
+                survivors, floor = float(s), float(f)
+                break
+        if survivors is not None and floor is not None and floor > 0:
+            firing = survivors <= floor + 1
+            rec = self._set_state(
+                "churn_storm", "survivor_floor", firing, now,
+                severity="critical",
+                message=(f"{survivors:.0f} survivors at the churn "
+                         f"plan's floor ({floor:.0f}) — the next "
+                         "departure stalls training" if firing else
+                         f"{survivors:.0f} survivors, clear of the "
+                         f"floor ({floor:.0f})"),
+                survivors=survivors, floor=floor)
             if rec:
                 out.append(rec)
         return out

@@ -97,6 +97,22 @@ class EsyncState:
                 out[w] = max(self.min_steps, min(st["cap"], m))
             return out
 
+    def drop(self, worker: str) -> bool:
+        """Forget a departed worker (membership fold / eviction /
+        graceful leave).  Without this, the departed worker's stale
+        ``step_s`` estimate stays in the ``max`` reach-time target
+        forever — a slow worker that left would permanently inflate
+        every survivor's assignment.  A joiner needs no inverse: it is
+        seeded at ``min_steps`` until its first report.  Returns True
+        when the worker had stats to forget."""
+        with self._mu:
+            return self._stats.pop(worker, None) is not None
+
+    def workers(self):
+        """Currently-tracked worker names (planner hygiene tests)."""
+        with self._mu:
+            return sorted(self._stats)
+
     def steps_for(self, worker: str) -> int:
         """Assignment for one worker (min_steps until it has reported)."""
         return self.plan().get(worker, self.min_steps)

@@ -41,6 +41,16 @@ def load_params(path: str):
         return serialization.msgpack_restore(f.read())
 
 
+def _preempt_noticed(kv) -> bool:
+    """True once a spot-preemption notice landed on this worker
+    (Control.PREEMPT_NOTICE / the launch.py SIGTERM mapping): the
+    training loops poll it at every step boundary — the noticed worker
+    finishes its in-flight step, then stops pushing so the drain can
+    flush and leave gracefully.  One attribute load + Event check."""
+    ev = getattr(kv, "preempt_noticed", None)
+    return ev is not None and ev.is_set()
+
+
 def flatten_params(params) -> Tuple[List[np.ndarray], object]:
     leaves, treedef = jax.tree_util.tree_flatten(params)
     return [np.asarray(x) for x in leaves], treedef
@@ -83,7 +93,7 @@ def run_worker_hfa(
     buf: List[Optional[np.ndarray]] = [None] * len(leaves)
 
     for step, (x, y) in enumerate(data_iter):
-        if step >= steps:
+        if step >= steps or _preempt_noticed(kv):
             break
         m.step_start()
         with m.phase("grad"):
@@ -243,6 +253,8 @@ def run_worker_esync(
     local_steps = 1  # until the state server has a plan
     loss = acc = 0.0
     for _round in range(rounds):
+        if _preempt_noticed(kv):
+            break
         m.step_start()
         t0 = _time.perf_counter()
         ran = 0
@@ -416,7 +428,7 @@ def run_worker(
     buf: List[Optional[np.ndarray]] = [None] * len(leaves)
 
     for step, (x, y) in enumerate(data_iter):
-        if step >= steps:
+        if step >= steps or _preempt_noticed(kv):
             break
         # re-read per step: dynamic join/leave changes the party size
         # mid-training (the server broadcasts the new count, the client

@@ -84,6 +84,20 @@ class Control(enum.Enum):
     #                    incident window) and by the scheduler relaying
     #                    an operator's Ctrl.FLIGHT_DUMP request
     #                    (geomx_tpu/obs/flight.py)
+    PREEMPT_NOTICE = 18  # spot-preemption notice (graceful drain path,
+    #                    requires Config.enable_preempt).  As a REQUEST
+    #                    to a worker: finish the in-flight step, flush
+    #                    un-ACKed pushes, leave the party gracefully,
+    #                    reply {ok, drain_s} — the party server folds
+    #                    the member out IMMEDIATELY instead of stalling
+    #                    rounds until heartbeat expiry.  As a request to
+    #                    a local server: drain the WAN round and hand
+    #                    the party fold to the global tier proactively.
+    #                    As a non-request: {event: "draining", node} to
+    #                    the party scheduler holds eviction during the
+    #                    drain window; {event: "server_drained", party,
+    #                    node, boot} tells the recovery monitor the fold
+    #                    already happened so the rejoin path arms
 
 
 class Domain(enum.Enum):

@@ -20,6 +20,6 @@ export GEOMX_TRANSPORT=reactor
 exec python -m pytest -q -m 'not slow' -p no:cacheprovider \
   tests/test_reactor.py tests/test_transport.py tests/test_tcp.py \
   tests/test_wire_v2.py tests/test_ps.py tests/test_kvstore.py \
-  tests/test_failover.py tests/test_eviction.py \
+  tests/test_failover.py tests/test_eviction.py tests/test_churn.py \
   tests/test_sharded_global.py tests/test_recovery.py \
   ${PYTEST_ARGS:-}
