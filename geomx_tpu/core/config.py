@@ -797,6 +797,7 @@ class Config:
             ),
             num_standby_globals=_env_int("GEOMX_NUM_STANDBY_GLOBALS", 0),
             num_replicas=_env_int("GEOMX_SERVE_REPLICAS", 0),
+            central_party=_env_int("GEOMX_CENTRAL_PARTY", 0),
             central_worker=_env_bool(
                 "GEOMX_ENABLE_CENTRAL_WORKER",
                 _env_bool("DMLC_ENABLE_CENTRAL_WORKER"),

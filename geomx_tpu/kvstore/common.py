@@ -357,11 +357,15 @@ class Ctrl(enum.IntEnum):
     SET_SYNC_GLOBAL_MODE = 12  # body: {"sync": bool}
     SET_COMPRESSION = 13       # body: {"type": "bsc"|"2bit"|"fp16"|"mpq", ...}
     SET_HFA = 14               # body: {"enabled": bool, "k2": int}
-    STOP_SERVER = 15
+    # 15 reserved: STOP_SERVER (the reference's kStopServer) — shutdown
+    # rides Control.TERMINATE here, so the head was dead wire surface
+    # (wire-protocol audit); the value stays reserved for compatibility
     PROFILER = 16              # body: {"action": "config"|"state"|"pause"|"dump", ...}
     QUERY_STATS = 17           # body: None → reply {"wan_send_bytes": ..., ...}
     CHECKPOINT = 18            # body: {"action": "save"|"load", "path": ...}
-    DEAD_NODES = 19            # scheduler query → reply {"dead": [...]}
+    # 19 reserved: DEAD_NODES — the heartbeat-table query rides
+    # Control.DEAD_NODES (the transport head); this duplicate command
+    # head was never dispatched anywhere (wire-protocol audit)
     ESYNC = 20                 # body: {"worker", "step_s", "comm_s"} →
     #                            reply {"steps": int, "plan": {...}}
     #                            (state server; ref README.md:45 ESync

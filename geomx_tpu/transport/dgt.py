@@ -149,6 +149,11 @@ class DgtSender:
                 # every chunk carries the WAN-policy epoch too: the
                 # reassembled push must fence like an unsplit one
                 policy_epoch=msg.policy_epoch,
+                # ...and the sender incarnation nonce (the van re-stamps
+                # it at send time, but the field table must be complete:
+                # reassembly restores boot from the completion chunk and
+                # replay dedup keys on it)
+                boot=msg.boot,
             )
             if chunk_body is not None:
                 chunk.body = chunk_body
@@ -247,6 +252,11 @@ class DgtReassembler:
             trace_id=final.trace_id, span_id=final.span_id,
             parent_span_id=final.parent_span_id, sampled=final.sampled,
             policy_epoch=final.policy_epoch,
+            # restore the sender incarnation nonce: RecentRequests keys
+            # replay dedup on (sender, boot, ts) — a reassembled push
+            # with boot=0 would collide with a replaced predecessor's
+            # requests after an ADDR_UPDATE recovery
+            boot=final.boot,
             # the reassembly buffer is freshly allocated and exclusively
             # ours — the receiving server may adopt it as its accumulator
             donated=True,

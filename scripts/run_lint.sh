@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Concurrency & protocol lint lane (ISSUE 14): run the AST-based
+# static-analysis suite over the live tree, then the audit tests that
+# pin it green in tier 1 (fixture mutation checks + the live-tree
+# regression, and the metrics/env-vars doc-drift audits).
+#
+# Exit non-zero on any finding not suppressed by analysis-baseline.toml
+# (every suppression there carries a mandatory justification — see
+# docs/static-analysis.md "Baseline policy").
+#
+# Env: PYTEST_ARGS (extra pytest flags); any arguments are forwarded to
+# `python -m geomx_tpu.analysis` (e.g. --check reactor-blocking).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export JAX_PLATFORM_NAME=cpu
+
+python -m geomx_tpu.analysis "$@"
+
+exec python -m pytest -q -p no:cacheprovider \
+  tests/test_analysis.py tests/test_metrics_doc.py \
+  ${PYTEST_ARGS:-}

@@ -15,6 +15,14 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 export JAX_PLATFORM_NAME=cpu
+
+# lint gate first (ISSUE 14): the reactor-blocking checker statically
+# proves no blocking primitive is reachable from SerialChannel
+# handlers / selector callbacks / timer ticks — exactly the wedge class
+# this parity lane exists to catch dynamically.  A lint finding fails
+# the lane before any test runs.
+python -m geomx_tpu.analysis
+
 export GEOMX_TRANSPORT=reactor
 
 exec python -m pytest -q -m 'not slow' -p no:cacheprovider \

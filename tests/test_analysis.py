@@ -1,0 +1,446 @@
+"""The static-analysis suite's own tests (ISSUE 14).
+
+Two layers:
+
+- *fixture* tests: each checker runs over a tiny synthetic project
+  containing a seeded violation and a known-good twin, proving the
+  checker actually catches its bug class (the mutation check the
+  acceptance criteria ask for) and does not flag the disciplined
+  pattern.
+- *live-tree* tests: the real repo is clean modulo the committed
+  ``analysis-baseline.toml``, every baseline entry matches something
+  (no stale suppressions), and every baseline entry carries a real
+  justification (the loader enforces it; the test pins the contract).
+"""
+
+import pathlib
+import textwrap
+
+import pytest
+
+from geomx_tpu.analysis import (CHECKERS, Baseline, BaselineError, Project,
+                                repo_root, run_checkers)
+from geomx_tpu.analysis.baseline import parse as parse_baseline
+from geomx_tpu.analysis.baseline import skeleton
+from geomx_tpu.analysis.config_drift import ConfigDrift
+from geomx_tpu.analysis.doc_drift import MetricsDoc
+from geomx_tpu.analysis.lock_discipline import LockDiscipline
+from geomx_tpu.analysis.reactor_blocking import ReactorBlocking
+from geomx_tpu.analysis.wire_protocol import WireProtocol
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def make_project(tmp_path, files, docs=None):
+    """Build a throwaway project: ``files``/``docs`` map relative paths
+    to source text."""
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    for rel, text in (docs or {}).items():
+        p = tmp_path / "docs" / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return Project(tmp_path)
+
+
+def keys(findings):
+    return {f.key for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# lock discipline
+
+
+LOCK_FIXTURE = {
+    "geomx_tpu/mod.py": '''
+    import threading
+
+    class Server:
+        def __init__(self):
+            self._mu = threading.RLock()
+            self._boot_locked()        # ok: construction is pre-concurrent
+
+        def good(self):
+            with self._mu:
+                self._apply_locked()   # ok: dominated by the lock
+
+        def bad(self):
+            self._apply_locked()       # VIOLATION: no lock held
+
+        def chained_locked(self):
+            self._apply_locked()       # ok: caller-chain contract
+
+        def documented(self):
+            """Caller holds the stripe for this key."""
+            self._apply_locked()       # ok: documented contract
+
+        def drains_under_lock(self, shards):
+            with self._mu:
+                shards.drain()         # VIOLATION: drain under a lock
+
+        def _apply_locked(self):
+            pass
+
+        def _boot_locked(self):
+            pass
+
+    class Cyclic:
+        def __init__(self):
+            self._a_mu = threading.Lock()
+            self._b_mu = threading.Lock()
+
+        def ab(self):
+            with self._a_mu:
+                with self._b_mu:
+                    pass
+
+        def ba(self):
+            with self._b_mu:
+                with self._a_mu:
+                    pass
+    ''',
+}
+
+
+def test_lock_discipline_fixture(tmp_path):
+    project = make_project(tmp_path, LOCK_FIXTURE)
+    got = keys(LockDiscipline().run(project))
+    assert "geomx_tpu/mod.py::Server.bad::_apply_locked" in got
+    assert ("geomx_tpu/mod.py::Server.drains_under_lock::drain-under-lock"
+            in got)
+    assert any(k.startswith("lock-order-cycle::") for k in got)
+    # the disciplined patterns stay clean
+    for qual in ("Server.good", "Server.chained_locked",
+                 "Server.documented", "Server.__init__"):
+        assert not any(f"::{qual}::" in k for k in got), (qual, got)
+
+
+def test_lock_order_interprocedural(tmp_path):
+    project = make_project(tmp_path, {"geomx_tpu/mod.py": '''
+    import threading
+
+    class A:
+        def __init__(self):
+            self._a_mu = threading.Lock()
+            self._b_mu = threading.Lock()
+
+        def outer(self):
+            with self._a_mu:
+                self.inner()
+
+        def inner(self):
+            with self._b_mu:
+                pass
+
+        def reversed_outer(self):
+            with self._b_mu:
+                with self._a_mu:
+                    pass
+    '''})
+    got = keys(LockDiscipline().run(project))
+    assert any(k.startswith("lock-order-cycle::") for k in got), got
+
+
+# ---------------------------------------------------------------------------
+# reactor blocking
+
+
+REACTOR_FIXTURE = {
+    "geomx_tpu/mod.py": '''
+    import time
+
+    class BadHandler:
+        def __init__(self, reactor):
+            self.chan = reactor.channel(self._on_msg)
+
+        def _on_msg(self, msg):
+            time.sleep(0.5)                     # VIOLATION
+            self._helper(msg)
+
+        def _helper(self, msg):
+            self.app.send_cmd(msg.sender, 1)    # VIOLATION (wait=True)
+
+    class GoodHandler:
+        def __init__(self, reactor):
+            self.chan = reactor.channel(self._on_msg)
+
+        def _on_msg(self, msg):
+            self.app.send_cmd(msg.sender, 1, wait=False)   # ok
+            self.ev.wait(0.1)                   # ok: bounded Event.wait
+
+    class Tick:
+        def __init__(self, reactor):
+            reactor.call_every(1.0, self._sweep)
+
+        def _sweep(self):
+            self.q.get()                        # VIOLATION (periodic)
+
+    class OffThread:
+        def __init__(self, reactor):
+            self.chan = reactor.channel(self._on_msg)
+
+        def _on_msg(self, msg):
+            import threading
+            threading.Thread(target=self._blocking_work).start()  # ok
+
+        def _blocking_work(self):
+            time.sleep(5)                       # ok: own thread
+    ''',
+}
+
+
+def test_reactor_blocking_fixture(tmp_path):
+    project = make_project(tmp_path, REACTOR_FIXTURE)
+    got = keys(ReactorBlocking().run(project))
+    assert "geomx_tpu/mod.py::BadHandler._on_msg::sleep:sleep" in got
+    assert "geomx_tpu/mod.py::BadHandler._helper::send-cmd:send_cmd" in got
+    assert "geomx_tpu/mod.py::Tick._sweep::queue-get:get" in got
+    # the escape hatch (Thread target) and bounded waits stay clean
+    assert not any("GoodHandler" in k for k in got), got
+    assert not any("_blocking_work" in k for k in got), got
+
+
+def test_reactor_blocking_customer_wait_default(tmp_path):
+    project = make_project(tmp_path, {"geomx_tpu/mod.py": '''
+    class H:
+        def __init__(self, reactor):
+            self.chan = reactor.channel(self._on)
+
+        def _on(self, msg):
+            ts = self.app.send_cmd(msg.sender, 1, wait=False)  # ok
+            self.customer.wait(ts)   # VIOLATION: 120 s default timeout
+    '''})
+    got = keys(ReactorBlocking().run(project))
+    assert "geomx_tpu/mod.py::H._on::wait-default:wait" in got
+
+
+# ---------------------------------------------------------------------------
+# wire protocol
+
+
+WIRE_FIXTURE = {
+    "geomx_tpu/transport/message.py": '''
+    import enum
+    import struct
+
+    class Control(enum.Enum):
+        EMPTY = 0
+        USED = 1
+        ORPHAN = 2          # VIOLATION: never referenced elsewhere
+        ALIAS_A = 7
+        ALIAS_B = 7         # VIOLATION: duplicate value
+
+    class Message:
+        _HDR = struct.Struct("<ii")
+
+        def _pack_hdr(self):
+            return self._HDR.pack(self.timestamp, self.boot)
+
+        @classmethod
+        def _unpack_hdr(cls, data, off):
+            (timestamp, _boot) = cls._HDR.unpack_from(data, off)
+            return dict(timestamp=timestamp)   # VIOLATION: boot dropped
+    ''',
+    "geomx_tpu/transport/dgt.py": '''
+    from geomx_tpu.transport.message import Message
+
+    class DgtSender:
+        def split(self, msg):
+            return [Message(timestamp=msg.timestamp)]  # VIOLATION: no boot
+
+    class DgtReassembler:
+        def accept(self, final):
+            return Message(timestamp=final.timestamp,
+                           boot=final.boot)            # carries boot: ok
+    ''',
+    "geomx_tpu/user.py": '''
+    from geomx_tpu.transport.message import Control
+
+    def handle(m):
+        if m.control is Control.USED:
+            return True
+        return m.control is Control.ALIAS_A or Control.ALIAS_B
+    ''',
+}
+
+
+def test_wire_protocol_fixture(tmp_path):
+    project = make_project(tmp_path, WIRE_FIXTURE)
+    got = keys(WireProtocol().run(project))
+    assert "geomx_tpu/transport/message.py::Control::unused:ORPHAN" in got
+    assert "geomx_tpu/transport/message.py::Control::dup:7" in got
+    assert ("geomx_tpu/transport/message.py::Message._unpack_hdr::"
+            "unpack:boot" in got)
+    assert "geomx_tpu/transport/dgt.py::DgtSender.split::field:boot" in got
+    # the reassembler DOES carry boot
+    assert ("geomx_tpu/transport/dgt.py::DgtReassembler.accept::field:boot"
+            not in got)
+    assert not any(":USED" in k for k in got), got
+
+
+# ---------------------------------------------------------------------------
+# config drift
+
+
+CONFIG_FIXTURE = {
+    "geomx_tpu/core/config.py": '''
+    import dataclasses
+    import os
+
+    def _env_int(name, default):
+        v = os.environ.get(name)
+        return default if v is None else int(v)
+
+    @dataclasses.dataclass
+    class Config:
+        wired: int = 1
+        manual_only: float = 2.0    # documented with an em-dash env cell
+        drifted: int = 3            # VIOLATION: no env, no doc row
+
+        @staticmethod
+        def from_env():
+            return Config(wired=_env_int("GEOMX_WIRED", 1))
+    ''',
+    "geomx_tpu/orphan.py": '''
+    import os
+
+    SECRET = os.environ.get("GEOMX_ORPHAN_KNOB", "")  # VIOLATION: no doc
+    ''',
+}
+
+CONFIG_DOCS = {
+    "env-vars.md": '''
+    # Config
+
+    | Env | Legacy | Field | Default | Meaning |
+    |---|---|---|---|---|
+    | `GEOMX_WIRED` | — | `wired` | 1 | a wired knob |
+    | — | — | `manual_only` | 2.0 | code-only tuning field |
+    | `GEOMX_GONE` | — | — | — | stale row |
+    ''',
+}
+
+
+def test_config_drift_fixture(tmp_path):
+    project = make_project(tmp_path, CONFIG_FIXTURE, CONFIG_DOCS)
+    got = keys(ConfigDrift().run(project))
+    assert "geomx_tpu/core/config.py::Config::noenv:drifted" in got
+    assert "geomx_tpu/core/config.py::Config::undoc:drifted" in got
+    assert "geomx_tpu/orphan.py::env::envundoc:GEOMX_ORPHAN_KNOB" in got
+    assert "docs/env-vars.md::doc::stale:GEOMX_GONE" in got
+    # wired + documented-manual fields stay clean
+    assert not any(":wired" in k or ":manual_only" in k for k in got), got
+
+
+# ---------------------------------------------------------------------------
+# metrics doc (the refactored grep-audit)
+
+
+METRICS_FIXTURE = {
+    "geomx_tpu/mod.py": '''
+    from geomx_tpu.utils.metrics import system_counter, system_gauge
+
+    class M:
+        def tick(self):
+            system_counter(f"{self.node}.good_metric").inc()
+            system_gauge(f"{self.node}.bad_metric").set(1)  # undocumented
+    ''',
+}
+
+METRICS_DOCS = {
+    "metrics.md": '''
+    # Metrics
+
+    | Name | Meaning |
+    |---|---|
+    | `good_metric` | documented |
+    | `stale_metric` | VIOLATION: no call site |
+    ''',
+}
+
+
+def test_metrics_doc_fixture(tmp_path):
+    project = make_project(tmp_path, METRICS_FIXTURE, METRICS_DOCS)
+    got = keys(MetricsDoc().run(project))
+    assert "geomx_tpu/mod.py::metric::missing:`bad_metric`" in got
+    assert "docs/metrics.md::row::stale_metric" in got
+    assert not any("good_metric" in k for k in got), got
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+
+
+def test_baseline_rejects_placeholder_reasons():
+    with pytest.raises(BaselineError):
+        parse_baseline('[[suppress]]\nchecker = "x"\nkey = "a::b::c"\n'
+                       'reason = "TODO"\n')
+    with pytest.raises(BaselineError):
+        parse_baseline('[[suppress]]\nchecker = "x"\nkey = "a::b::c"\n'
+                       'reason = "short"\n')
+
+
+def test_baseline_requires_all_fields():
+    with pytest.raises(BaselineError):
+        parse_baseline('[[suppress]]\nchecker = "x"\n'
+                       'reason = "a perfectly fine justification"\n')
+
+
+def test_baseline_filter_and_globs(tmp_path):
+    project = make_project(tmp_path, LOCK_FIXTURE)
+    findings = LockDiscipline().run(project)
+    assert findings
+    bl = Baseline(parse_baseline(
+        '[[suppress]]\nchecker = "lock-discipline"\n'
+        'key = "geomx_tpu/mod.py::Server.bad::*"\n'
+        'reason = "fixture test exercising glob suppression keys"\n'))
+    fresh, eaten = bl.filter(findings)
+    assert any(f.key.startswith("geomx_tpu/mod.py::Server.bad::")
+               for f in eaten)
+    assert not any(f.key.startswith("geomx_tpu/mod.py::Server.bad::")
+                   for f in fresh)
+    assert not bl.unused()
+
+
+def test_baseline_skeleton_is_rejected_until_justified(tmp_path):
+    project = make_project(tmp_path, LOCK_FIXTURE)
+    findings = LockDiscipline().run(project)
+    text = skeleton(findings)
+    assert "[[suppress]]" in text
+    with pytest.raises(BaselineError):
+        parse_baseline(text)
+
+
+# ---------------------------------------------------------------------------
+# live tree: the tier-1 audit
+
+
+def test_live_tree_clean_modulo_baseline():
+    """The audit itself: the committed tree has zero unsuppressed
+    findings.  Un-fixing any repaired violation (e.g. dropping ``boot``
+    from the DGT reassembler again) fails here."""
+    fresh, eaten, bl = run_checkers()
+    assert not fresh, "unsuppressed findings:\n" + "\n".join(
+        f.render() for f in fresh)
+    # and no committed suppression has gone stale
+    stale = bl.unused()
+    assert not stale, (
+        "baseline entries that matched nothing (delete them): "
+        + str([(s.checker, s.key) for s in stale]))
+
+
+def test_live_tree_baseline_is_committed_and_justified():
+    text = (repo_root() / "analysis-baseline.toml").read_text()
+    entries = parse_baseline(text)   # raises on placeholder reasons
+    assert entries, "the committed baseline should document the audited "
+    "exceptions"
+
+
+def test_checker_registry_catalog():
+    assert set(CHECKERS) == {"lock-discipline", "reactor-blocking",
+                             "wire-protocol", "config-drift",
+                             "metrics-doc"}
+    for name, cls in CHECKERS.items():
+        assert cls.name == name and cls.description

@@ -1,102 +1,42 @@
-"""docs/metrics.md grep-audit (ISSUE 7 satellite): every system metric
-name registered anywhere in geomx_tpu/ must be documented.
+"""docs/metrics.md audit (ISSUE 7 satellite), now running on the
+shared static-analysis framework (ISSUE 14): the extraction, the
+dynamic-name expansion table and both audit directions live in
+``geomx_tpu.analysis.doc_drift.MetricsDoc`` — this module keeps the
+same two test surfaces (undocumented metrics / stale doc rows) so a
+failure still names the direction that drifted.
 
-The audit extracts each ``system_counter``/``system_gauge`` call site's
-name template from source.  Static suffixes must appear (backticked) in
-the catalog; templates whose suffix is dynamic must have an explicit
-expansion below — adding a new dynamic call site without documenting
-its expansions fails here, by design.
+The dynamic expansions (templates whose suffix is computed at runtime,
+e.g. ``{self.node}.wan_bytes_{tag}``) are defined in
+``doc_drift.metric_expansions()``; adding a new dynamic call site
+without declaring its expansions fails here, by design, exactly like
+the pre-framework grep audit did.
 """
 
-import pathlib
-import re
+from geomx_tpu.analysis import Project, repo_root
+from geomx_tpu.analysis.doc_drift import (MetricsDoc, metric_expansions,
+                                          metric_templates)
 
-from geomx_tpu.obs.health import RULES
-
-ROOT = pathlib.Path(__file__).resolve().parent.parent
-DOC = ROOT / "docs" / "metrics.md"
-_CALL = re.compile(r'system_(?:counter|gauge)\(\s*f?"([^"]+)"', re.S)
-
-# templates whose SUFFIX is computed at runtime -> the concrete names
-# they can produce (each must be documented)
-EXPANSIONS = {
-    "{self.po.node}.{action}s": ["party_folds", "party_unfolds"],
-    "{postoffice.node}.wan_policy_{a}s": [
-        "wan_policy_downshifts", "wan_policy_upshifts",
-        "wan_policy_manuals"],
-    "{self.node}.wan_bytes_{tag or 'vanilla'}": [
-        "wan_bytes_vanilla", "wan_bytes_fp16", "wan_bytes_2bit",
-        "wan_bytes_bsc", "wan_bytes_mpq"],
-    "{self.node}.health_{r}_alerts": [
-        f"health_{r}_alerts" for r in RULES],
-    # the flight recorder's pressure gauges (obs/flight.py
-    # add_pressure): the van's send-queue / process-thread / reactor
-    # probes are registered by the Postoffice, the merge-side trio by
-    # attach_server_pressure
-    "{self.node}.{name}": ["lock_wait_s", "lane_depth",
-                           "van_sendq_depth", "codec_pool_busy",
-                           "process_threads", "reactor_loop_lag_ms",
-                           "reactor_fds"],
-}
+# re-exported for anything that imported the table from here
+EXPANSIONS = metric_expansions()
 
 
-def _templates():
-    out = []
-    for p in sorted((ROOT / "geomx_tpu").rglob("*.py")):
-        for m in _CALL.finditer(p.read_text()):
-            out.append((str(p.relative_to(ROOT)), m.group(1)))
-    return out
+def _findings():
+    return MetricsDoc().run(Project(repo_root()))
 
 
 def test_every_registered_metric_is_documented():
-    doc = DOC.read_text()
-    templates = _templates()
-    assert templates, "audit regex found no call sites — broken audit"
-    missing = []
-    for src, tpl in templates:
-        # collapse {placeholders} to a marker FIRST — the node
-        # expression itself contains dots ({self.po.node}.x)
-        norm = re.sub(r"\{[^}]*\}", "\x00", tpl)
-        assert "." in norm, f"{src}: metric {tpl!r} has no node prefix"
-        prefix, suffix = norm.split(".", 1)
-        if "\x00" in suffix:
-            if tpl not in EXPANSIONS:
-                missing.append(
-                    f"{src}: dynamic metric name {tpl!r} — add its "
-                    "expansions to tests/test_metrics_doc.py AND "
-                    "document them in docs/metrics.md")
-                continue
-            for name in EXPANSIONS[tpl]:
-                if f"`{name}`" not in doc:
-                    missing.append(f"{src}: {name} (expansion of {tpl!r})")
-            continue
-        if prefix == "\x00":
-            # per-node metric: the doc lists the bare suffix
-            token = f"`{suffix}`"
-        else:
-            # literal family prefix (global_shard<k>.*): the doc lists
-            # the full dotted pattern with <k>
-            token = "`" + prefix.replace("\x00", "<k>") + "." + suffix + "`"
-        if token not in doc:
-            missing.append(f"{src}: {token} not in docs/metrics.md")
-    assert not missing, "undocumented system metrics:\n" + "\n".join(missing)
+    project = Project(repo_root())
+    assert metric_templates(project), \
+        "audit regex found no call sites — broken audit"
+    missing = [f for f in _findings() if "::row::" not in f.key]
+    assert not missing, "undocumented system metrics:\n" + "\n".join(
+        f.render() for f in missing)
 
 
 def test_doc_has_no_stale_entries():
     """The reverse direction, loosely: every per-node table row's name
     still has a matching call site (catches renames that orphan doc
-    rows).  Dynamic expansions and the global_shard family are checked
-    by name-substring against the template list."""
-    doc = DOC.read_text()
-    templates = [t for _, t in _templates()]
-    expanded = [n for names in EXPANSIONS.values() for n in names]
-    rows = re.findall(r"^\| `([^`]+)` \|", doc, re.M)
-    assert rows, "no table rows parsed from docs/metrics.md"
-    stale = []
-    for name in rows:
-        bare = name.replace("global_shard<k>.", "")
-        if name in expanded or bare in expanded:
-            continue
-        if not any(t.endswith(f".{bare}") for t in templates):
-            stale.append(name)
-    assert not stale, f"doc rows with no call site: {stale}"
+    rows)."""
+    stale = [f for f in _findings() if "::row::" in f.key]
+    assert not stale, "doc rows with no call site:\n" + "\n".join(
+        f.render() for f in stale)
