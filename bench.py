@@ -2097,6 +2097,239 @@ def child_serve():
             }
         finally:
             sim.shutdown()
+    # ---- serving plane (ISSUE 15): balancer vs single-target, then a
+    # mixed read+train soak under seeded replica churn with admission
+    # control, batched predict, and the autoscaler all on ------------------
+    def _reader_pool(read_fn, n_threads, seconds, recs, mu):
+        t_end = time.monotonic() + seconds
+
+        def loop(i):
+            j = 0
+            while time.monotonic() < t_end:
+                tid = (i + j) % N_TENSORS
+                j += 1
+                t0 = time.perf_counter()
+                try:
+                    _, meta = read_fn(tid)
+                except (TimeoutError, RuntimeError):
+                    with mu:
+                        recs["errors"] += 1
+                    continue
+                dt = (time.perf_counter() - t0) * 1e3
+                with mu:
+                    recs["pulls"] += 1
+                    recs["lats"].append((time.monotonic(), dt))
+                    s = meta.get("staleness_s")
+                    if isinstance(s, (int, float)):
+                        recs["stals"].append(float(s))
+
+        ths = [_threading.Thread(target=loop, args=(i,), daemon=True)
+               for i in range(n_threads)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=seconds + 30)
+
+    def _pct_vals(vals, q):
+        return pct(vals, q) or 0.0
+
+    # (a) balanced reads at 2 replicas, same shape as the sweep's
+    # single-target measurement: the LB must not cost throughput
+    lb_phase = {}
+    cfg = Config(
+        topology=Topology(num_parties=2, workers_per_party=1,
+                          num_replicas=2),
+        serve_staleness_s=BOUND, serve_refresh_interval_s=0.1,
+        serve_attempt_timeout_s=0.5)
+    sim = Simulation(cfg)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            for tid in range(N_TENSORS):
+                w.init(tid, np.zeros(ELEMS, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        g = np.ones(ELEMS, np.float32)
+        stop = _threading.Event()
+
+        def train():
+            while not stop.is_set():
+                for w in ws:
+                    for tid in range(N_TENSORS):
+                        w.push(tid, g)
+                for w in ws:
+                    for tid in range(N_TENSORS):
+                        w.pull_sync(tid)
+                    w.wait_all()
+
+        trainer = _threading.Thread(target=train, daemon=True)
+        trainer.start()
+        deadline = time.monotonic() + 20
+        while (time.monotonic() < deadline
+               and any(r.refresh_rounds == 0 or len(r.store) == 0
+                       for r in sim.replicas)):
+            time.sleep(0.05)
+        # one balancer per reader, like the sweep's one client per
+        # reader — the comparison measures the LB policy, not lock
+        # contention on a shared customer
+        n_readers = 2 * CLIENTS_PER_REPLICA
+        lbs = [sim.serve_balancer(seed=i) for i in range(n_readers)]
+        idx = _threading.local()
+        counter = [0]
+        mu = _threading.Lock()
+
+        def balanced_read(tid):
+            if not hasattr(idx, "lb"):
+                with mu:
+                    idx.lb = lbs[counter[0] % n_readers]
+                    counter[0] += 1
+            return idx.lb.pull_tensor(tid, ELEMS, timeout=5.0)
+
+        recs = {"pulls": 0, "errors": 0, "lats": [], "stals": []}
+        _reader_pool(balanced_read, n_readers, SECONDS, recs, mu)
+        stop.set()
+        trainer.join(timeout=30)
+        single = sweep["2"]["pulls_per_sec"]
+        lb_qps = round(recs["pulls"] / SECONDS, 1)
+        lats = [v for _, v in recs["lats"]]
+        agg = [lb.stats() for lb in lbs]
+        lb_phase = {
+            "pulls_per_sec": lb_qps,
+            "vs_single_target_2rep": round(lb_qps / max(single, 1e-9),
+                                           2),
+            "p50_ms": round(_pct_vals(lats, 0.5), 2),
+            "p99_ms": round(_pct_vals(lats, 0.99), 2),
+            "read_errors": recs["errors"],
+            "bound_violations": sum(1 for s in recs["stals"]
+                                    if s > BOUND),
+            "lb": {k: sum(st[k] for st in agg)
+                   for k in ("picks", "failovers", "sheds",
+                             "ejections", "probes", "recoveries")},
+        }
+    finally:
+        sim.shutdown()
+
+    # (b) the churn soak: 3 replicas, seeded replica kills mid-load,
+    # admission + batching + autoscaler on.  Judged on: zero staleness
+    # violations SERVED, sheds explicit and bounded, p99 recovered
+    # after the kills, autoscaler stable (no reversal inside cooldown)
+    from geomx_tpu.chaos.churn import (ChurnOrchestrator, ChurnPhase,
+                                       ChurnPlan)
+
+    SOAK_S = float(os.environ.get("BENCH_SERVE_SOAK_S", "7.0"))
+    plane = {}
+    cfg = Config(
+        topology=Topology(num_parties=2, workers_per_party=1,
+                          num_replicas=3),
+        serve_staleness_s=BOUND, serve_refresh_interval_s=0.1,
+        heartbeat_interval_s=0.2, heartbeat_timeout_s=1.0,
+        request_retry_s=1.0,
+        serve_max_inflight=64, serve_batch_max=8,
+        serve_attempt_timeout_s=0.5, serve_eject_errors=2,
+        serve_probe_s=0.5, serve_lb_refresh_s=0.5,
+        enable_obs=True, obs_interval_s=0.25,
+        serve_autoscale=True, serve_scale_interval_s=0.5,
+        serve_scale_cooldown_s=2.0, serve_min_replicas=2)
+    sim = Simulation(cfg)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            for tid in range(N_TENSORS):
+                w.init(tid, np.zeros(ELEMS, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.1})
+        g = np.ones(ELEMS, np.float32)
+        stop = _threading.Event()
+        rounds = [0]
+
+        def train2():
+            while not stop.is_set():
+                for w in ws:
+                    for tid in range(N_TENSORS):
+                        w.push(tid, g)
+                for w in ws:
+                    for tid in range(N_TENSORS):
+                        w.pull_sync(tid)
+                    w.wait_all()
+                rounds[0] += 1
+
+        trainer = _threading.Thread(target=train2, daemon=True)
+        trainer.start()
+        deadline = time.monotonic() + 20
+        while (time.monotonic() < deadline
+               and any(r.refresh_rounds == 0 or len(r.store) == 0
+                       for r in sim.replicas)):
+            time.sleep(0.05)
+        lb = sim.serve_balancer(seed=1)
+        plan = ChurnPlan(
+            phases=(ChurnPhase(duration_s=SOAK_S * 0.7,
+                               notice_fraction=0.0,
+                               replica_kill_rate=0.45,
+                               replica_restart_s=1.2),),
+            seed=int(os.environ.get("BENCH_SERVE_SOAK_SEED", "5")),
+            min_replicas_live=2)
+        orch = ChurnOrchestrator(sim, plan)
+        recs = {"pulls": 0, "errors": 0, "lats": [], "stals": []}
+        mu = _threading.Lock()
+        t_soak0 = time.monotonic()
+        orch.start()
+        _reader_pool(lambda tid: lb.pull_tensor(tid, ELEMS,
+                                                timeout=5.0),
+                     6, SOAK_S, recs, mu)
+        orch.stop()
+        orch.join(timeout=10)
+        stop.set()
+        trainer.join(timeout=30)
+        # p99 recovery: bucket latencies per second; after the LAST
+        # kill the tail bucket must sit back near the pre-kill median
+        kills = [e["t"] for e in orch.events
+                 if e["kind"] == "churn_replica_kill"]
+        buckets = {}
+        for t, ms in recs["lats"]:
+            buckets.setdefault(int(t - t_soak0), []).append(ms)
+        per_bucket_p99 = {b: _pct_vals(v, 0.99)
+                          for b, v in sorted(buckets.items())}
+        pre = ([per_bucket_p99[b] for b in per_bucket_p99
+                if not kills or t_soak0 + b < min(kills)]
+               or list(per_bucket_p99.values()))
+        baseline_p99 = sorted(pre)[len(pre) // 2]
+        tail = [per_bucket_p99[b] for b in sorted(per_bucket_p99)[-2:]]
+        p99_recovered = (not kills or not tail or
+                         min(tail) <= max(3.0 * baseline_p99, 50.0))
+        asc = sim.replica_autoscaler
+        stable = True
+        ds = asc.decisions
+        for i in range(1, len(ds)):
+            if (ds[i]["action"] != ds[i - 1]["action"]
+                    and ds[i]["t_mono"] - ds[i - 1]["t_mono"]
+                    < asc.cooldown_s):
+                stable = False
+        lb_st = lb.stats()
+        shed_total = lb_st["sheds"] + sum(
+            r.serve_sheds for r in sim.replicas)
+        plane = {
+            "soak_s": SOAK_S,
+            "pulls_per_sec": round(recs["pulls"] / SOAK_S, 1),
+            "read_errors": recs["errors"],
+            "replica_kills": orch.stats()["replica_kills"],
+            "violations_served": sum(1 for s in recs["stals"]
+                                     if s > BOUND),
+            "sheds": shed_total,
+            "sheds_all_carried_retry_after": True,  # shed errors are
+            # constructed with retry_after_s unconditionally
+            # (serve/replica.py _shed); the balancer counts them as
+            # honored sheds, not failures
+            "shed_frac": round(shed_total
+                               / max(recs["pulls"] + shed_total, 1), 4),
+            "lb": lb_st,
+            "p99_ms_prekill": round(baseline_p99, 2),
+            "p99_ms_tail": [round(v, 2) for v in tail],
+            "p99_recovered": bool(p99_recovered),
+            "autoscale": asc.stats(),
+            "autoscale_stable": bool(stable),
+            "train_rounds": rounds[0],
+        }
+    finally:
+        sim.shutdown()
+
     base = sweep["1"]["pulls_per_sec"]
     print(json.dumps({
         "tensors": N_TENSORS,
@@ -2108,6 +2341,8 @@ def child_serve():
             k: round(v["pulls_per_sec"] / max(base, 1e-9), 2)
             for k, v in sweep.items()},
         "sweep": sweep,
+        "balanced": lb_phase,
+        "plane_soak": plane,
     }))
 
 
@@ -2526,6 +2761,19 @@ def _compact(record: dict) -> dict:
     sv = record.get("serve") or {}
     if sv.get("pulls_per_sec"):
         out["serve_pulls_per_sec"] = sv["pulls_per_sec"]
+    bal = sv.get("balanced") or {}
+    if bal.get("pulls_per_sec") is not None:
+        out["serve_lb_vs_single"] = bal.get("vs_single_target_2rep")
+    pl = sv.get("plane_soak") or {}
+    if pl.get("pulls_per_sec") is not None:
+        out["serve_plane"] = {
+            "qps": pl["pulls_per_sec"],
+            "kills": pl.get("replica_kills"),
+            "violations_served": pl.get("violations_served"),
+            "shed_frac": pl.get("shed_frac"),
+            "p99_recovered": pl.get("p99_recovered"),
+            "autoscale_stable": pl.get("autoscale_stable"),
+        }
     ch = record.get("churn") or {}
     if ch.get("churn_overhead_pct") is not None:
         out["churn_overhead_pct"] = ch["churn_overhead_pct"]

@@ -17,11 +17,15 @@ tape, and :class:`ChurnOrchestrator` executes it against a live
 - arrival → ``Simulation.add_worker`` + the harness's ``spawn``
   callback (dynamic join);
 - local-server preemption → ``kill_local_server`` + a scheduled
-  ``restart_local_server`` (fold → warm boot → unfold).
+  ``restart_local_server`` (fold → warm boot → unfold);
+- serve-replica preemption → ``kill_replica`` + a scheduled
+  ``restart_replica`` (eviction → view prune → dense-resync rejoin —
+  the serving-plane soak's churn axis, ISSUE 15).
 
 Every injected event is stamped into the global scheduler's flight
 recorder (``FlightEv.CHURN``) and counted in the registry family
-``churn_{notices,graceful_leaves,ungraceful_kills,joins,stall_rounds}``
+``churn_{notices,graceful_leaves,ungraceful_kills,joins,replica_kills,
+stall_rounds}``
 so a postmortem can attribute a stall to an injected fault vs an
 organic one, and the health engine's ``churn_storm`` rule can page on
 transition rate / survivor floor (obs/health.py).
@@ -50,6 +54,12 @@ class ChurnPhase:
     notice_fraction: float = 1.0  # P(a departure gets a preempt notice)
     server_kill_rate: float = 0.0  # local-server preemptions per second
     server_restart_s: float = 2.0  # replacement delay after a server kill
+    replica_kill_rate: float = 0.0  # serve-replica preemptions per
+    #                                 second (the serving-plane soak's
+    #                                 churn axis, ISSUE 15)
+    replica_restart_s: float = 2.0  # replacement delay after a replica
+    #                                 kill (fresh boot, empty store —
+    #                                 first refresh resyncs dense)
 
 
 @dataclasses.dataclass
@@ -63,6 +73,9 @@ class ChurnPlan:
     min_workers_per_party: int = 1  # departure floor (survivors per party)
     max_workers_per_party: int = 4  # join ceiling per party
     min_servers_live: int = 1       # floor on simultaneously-live parties
+    min_replicas_live: int = 1      # floor on simultaneously-live serve
+    #                                 replicas (a kill that would breach
+    #                                 it is skipped, like the worker floor)
 
     def schedule(self) -> List[Tuple[float, str, ChurnPhase]]:
         """The deterministic event tape: sorted ``(t, kind, phase)``
@@ -75,7 +88,8 @@ class ChurnPlan:
         for ph in self.phases:
             for kind, rate in (("depart", ph.departure_rate),
                                ("join", ph.join_rate),
-                               ("server_kill", ph.server_kill_rate)):
+                               ("server_kill", ph.server_kill_rate),
+                               ("replica_kill", ph.replica_kill_rate)):
                 if rate <= 0:
                     continue
                 t = t0
@@ -136,7 +150,10 @@ class ChurnOrchestrator:
                               for w in sim.topology.workers(p)}
         self._server_live = {p: True
                              for p in range(sim.topology.num_parties)}
+        self._replica_live = {r: True
+                              for r in range(sim.topology.num_replicas)}
         self._restarts: List[Tuple[float, int]] = []  # (t, party)
+        self._replica_restarts: List[Tuple[float, int]] = []  # (t, rank)
         self.noticed: set = set()      # nodes that got a graceful notice
         self.killed: set = set()       # nodes killed ungracefully
         self.drain_latencies: List[float] = []
@@ -149,6 +166,8 @@ class ChurnOrchestrator:
         self._c_kills = system_counter(
             f"{self.node}.churn_ungraceful_kills")
         self._c_joins = system_counter(f"{self.node}.churn_joins")
+        self._c_replica_kills = system_counter(
+            f"{self.node}.churn_replica_kills")
         self._c_stalls = system_counter(
             f"{self.node}.churn_stall_rounds")
         self._g_survivors = system_gauge(f"{self.node}.churn_survivors")
@@ -180,6 +199,7 @@ class ChurnOrchestrator:
                 "graceful_leaves": self._c_leaves.value,
                 "ungraceful_kills": self._c_kills.value,
                 "joins": self._c_joins.value,
+                "replica_kills": self._c_replica_kills.value,
                 "stall_rounds": self._c_stalls.value,
                 "transitions": len(self.events),
                 "survivors": self._survivor_count(),
@@ -201,7 +221,11 @@ class ChurnOrchestrator:
             for r in [r for r in self._restarts if r[0] <= now]:
                 self._restarts.remove(r)
                 self._do_server_restart(r[1])
+            for r in [r for r in self._replica_restarts if r[0] <= now]:
+                self._replica_restarts.remove(r)
+                self._do_replica_restart(r[1])
             deadlines = [r[0] for r in self._restarts]
+            deadlines += [r[0] for r in self._replica_restarts]
             if i < len(self._tape):
                 deadlines.append(t_start + self._tape[i][0])
             if not deadlines:
@@ -342,6 +366,29 @@ class ChurnOrchestrator:
             self.sim.kill_local_server(p)
             self._restarts.append(
                 (time.monotonic() + ph.server_restart_s, p))
+        elif kind == "replica_kill":
+            with self._mu:
+                live = [r for r, up in self._replica_live.items()
+                        if up and f"replica:{r}" not in self.protect]
+                if len([r for r, up in self._replica_live.items()
+                        if up]) <= self.plan.min_replicas_live \
+                        or not live:
+                    return  # replica floor: the kill is skipped
+                r = self._rng.choice(sorted(live))
+                self._replica_live[r] = False
+            self._c_replica_kills.inc()
+            self.killed.add(f"replica:{r}")
+            self._stamp("churn_replica_kill", f"replica:{r}")
+            self.sim.kill_replica(r)
+            self._replica_restarts.append(
+                (time.monotonic() + ph.replica_restart_s, r))
+
+    def _do_replica_restart(self, rank: int):
+        self.sim.restart_replica(rank)
+        with self._mu:
+            self._replica_live[rank] = True
+        self._stamp("churn_replica_restart", f"replica:{rank}")
+        print(f"churn: restarted replica:{rank}", flush=True)
 
     def _do_server_restart(self, party: int):
         self.sim.restart_local_server(party)

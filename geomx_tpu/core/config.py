@@ -638,6 +638,66 @@ class Config:
     #                                     0 = no refresh thread (manual
     #                                     refresh() only — what the
     #                                     deterministic tests drive)
+    # --- self-healing serving plane (geomx_tpu/serve: balancer.py /
+    # autoscaler.py + replica-side admission control; docs/serving.md
+    # "Serving plane").  The TensorFlow-paper posture: degrade by
+    # REFUSING work with an explicit retry signal (RETRY_AFTER sheds),
+    # never by missing every deadline, and keep capacity elastic.
+    serve_max_inflight: int = 0       # replica admission budget: pending
+    #                                   reads (queued + parked + batch)
+    #                                   past it are answered with an
+    #                                   explicit RETRY_AFTER shed error
+    #                                   instead of queueing unboundedly.
+    #                                   0 (default) = admission control
+    #                                   OFF — bit-for-bit the PR 8 path
+    serve_retry_after_s: float = 0.05  # suggested backoff carried in
+    #                                   shed errors (clients add jitter)
+    serve_batch_max: int = 0          # PREDICT batching: aggregate up to
+    #                                   this many compatible requests
+    #                                   into one forward pass; <=1 = off
+    serve_batch_wait_ms: float = 2.0  # batch latency budget: a pending
+    #                                   batch flushes after this long
+    #                                   even if not full
+    serve_lb_refresh_s: float = 1.0   # balancer cluster-state view
+    #                                   cache: refreshed at most this
+    #                                   often (Ctrl.CLUSTER_STATE query)
+    serve_eject_errors: int = 3       # consecutive failures before the
+    #                                   balancer ejects a replica from
+    #                                   the candidate set
+    serve_probe_s: float = 1.0        # half-open probe backoff: an
+    #                                   ejected replica gets one trial
+    #                                   read after this long
+    serve_attempt_timeout_s: float = 1.0  # balancer per-ATTEMPT read
+    #                                   timeout: the first failure on a
+    #                                   dead target triggers an immediate
+    #                                   re-pick instead of burning the
+    #                                   caller's whole deadline
+    serve_autoscale: bool = False     # ReplicaAutoscaler on the global
+    #                                   scheduler (needs enable_obs: it
+    #                                   reads the collector's series)
+    serve_min_replicas: int = 1       # autoscaler floor (active replicas)
+    serve_max_replicas: int = 0       # autoscaler ceiling; 0 = follow
+    #                                   topology.num_replicas
+    serve_scale_interval_s: float = 0.0  # autoscaler sweep cadence;
+    #                                   0 = manual tick() (tests)
+    serve_scale_cooldown_s: float = 5.0  # min seconds between scaling
+    #                                   actions (the WanPolicyEngine
+    #                                   hysteresis discipline)
+    serve_scale_patience: int = 2     # consecutive out-of-band sweeps
+    #                                   before scaling up (down needs 2x:
+    #                                   shrinking is the risky direction)
+    serve_target_qps: float = 0.0     # per-replica serve QPS target the
+    #                                   autoscaler sizes against; 0 =
+    #                                   shed/staleness/p99-driven only
+    #                                   (no QPS-based scale-down)
+    serve_scale_p99_ms: float = 0.0   # p99 read-latency ceiling that
+    #                                   counts as overload; 0 = off
+    obs_shed_rate: float = 2.0        # serve_overload health rule:
+    #                                   sustained sheds/s per replica
+    #                                   over the collector window
+    obs_replica_flap: int = 2         # replica_flap health rule:
+    #                                   autoscaler direction reversals
+    #                                   inside cooldown per window
     verbose: int = 0
 
     def __post_init__(self):
@@ -760,6 +820,41 @@ class Config:
         if self.serve_refresh_interval_s < 0:
             raise ValueError("serve_refresh_interval_s must be >= 0 "
                              "(0 = manual refresh)")
+        if self.serve_max_inflight < 0:
+            raise ValueError("serve_max_inflight must be >= 0 "
+                             "(0 = admission control off)")
+        if self.serve_retry_after_s <= 0:
+            raise ValueError("serve_retry_after_s must be > 0 (the shed "
+                             "errors carry it as the suggested backoff)")
+        if self.serve_batch_max < 0 or self.serve_batch_wait_ms < 0:
+            raise ValueError("serve_batch_max and serve_batch_wait_ms "
+                             "must be >= 0")
+        if self.serve_eject_errors < 1:
+            raise ValueError("serve_eject_errors must be >= 1")
+        if self.serve_probe_s <= 0 or self.serve_attempt_timeout_s <= 0:
+            raise ValueError("serve_probe_s and serve_attempt_timeout_s "
+                             "must be > 0")
+        if self.serve_lb_refresh_s < 0:
+            raise ValueError("serve_lb_refresh_s must be >= 0")
+        if self.serve_min_replicas < 1:
+            raise ValueError("serve_min_replicas must be >= 1 (the "
+                             "serving tier never scales to zero)")
+        if self.serve_max_replicas < 0:
+            raise ValueError("serve_max_replicas must be >= 0 "
+                             "(0 = follow topology.num_replicas)")
+        if self.serve_scale_interval_s < 0 \
+                or self.serve_scale_cooldown_s < 0:
+            raise ValueError("serve_scale_interval_s and "
+                             "serve_scale_cooldown_s must be >= 0")
+        if self.serve_scale_patience < 1:
+            raise ValueError("serve_scale_patience must be >= 1")
+        if self.serve_target_qps < 0 or self.serve_scale_p99_ms < 0:
+            raise ValueError("serve_target_qps and serve_scale_p99_ms "
+                             "must be >= 0 (0 = off)")
+        if self.obs_shed_rate <= 0:
+            raise ValueError("obs_shed_rate must be > 0")
+        if self.obs_replica_flap < 1:
+            raise ValueError("obs_replica_flap must be >= 1")
         if self.server_shards < 0:
             raise ValueError("server_shards must be >= 0 (0 = auto)")
         if self.transport not in ("", "threads", "reactor"):
@@ -911,5 +1006,31 @@ class Config:
             serve_staleness_s=_env_float("GEOMX_SERVE_STALENESS_S", 5.0),
             serve_refresh_interval_s=_env_float("GEOMX_SERVE_REFRESH_S",
                                                 0.5),
+            serve_max_inflight=_env_int("GEOMX_SERVE_MAX_INFLIGHT", 0),
+            serve_retry_after_s=_env_float("GEOMX_SERVE_RETRY_AFTER_S",
+                                           0.05),
+            serve_batch_max=_env_int("GEOMX_SERVE_BATCH_MAX", 0),
+            serve_batch_wait_ms=_env_float("GEOMX_SERVE_BATCH_WAIT_MS",
+                                           2.0),
+            serve_lb_refresh_s=_env_float("GEOMX_SERVE_LB_REFRESH_S",
+                                          1.0),
+            serve_eject_errors=_env_int("GEOMX_SERVE_EJECT_ERRORS", 3),
+            serve_probe_s=_env_float("GEOMX_SERVE_PROBE_S", 1.0),
+            serve_attempt_timeout_s=_env_float(
+                "GEOMX_SERVE_ATTEMPT_TIMEOUT_S", 1.0),
+            serve_autoscale=_env_bool("GEOMX_SERVE_AUTOSCALE"),
+            serve_min_replicas=_env_int("GEOMX_SERVE_MIN_REPLICAS", 1),
+            serve_max_replicas=_env_int("GEOMX_SERVE_MAX_REPLICAS", 0),
+            serve_scale_interval_s=_env_float(
+                "GEOMX_SERVE_SCALE_INTERVAL_S", 0.0),
+            serve_scale_cooldown_s=_env_float(
+                "GEOMX_SERVE_SCALE_COOLDOWN_S", 5.0),
+            serve_scale_patience=_env_int("GEOMX_SERVE_SCALE_PATIENCE",
+                                          2),
+            serve_target_qps=_env_float("GEOMX_SERVE_TARGET_QPS", 0.0),
+            serve_scale_p99_ms=_env_float("GEOMX_SERVE_SCALE_P99_MS",
+                                          0.0),
+            obs_shed_rate=_env_float("GEOMX_OBS_SHED_RATE", 2.0),
+            obs_replica_flap=_env_int("GEOMX_OBS_REPLICA_FLAP", 2),
             verbose=_env_int("GEOMX_VERBOSE", _env_int("PS_VERBOSE", 0)),
         )

@@ -406,3 +406,12 @@ class Ctrl(enum.IntEnum):
     #                            broadcast under one incident id and
     #                            replies with the dump dir + expected
     #                            per-node paths (geomx_tpu/obs/flight)
+    SERVE_SCALE = 27           # replica autoscaler -> serve replica
+    #                            (geomx_tpu/serve/autoscaler): body
+    #                            {"active": bool}.  False RETIRES the
+    #                            replica — its refresh loop pauses and
+    #                            reads are answered with an explicit
+    #                            RETRY_AFTER shed so the balancer routes
+    #                            elsewhere; True reactivates it (the
+    #                            next refresh resyncs dense, rejoin
+    #                            semantics).  Reply: {"ok", "active"}

@@ -140,6 +140,7 @@ class Simulation:
         # (the default) constructs nothing — no threads, no endpoints.
         self.replicas: List["ModelReplica"] = []
         self.replica_monitor = None
+        self.replica_autoscaler = None
         self._serve_clients: List = []
         if self.topology.num_replicas:
             from geomx_tpu.serve import ModelReplica
@@ -153,6 +154,17 @@ class Simulation:
 
                 self.replica_monitor = ReplicaMonitor(
                     self.offices[str(self.topology.global_scheduler())])
+            if config.serve_autoscale:
+                # elastic serve capacity (geomx_tpu/serve/autoscaler):
+                # decisions read the telemetry plane, scale-down retires
+                # over the wire, scale-up revives through the same path
+                # a restarted --role replica:K process takes
+                from geomx_tpu.serve import ReplicaAutoscaler
+
+                self.replica_autoscaler = ReplicaAutoscaler(
+                    self.offices[gsched], config,
+                    collector=self.metrics_collector,
+                    spawn=self.restart_replica)
         self.workers: Dict[str, WorkerKVStore] = {}
         for p in range(self.topology.num_parties):
             for w in self.topology.workers(p):
@@ -514,6 +526,27 @@ class Simulation:
                 po, self.config, stats_fn=rep.stats)
         return rep
 
+    def serve_balancer(self, replicas=None,
+                       seed: int = 0) -> "ServeBalancer":
+        """An out-of-plan balanced read frontend over the replica set
+        (the wire path an inference frontend uses with the serving
+        plane on).  Heartbeats off — a passive querier has no
+        scheduler slot to ping."""
+        import dataclasses
+
+        from geomx_tpu.serve import ServeBalancer
+
+        with self._join_mu:
+            n = NodeId.parse(
+                f"master_worker:{700 + len(self._serve_clients)}")
+            cfg = dataclasses.replace(self.config,
+                                      heartbeat_interval_s=0.0)
+            po = Postoffice(n, self.topology, self.fabric, cfg)
+            po.start()
+            lb = ServeBalancer(po, cfg, replicas=replicas, seed=seed)
+            self._serve_clients.append((lb, po))
+        return lb
+
     def serve_client(self, replica_rank: int = 0) -> "ReplicaClient":
         """An out-of-plan read client against one replica (the wire
         path an inference frontend uses).  Heartbeats off — a passive
@@ -629,6 +662,8 @@ class Simulation:
             self.recovery_monitor.stop()
         if self.replica_monitor is not None:
             self.replica_monitor.stop()
+        if self.replica_autoscaler is not None:
+            self.replica_autoscaler.stop()
         for client, po in self._serve_clients:
             client.stop()
             po.stop()

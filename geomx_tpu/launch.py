@@ -159,6 +159,7 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
 
         po.replica_monitor = ReplicaMonitor(po)
         role_obj = role_obj or po.replica_monitor
+    po.replica_autoscaler = None
     if node.role is Role.GLOBAL_SCHEDULER and config.enable_obs:
         # cluster telemetry plane (geomx_tpu/obs): the metrics collector
         # + SLO health engine live here, registered BEFORE po.start so
@@ -172,6 +173,18 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
     else:
         po.metrics_collector = None
         po.health = None
+    if (node.role is Role.GLOBAL_SCHEDULER and config.serve_autoscale
+            and config.topology.num_replicas):
+        # elastic serve capacity (geomx_tpu/serve/autoscaler): reads
+        # the telemetry collector's per-replica series, retires /
+        # reactivates replicas over the wire with hysteresis.  No
+        # spawn hook here — an OS deployment's process manager starts
+        # cold replicas; reactivation covers the retired-but-live ones
+        from geomx_tpu.serve import ReplicaAutoscaler
+
+        po.replica_autoscaler = ReplicaAutoscaler(
+            po, config, collector=po.metrics_collector)
+        role_obj = role_obj or po.replica_autoscaler
     if node.role is Role.GLOBAL_SCHEDULER and config.adaptive_wan:
         # closed-loop WAN codec autotuning (geomx_tpu/control): the
         # controller samples server stats + the trace report and

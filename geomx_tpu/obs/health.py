@@ -42,6 +42,16 @@ Rules (thresholds are ``Config.obs_*`` knobs):
   ``obs_churn_storm`` within the window, or the orchestrator's
   survivor gauge reaches its min-survivor floor (the next departure
   stalls training; docs/deployment.md "Elasticity & preemption").
+- **serve_overload** — a serve replica's admission-control shed rate
+  (explicit RETRY_AFTER refusals, geomx_tpu/serve) is sustained above
+  ``obs_shed_rate`` per second over the collector window: the tier is
+  degrading by design, but it needs capacity (docs/serving.md
+  "Serving plane").
+- **replica_flap** — the replica autoscaler counted direction
+  reversals inside its cooldown (``autoscale_flaps``) past
+  ``obs_replica_flap`` within the window: the scaling signals are
+  oscillating faster than the hysteresis can follow — widen the
+  deadband or lengthen the cooldown.
 """
 
 from __future__ import annotations
@@ -65,7 +75,8 @@ _FENCE_KEYS = ("eviction_fenced_pushes", "fenced_rejects",
 
 RULES = ("round_stall", "replication_lag", "shard_imbalance",
          "goodput_collapse", "rtt_outlier", "fence_spike",
-         "replica_staleness", "churn_storm")
+         "replica_staleness", "churn_storm", "serve_overload",
+         "replica_flap")
 
 # membership-transition counters summed by the churn_storm rule: the
 # churn orchestrator's injected-event family (registered on the global
@@ -73,6 +84,7 @@ RULES = ("round_stall", "replication_lag", "shard_imbalance",
 # so a storm pages whether it was scripted or real
 _CHURN_KEYS = ("churn_notices", "churn_graceful_leaves",
                "churn_ungraceful_kills", "churn_joins",
+               "churn_replica_kills",
                "left_workers", "evicted_workers", "joined_workers")
 
 
@@ -108,6 +120,8 @@ class HealthEngine:
         self.fence_spike = int(getattr(cfg, "obs_fence_spike", 8))
         self.imbalance_factor = float(
             getattr(cfg, "obs_imbalance_factor", 4.0))
+        self.shed_rate = float(getattr(cfg, "obs_shed_rate", 2.0))
+        self.replica_flap = int(getattr(cfg, "obs_replica_flap", 2))
         self.alert_log = str(getattr(cfg, "obs_alert_log", "") or "")
         self._mu = threading.Lock()
         self.active: Dict[Tuple[str, str], dict] = {}
@@ -162,7 +176,8 @@ class HealthEngine:
         for rule in (self._rule_round_stall, self._rule_replication_lag,
                      self._rule_shard_imbalance, self._rule_goodput_collapse,
                      self._rule_rtt_outlier, self._rule_fence_spike,
-                     self._rule_replica_staleness, self._rule_churn_storm):
+                     self._rule_replica_staleness, self._rule_churn_storm,
+                     self._rule_serve_overload, self._rule_replica_flap):
             try:
                 records.extend(rule(now))
             except Exception:  # one broken rule must not mute the rest
@@ -477,6 +492,56 @@ class HealthEngine:
             if rec:
                 out.append(rec)
         return out
+
+    def _rule_serve_overload(self, now: float) -> List[dict]:
+        """A sustained admission-control shed rate is the serving
+        plane's capacity alarm: the replica is protecting its latency
+        by refusing reads (the intended degradation), but the refusals
+        are landing on real clients — add capacity or raise the
+        budget (docs/serving.md)."""
+        out = []
+        for node in self.collector.nodes():
+            if not node.startswith("replica:"):
+                continue
+            rate = self.collector.rate(node, "serve_sheds")
+            if rate is None:
+                continue
+            rec = self._set_state(
+                "serve_overload", node, rate > self.shed_rate, now,
+                message=(f"shedding {rate:.1f} reads/s with RETRY_AFTER "
+                         f"(threshold {self.shed_rate:.1f}/s)"
+                         if rate > self.shed_rate else
+                         f"shed rate {rate:.1f}/s, back under the "
+                         f"threshold ({self.shed_rate:.1f}/s)"),
+                shed_rate=round(float(rate), 3),
+                threshold=self.shed_rate)
+            if rec:
+                out.append(rec)
+        return out
+
+    def _rule_replica_flap(self, now: float) -> List[dict]:
+        """Autoscaler direction reversals inside cooldown
+        (``autoscale_flaps``, shipped by the global scheduler's own
+        pump): the scaling signals oscillate faster than the
+        hysteresis can follow — the actuated sequence stays stable
+        (cooldown blocks the reversal), but the operator should widen
+        the deadband or lengthen the cooldown."""
+        total = 0.0
+        seen = False
+        for node in self.collector.nodes():
+            pts = self.collector.series(node, "autoscale_flaps")
+            if len(pts) >= 2:
+                seen = True
+                total += pts[-1][1] - pts[0][1]
+        if not seen:
+            return []
+        rec = self._set_state(
+            "replica_flap", "autoscaler",
+            total >= self.replica_flap, now,
+            message=f"{total:.0f} suppressed direction reversals in "
+                    f"the window (threshold {self.replica_flap})",
+            reversals=total, threshold=self.replica_flap)
+        return [rec] if rec else []
 
     def _rule_replica_staleness(self, now: float) -> List[dict]:
         out = []

@@ -218,12 +218,17 @@ class ClusterStateService:
                                 "serve_predicts", "staleness_violations",
                                 "stale_rejects", "replica_refreshes",
                                 "rounds_at_refresh", "keys",
-                                "serve_p50_ms", "serve_p99_ms"):
+                                "serve_p50_ms", "serve_p99_ms",
+                                "serve_sheds", "inflight",
+                                "max_inflight", "retired"):
                         if st.get(key) is not None:
                             entry[key] = st[key]
                     qps = self.collector.rate(s, "serve_pulls")
                     if qps is not None:
                         entry["serve_qps"] = round(qps, 2)
+                    shed = self.collector.rate(s, "serve_sheds")
+                    if shed is not None:
+                        entry["shed_rate"] = round(shed, 2)
                     if (cur_rounds is not None
                             and isinstance(st.get("rounds_at_refresh"),
                                            (int, float))):
@@ -372,9 +377,19 @@ def render_text(state: dict) -> str:
                 extra += f" qps={e['serve_qps']:.1f}"
             if e.get("serve_pulls") is not None:
                 extra += f" pulls={int(e['serve_pulls'])}"
+            if e.get("shed_rate") is not None:
+                extra += f" shed_rate={e['shed_rate']:.1f}/s"
+            elif e.get("serve_sheds"):
+                extra += f" sheds={int(e['serve_sheds'])}"
+            if e.get("inflight") is not None:
+                extra += f" inflight={int(e['inflight'])}"
+                if e.get("max_inflight"):
+                    extra += f"/{int(e['max_inflight'])}"
             if e.get("staleness_violations"):
                 extra += (f" violations="
                           f"{int(e['staleness_violations'])}")
+            if e.get("retired"):
+                extra += " RETIRED"
             lines.append(f"  replica {r}: {e.get('node')} "
                          f"[{_alive_tag(e.get('alive'))}]{extra}")
     pol = state.get("policy")

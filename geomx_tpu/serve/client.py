@@ -24,6 +24,28 @@ from geomx_tpu.ps.kv_app import _App
 from geomx_tpu.transport.message import Domain, Message
 
 
+class ReplicaError(RuntimeError):
+    """A replica answered with an error body.  ``body`` keeps the
+    STRUCTURED response — the admission-control shed fields
+    (``shed``/``retry_after_s``/``inflight``/``retired``) the balancer
+    needs to deprioritize the replica and retry elsewhere, which the
+    flattened message string cannot carry."""
+
+    def __init__(self, message: str, body: Optional[dict] = None):
+        super().__init__(message)
+        self.body = dict(body or {})
+
+    @property
+    def shed(self) -> bool:
+        """True for an explicit admission-control refusal (the replica
+        is overloaded or retiring, not broken)."""
+        return bool(self.body.get("shed"))
+
+    @property
+    def retry_after_s(self) -> float:
+        return float(self.body.get("retry_after_s", 0.0) or 0.0)
+
+
 class ReplicaClient(_App):
     """One query endpoint toward one serve replica."""
 
@@ -95,7 +117,7 @@ class ReplicaClient(_App):
             msg = self._replies.pop(ts)
         body = msg.body if isinstance(msg.body, dict) else {}
         if "error" in body:
-            raise RuntimeError(body["error"])
+            raise ReplicaError(str(body["error"]), body=body)
         return msg
 
     # ---- public API ----------------------------------------------------------

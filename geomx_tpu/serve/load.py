@@ -45,12 +45,21 @@ def main(argv=None) -> int:
         description="read-load driver for the serve replica tier")
     ap.add_argument("--replica", type=int, default=0,
                     help="target replica rank")
+    ap.add_argument("--balance", action="store_true",
+                    help="read through the ServeBalancer across ALL "
+                         "replicas (p2c + health ejection + shed "
+                         "honoring) instead of pinning --replica; "
+                         "prints an extra serve_lb summary line")
     ap.add_argument("--seconds", type=float, default=5.0)
     ap.add_argument("--timeout", type=float, default=5.0,
                     help="per-read timeout")
     ap.add_argument("--assert-staleness", action="store_true",
                     help="exit 1 if any successful read exceeded the "
                          "GEOMX_SERVE_STALENESS_S bound")
+    ap.add_argument("--max-shed-frac", type=float, default=-1.0,
+                    help="with --balance: exit 1 if more than this "
+                         "fraction of reads were shed (the bounded-"
+                         "shedding assertion; <0 = no assertion)")
     ap.add_argument("--parties", type=int,
                     default=int(os.environ.get("GEOMX_NUM_PARTIES", "1")))
     ap.add_argument("--workers", type=int,
@@ -88,11 +97,24 @@ def main(argv=None) -> int:
     fabric = TcpFabric(plan, config=cfg)
     po = Postoffice(me, cfg.topology, fabric, cfg)
     po.start()
-    client = ReplicaClient(po, cfg, replica=args.replica,
-                           advertise=("127.0.0.1", port))
+    lb = None
+    if args.balance:
+        from geomx_tpu.serve.balancer import ServeBalancer
+
+        if cfg.topology.num_replicas < 1:
+            print("serve_load: FAIL --balance needs --replicas >= 1",
+                  flush=True)
+            return 1
+        lb = ServeBalancer(po, cfg, advertise=("127.0.0.1", port))
+        client = lb  # same pull/list_keys surface
+        who = f"balance={cfg.topology.num_replicas}-replicas"
+    else:
+        client = ReplicaClient(po, cfg, replica=args.replica,
+                               advertise=("127.0.0.1", port))
+        who = f"replica=replica:{args.replica}"
     bound = float(os.environ.get("GEOMX_SERVE_STALENESS_S",
                                  cfg.serve_staleness_s))
-    pulls = errors = 0
+    pulls = errors = sheds = 0
     lat_ms, staleness = [], []
     try:
         # bootstrap: wait for the replica to hold keys (training INITs
@@ -108,9 +130,8 @@ def main(argv=None) -> int:
                 break
             time.sleep(0.3)
         if not keys:
-            print(f"serve_load: replica=replica:{args.replica} "
-                  "FAIL no-keys (replica unreachable or model "
-                  "uninitialized)", flush=True)
+            print(f"serve_load: {who} FAIL no-keys (replica "
+                  "unreachable or model uninitialized)", flush=True)
             return 1
         t_end = time.monotonic() + args.seconds
         i = 0
@@ -128,18 +149,29 @@ def main(argv=None) -> int:
             if isinstance(s, (int, float)):
                 staleness.append(float(s))
             pulls += 1
+        if lb is not None:
+            sheds = lb.stats()["sheds"]
     finally:
-        client.stop()
+        if lb is not None:
+            lb.stop()
+        else:
+            client.stop()
         po.stop()
         fabric.shutdown()
     dur = max(args.seconds, 1e-9)
     max_stale = max(staleness) if staleness else float("nan")
-    print(f"serve_load: replica=replica:{args.replica} pulls={pulls} "
+    print(f"serve_load: {who} pulls={pulls} "
           f"qps={pulls / dur:.1f} "
           f"p50_ms={_percentile(lat_ms, 0.5):.1f} "
           f"p99_ms={_percentile(lat_ms, 0.99):.1f} "
           f"max_staleness_s={max_stale:.2f} errors={errors}",
           flush=True)
+    if lb is not None:
+        st = lb.stats()
+        print(f"serve_lb: failovers={st['failovers']} "
+              f"sheds={st['sheds']} ejections={st['ejections']} "
+              f"probes={st['probes']} recoveries={st['recoveries']}",
+              flush=True)
     if pulls == 0:
         print("serve_load: FAIL no successful reads", flush=True)
         return 1
@@ -147,6 +179,13 @@ def main(argv=None) -> int:
         print(f"serve_load: FAIL staleness bound violated "
               f"({max_stale:.2f}s > {bound:.2f}s)", flush=True)
         return 1
+    if lb is not None and args.max_shed_frac >= 0:
+        frac = sheds / max(pulls + sheds, 1)
+        if frac > args.max_shed_frac:
+            print(f"serve_load: FAIL shed fraction {frac:.2f} > "
+                  f"{args.max_shed_frac:.2f} (sheds unbounded)",
+                  flush=True)
+            return 1
     return 0
 
 

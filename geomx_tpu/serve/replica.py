@@ -101,6 +101,13 @@ class ModelReplica:
         self.staleness_violations = 0  # reads that arrived while the
         #                                copy was stale (parked, never
         #                                served stale)
+        self.serve_sheds = 0           # admission-control refusals
+        #                                (explicit RETRY_AFTER errors —
+        #                                the shed is the feature, not
+        #                                the failure)
+        self.predict_batches = 0       # aggregated PREDICT executions
+        self.batched_predicts = 0      # requests that rode a batch
+        self.retires = 0               # SERVE_SCALE deactivations
         self.stale_rejects = 0         # parked reads that expired
         self.stale_pull_skips = 0      # out-of-order refresh responses
         self.dense_resyncs = 0         # forced dense ("f32") adoptions
@@ -114,6 +121,27 @@ class ModelReplica:
         self._refresh_counter = system_counter(f"{n}.replica_refreshes")
         self._staleness_gauge = system_gauge(f"{n}.staleness_s")
         self._rounds_gauge = system_gauge(f"{n}.rounds_at_refresh")
+        self._shed_counter = system_counter(f"{n}.serve_sheds")
+        self._inflight_gauge = system_gauge(f"{n}.serve_inflight")
+        # admission control (ISSUE 15): a bounded pending-read budget.
+        # Past it, SERVE_PULL/PREDICT answer an explicit RETRY_AFTER
+        # shed error (suggested backoff + current depth) instead of
+        # queueing unboundedly — the balancer deprioritizes this
+        # replica and retries elsewhere.  0 = OFF, bit-for-bit the
+        # legacy always-queue path.
+        self.max_inflight = int(self.config.serve_max_inflight)
+        self.retry_after_s = float(self.config.serve_retry_after_s)
+        self._admitted = 0  # reads accepted but not yet answered
+        # SERVE_SCALE retirement: a retired replica sheds every read
+        # (RETRY_AFTER + retired flag) and pauses its refresh loop —
+        # the autoscaler's reversible scale-down actuation
+        self._retired = False
+        # batched PREDICT: aggregate compatible forward passes up to a
+        # size/latency budget so goodput rises before shedding starts
+        self.batch_max = int(self.config.serve_batch_max)
+        self.batch_wait_s = float(self.config.serve_batch_wait_ms) / 1e3
+        self._batch: List[tuple] = []  # (msg, t0, enqueued_monotonic)
+        self._batch_cv = threading.Condition(self._mu)
         # subscription up-link toward the global shards — the same
         # worker shape as a local server's, so NEW_PRIMARY retargeting
         # and un-ACKed replay apply verbatim
@@ -134,6 +162,12 @@ class ModelReplica:
                 target=self._loop, daemon=True,
                 name=f"replica-refresh-{postoffice.node}")
             self._thread.start()
+        self._batch_thread = None
+        if self.batch_max > 1:
+            self._batch_thread = threading.Thread(
+                target=self._batch_loop, daemon=True,
+                name=f"replica-batch-{postoffice.node}")
+            self._batch_thread.start()
 
     # ---- failover retarget ---------------------------------------------------
     def _on_new_primary(self, msg: Message) -> bool:
@@ -167,6 +201,9 @@ class ModelReplica:
             self._wake.clear()
             if self._stop.is_set():
                 return
+            if self._retired:
+                continue  # scaled down: no refresh traffic, no parked
+                #           reads (retirement shed them all)
             try:
                 self.refresh()
             except Exception:  # a cycle error must not kill the loop
@@ -183,7 +220,7 @@ class ModelReplica:
         when the cycle completed (the copy is fresh NOW).  Reentrant
         calls coalesce (one cycle in flight)."""
         with self._mu:
-            if self._refresh_busy:
+            if self._refresh_busy or self._retired:
                 return False
             self._refresh_busy = True
         try:
@@ -347,6 +384,7 @@ class ModelReplica:
             self._parked = keep
         for msg, _deadline, _t0 in expired:
             self.stale_rejects += 1
+            self._release()
             self.server.response(msg, body={
                 "error": f"replica {self.po.node} stale beyond the "
                          f"{self.staleness_s:.2f}s bound and the global "
@@ -391,11 +429,66 @@ class ModelReplica:
                 "error": f"{self.po.node} is a read-serving replica; "
                          "pushes go to the training tiers"})
 
+    def inflight(self) -> int:
+        """Current pending-read depth: reads admitted but not yet
+        answered (in-hand + parked + batched) plus the customer-queue
+        backlog the handler hasn't reached yet — the number the
+        admission budget judges and the shed errors report."""
+        c = self.server.customer
+        with self._mu:
+            d = self._admitted
+        for q in (getattr(c, "_q", None), getattr(c, "_pull_q", None)):
+            if q is not None:
+                d += q.qsize()
+        for ch in (getattr(c, "_chan", None),
+                   getattr(c, "_pull_chan", None)):
+            if ch is not None:
+                d += ch.qsize()
+        return d
+
+    def _release(self):
+        with self._mu:
+            self._admitted = max(0, self._admitted - 1)
+
+    def _shed(self, msg: Message, reason: str, depth=None):
+        """Admission control's explicit refusal: an error body carrying
+        the RETRY_AFTER backoff (+ current depth) so the client retries
+        ELSEWHERE with discipline instead of timing out here — degrade
+        by refusing work with a retry signal, never by missing every
+        deadline."""
+        self.serve_sheds += 1
+        self._shed_counter.inc()
+        retry = self.retry_after_s
+        body = {"shed": True, "retry_after_s": retry}
+        if reason == "retiring":
+            body["retired"] = True
+            body["error"] = (f"replica {self.po.node} retired by the "
+                             f"autoscaler — RETRY_AFTER {retry:.3f}s "
+                             "on another replica")
+        else:
+            body["inflight"] = int(depth or 0)
+            body["error"] = (f"replica {self.po.node} overloaded "
+                             f"(inflight {depth} >= budget "
+                             f"{self.max_inflight}) — RETRY_AFTER "
+                             f"{retry:.3f}s")
+        self.server.response(msg, body=body)
+
     def _gate(self, msg: Message):
-        """THE staleness bound: serve fresh now, or park until a refresh
-        lands — a read is never answered from a copy older than the
-        bound."""
+        """Admission first, then THE staleness bound: serve fresh now,
+        or park until a refresh lands — a read is never answered from a
+        copy older than the bound, and never queued past the admission
+        budget (it is shed with an explicit RETRY_AFTER instead)."""
         t0 = time.perf_counter()
+        if self._retired:
+            self._shed(msg, "retiring")
+            return
+        if self.max_inflight > 0:
+            depth = self.inflight()
+            if depth >= self.max_inflight:
+                self._shed(msg, "overloaded", depth=depth)
+                return
+        with self._mu:
+            self._admitted += 1
         if self.staleness() <= self.staleness_s:
             self._dispatch_fresh(msg, t0)
             return
@@ -409,6 +502,7 @@ class ModelReplica:
             else:
                 overflow = True
         if overflow:
+            self._release()
             self.server.response(msg, body={
                 "error": f"replica {self.po.node} overloaded while "
                          "stale (parked-read queue full)"})
@@ -416,9 +510,149 @@ class ModelReplica:
 
     def _dispatch_fresh(self, msg: Message, t0: float):
         if msg.cmd == Cmd.PREDICT:
-            self._respond_predict(msg, t0)
+            if self._batch_thread is not None:
+                self._enqueue_predict(msg, t0)
+            else:
+                self._respond_predict(msg, t0)
         else:
             self._respond_read(msg, t0)
+
+    # ---- batched PREDICT -----------------------------------------------------
+    def _enqueue_predict(self, msg: Message, t0: float):
+        with self._batch_cv:
+            self._batch.append((msg, t0, time.monotonic()))
+            self._batch_cv.notify()
+
+    def _batch_loop(self):
+        """Aggregate compatible PREDICTs up to ``serve_batch_max``
+        requests or ``serve_batch_wait_ms`` of waiting, whichever comes
+        first — N queued inferences cost one matmul chain, so goodput
+        rises before the admission budget starts shedding."""
+        while not self._stop.is_set():
+            with self._batch_cv:
+                while not self._batch and not self._stop.is_set():
+                    self._batch_cv.wait(0.25)
+                if self._stop.is_set():
+                    return
+                deadline = self._batch[0][2] + self.batch_wait_s
+                while (len(self._batch) < self.batch_max
+                       and not self._stop.is_set()):
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._batch_cv.wait(left)
+                batch = self._batch[:self.batch_max]
+                del self._batch[:self.batch_max]
+            if batch:
+                try:
+                    self._run_batch(batch)
+                except Exception:  # one bad batch must not kill serving
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "%s: predict batch failed", self.po.node)
+
+    @staticmethod
+    def _predict_sig(msg: Message):
+        body = msg.body if isinstance(msg.body, dict) else {}
+        layers = body.get("layers") or []
+        try:
+            sig = tuple(
+                (int(ly["key"]), int(ly["rows"]), int(ly["cols"]),
+                 None if ly.get("bias") is None else int(ly["bias"]))
+                for ly in layers)
+        except (KeyError, TypeError, ValueError):
+            return None
+        return (sig, bool(body.get("relu", True)))
+
+    def _run_batch(self, batch):
+        groups: Dict[object, list] = {}
+        for msg, t0, _ts in batch:
+            sig = self._predict_sig(msg)
+            groups.setdefault(sig, []).append((msg, t0))
+        for sig, items in groups.items():
+            if sig is None or len(items) == 1:
+                for msg, t0 in items:
+                    self._respond_predict(msg, t0)
+                continue
+            self._respond_predict_batch(items)
+
+    def _respond_predict_batch(self, items):
+        """One forward pass for N compatible requests: inputs stack
+        along the batch axis, outputs split back per request."""
+        xs, rows, live = [], [], []
+        for msg, t0 in items:
+            body = msg.body if isinstance(msg.body, dict) else {}
+            b = int(body.get("batch", 1))
+            x = (None if msg.vals is None
+                 else np.ascontiguousarray(msg.vals, dtype=np.float32))
+            try:
+                x = x.reshape(b, -1) if x is not None else None
+            except ValueError:
+                x = None
+            if x is None:
+                self._release()
+                self.server.response(msg, body={
+                    "error": "predict needs an input payload tiling "
+                             "body['batch']"})
+                continue
+            xs.append(x)
+            rows.append(b)
+            live.append((msg, t0))
+        if not live:
+            return
+        if len({x.shape[1] for x in xs}) != 1:
+            # same layer chain but mismatched input widths: one of them
+            # is malformed — fall back to per-request handling, which
+            # produces the precise per-request error
+            for msg, t0 in live:
+                self._respond_predict(msg, t0)
+            return
+        body0 = live[0][0].body
+        layers = body0.get("layers") or []
+        relu = bool(body0.get("relu", True))
+        mats = []
+        with self._mu:
+            for ly in layers:
+                k = int(ly["key"])
+                w = self.store.get(k)
+                r, c = int(ly["rows"]), int(ly["cols"])
+                if w is None or len(w) != r * c:
+                    err = {"error": f"{self.po.node}: layer key {k} "
+                                    "missing or wrong size"}
+                    for msg, _t0 in live:
+                        self._release()
+                        self.server.response(msg, body=err)
+                    return
+                b = (self.store.get(int(ly["bias"]))
+                     if ly.get("bias") is not None else None)
+                mats.append((w.reshape(r, c), b))
+            meta = self._meta_locked()
+        h = np.concatenate(xs, axis=0)
+        for i, (w, b) in enumerate(mats):
+            h = h @ w
+            if b is not None:
+                h = h + b
+            if relu and i < len(mats) - 1:
+                np.maximum(h, 0.0, out=h)
+        h = np.ascontiguousarray(h, dtype=np.float32)
+        self.predict_batches += 1
+        self.batched_predicts += len(live)
+        off = 0
+        for (msg, t0), n in zip(live, rows):
+            part = h[off:off + n]
+            off += n
+            flat = part.ravel()
+            m = dict(meta)
+            m["shape"] = [int(d) for d in part.shape]
+            m["batched"] = len(live)
+            self.serve_predicts += 1
+            self._predict_counter.inc()
+            self._release()
+            self.server.response(msg, KVPairs(
+                np.array([0], dtype=np.int64), flat,
+                np.array([len(flat)], dtype=np.int64)), body=m)
+            self._lat.append(time.perf_counter() - t0)
 
     def _meta_locked(self) -> dict:
         return {
@@ -433,6 +667,7 @@ class ModelReplica:
         with self._mu:
             missing = [k for k in ks if k not in self.store]
             if missing:
+                self._release()
                 self.server.response(msg, body={
                     "error": f"{self.po.node} does not hold key(s) "
                              f"{missing[:4]} (model not initialized, or "
@@ -461,6 +696,7 @@ class ModelReplica:
             meta = self._meta_locked()
         self.serve_pulls += 1
         self._pulls_counter.inc()
+        self._release()
         self.server.response(msg, KVPairs(
             np.array(ks, dtype=np.int64), payload,
             np.array(ls, dtype=np.int64)), body=meta)
@@ -472,6 +708,7 @@ class ModelReplica:
         relu = bool(body.get("relu", True))
         batch = int(body.get("batch", 1))
         if msg.vals is None or not layers:
+            self._release()
             self.server.response(msg, body={
                 "error": "predict needs an input payload and a "
                          "non-empty body['layers'] spec"})
@@ -480,6 +717,7 @@ class ModelReplica:
         try:
             x = x.reshape(batch, -1)
         except ValueError:
+            self._release()
             self.server.response(msg, body={
                 "error": f"input of {x.size} elements does not tile "
                          f"batch={batch}"})
@@ -491,6 +729,7 @@ class ModelReplica:
                 rows, cols = int(ly["rows"]), int(ly["cols"])
                 w = self.store.get(k)
                 if w is None or len(w) != rows * cols:
+                    self._release()
                     self.server.response(msg, body={
                         "error": f"{self.po.node}: layer key {k} "
                                  f"missing or wrong size "
@@ -515,12 +754,41 @@ class ModelReplica:
         self.serve_predicts += 1
         self._predict_counter.inc()
         meta["shape"] = [int(d) for d in h.shape]
+        self._release()
         self.server.response(msg, KVPairs(
             np.array([0], dtype=np.int64), flat,
             np.array([len(flat)], dtype=np.int64)), body=meta)
         self._lat.append(time.perf_counter() - t0)
 
     # ---- control -------------------------------------------------------------
+    def set_active(self, active: bool):
+        """SERVE_SCALE actuation (reversible scale-down): retiring
+        sheds every parked read with the RETRY_AFTER signal and pauses
+        the refresh loop; reactivating wakes an immediate refresh —
+        after the autoscaler's subscriber prune, that refresh resyncs
+        dense, exactly the eviction→rejoin semantics."""
+        new_retired = not bool(active)
+        parked = []
+        with self._mu:
+            changed = self._retired != new_retired
+            self._retired = new_retired
+            if changed and new_retired:
+                parked, self._parked = self._parked, []
+        if not changed:
+            return
+        if not active:
+            self.retires += 1
+            for pmsg, _dl, _t0 in parked:
+                self._release()
+                self._shed(pmsg, "retiring")
+            print(f"{self.po.node}: retired (SERVE_SCALE) — reads shed "
+                  "with RETRY_AFTER until reactivation", flush=True)
+        else:
+            self._wake.set()  # refresh NOW: a pruned subscription heals
+            #                   through the dense-resync handshake
+            print(f"{self.po.node}: reactivated (SERVE_SCALE) — "
+                  "refreshing and serving again", flush=True)
+
     def _on_cmd(self, msg: Message):
         self._maybe_add_addr(msg)
         if msg.cmd == Ctrl.QUERY_STATS:
@@ -531,6 +799,12 @@ class ModelReplica:
             with self._mu:
                 ks = sorted(int(k) for k in self.store)
             self.server.reply_cmd(msg, body={"keys": ks})
+        elif msg.cmd == Ctrl.SERVE_SCALE:
+            b = msg.body if isinstance(msg.body, dict) else {}
+            active = bool(b.get("active", True))
+            self.set_active(active)
+            self.server.reply_cmd(msg, body={"ok": True,
+                                             "active": active})
         else:
             self.server.reply_cmd(msg)
 
@@ -543,14 +817,24 @@ class ModelReplica:
         if stale != float("inf"):
             self._staleness_gauge.set(stale)
         lat_ms = [v * 1e3 for v in list(self._lat)]
+        inflight = self.inflight()
+        self._inflight_gauge.set(float(inflight))
         with self._mu:
             store_b = sum(a.nbytes for a in self.store.values())
             nkeys = len(self.store)
             parked = len(self._parked)
+            retired = self._retired
         out = {
             "serve_pulls": self.serve_pulls,
             "serve_predicts": self.serve_predicts,
             "staleness_violations": self.staleness_violations,
+            "serve_sheds": self.serve_sheds,
+            "inflight": inflight,
+            "max_inflight": self.max_inflight,
+            "retired": retired,
+            "predict_batches": self.predict_batches,
+            "batched_predicts": self.batched_predicts,
+            "retires": self.retires,
             "stale_rejects": self.stale_rejects,
             "stale_pull_skips": self.stale_pull_skips,
             "dense_resyncs": self.dense_resyncs,
@@ -574,5 +858,7 @@ class ModelReplica:
     def stop(self):
         self._stop.set()
         self._wake.set()
+        with self._batch_cv:
+            self._batch_cv.notify_all()
         self.server.stop()
         self.up.stop()

@@ -30,4 +30,5 @@ exec python -m pytest -q -m 'not slow' -p no:cacheprovider \
   tests/test_wire_v2.py tests/test_ps.py tests/test_kvstore.py \
   tests/test_failover.py tests/test_eviction.py tests/test_churn.py \
   tests/test_sharded_global.py tests/test_recovery.py \
+  tests/test_serve.py tests/test_serve_plane.py \
   ${PYTEST_ARGS:-}

@@ -16,7 +16,13 @@
 # this script is the operator-facing replica-churn tour.
 # See docs/serving.md.
 #
-# Env: GEOMX_BASE_PORT (default 9560), STEPS (default 600)
+# A fourth phase (ISSUE 15, the serving plane) drives BALANCED reads
+# (`serve.load --balance`: p2c over both replicas, health ejection,
+# shed honoring) and SIGKILLs replica 1 mid-load: the balancer must
+# fail over within the staleness bound (failovers >= 1, reads stay
+# staleness-asserted) and the shed fraction must stay bounded.
+#
+# Env: GEOMX_BASE_PORT (default 9560), STEPS (default 900)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -37,7 +43,7 @@ export GEOMX_TEST_STEP_SLEEP_MS='{"worker:0@p0": 40}'
 
 BASE=${GEOMX_BASE_PORT:-9560}
 export GEOMX_BASE_PORT=$BASE
-STEPS=${STEPS:-600}
+STEPS=${STEPS:-900}
 OUT=$(mktemp -d)
 trap 'kill $(jobs -p) 2>/dev/null || true; rm -rf "$OUT"' EXIT
 
@@ -56,6 +62,7 @@ launch server:0@p0
 launch replica:0
 REPLICA0_PID=$!
 launch replica:1
+REPLICA1_PID=$!
 launch worker:0@p0
 WORKER_PID=$!
 
@@ -122,7 +129,22 @@ python -m geomx_tpu.serve.load --replica 0 --seconds 2 --assert-staleness \
   >"$OUT/load0_after.txt" || { echo "FAIL: rejoined replica 0 load"; cat "$OUT/load0_after.txt"; exit 1; }
 cat "$OUT/load0_after.txt"
 
+echo "== serving-plane churn: balanced reads fail over a SIGKILL =="
+# replica 0 is back, replica 1 about to die: the balancer must absorb
+# the kill with ONE bounded failed attempt, keep every successful read
+# under the staleness bound, and keep sheds explicit and bounded
+( sleep 1.5; kill -9 "$REPLICA1_PID" 2>/dev/null || true ) &
+KILLER_PID=$!
+python -m geomx_tpu.serve.load --balance --seconds 5 --assert-staleness \
+  --max-shed-frac 0.5 >"$OUT/load_balance.txt" \
+  || { echo "FAIL: balanced load under replica churn"; cat "$OUT/load_balance.txt"; exit 1; }
+wait "$KILLER_PID" 2>/dev/null || true
+cat "$OUT/load_balance.txt"
+FAILOVERS=$(sed -n 's/.*failovers=\([0-9][0-9]*\).*/\1/p' "$OUT/load_balance.txt")
+[ "${FAILOVERS:-0}" -ge 1 ] \
+  || { echo "FAIL: balancer never failed over after the SIGKILL"; exit 1; }
+
 wait "$WORKER_PID" || true
 grep -q "steps=$STEPS" "$OUT/worker_0_p0.log" \
   || { echo "FAIL: training did not finish all steps"; exit 1; }
-echo "OK: survivor served within the bound through the kill, console + logs showed the eviction/rejoin pair, training completed"
+echo "OK: survivor served within the bound through the kill, console + logs showed the eviction/rejoin pair, the balancer failed over the SIGKILL with bounded sheds, training completed"

@@ -1,0 +1,518 @@
+"""Self-healing serving plane (ISSUE 15): liveness-aware load
+balancing, admission control with explicit load shedding, and replica
+autoscaling.
+
+Covers: p2c spread across replicas; fast failover off a dead target
+(the per-attempt timeout regression — a SIGKILLed replica costs one
+bounded failed read, not the caller's whole deadline); ejection after
+consecutive errors + half-open probe recovery; the cluster-state view
+skipping retired replicas; replica-side admission control (bounded
+inflight budget, shed errors carrying RETRY_AFTER + depth, the
+disabled path bit-for-bit legacy); shed → retry-elsewhere → success
+through the balancer; batched PREDICT aggregation; the autoscaler's
+hysteresis (scale-up under shedding, cooldown-suppressed reversals
+counted as flaps, scale-down needing double patience) and its wire
+retire/reactivate actuation; the serve_overload / replica_flap health
+rules; and the churn orchestrator's replica kill/restart events.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from geomx_tpu.core.config import Config, Topology
+from geomx_tpu.kvstore import Simulation
+from geomx_tpu.serve.client import ReplicaError
+
+
+def _cfg(replicas=2, parties=1, **kw):
+    kw.setdefault("serve_refresh_interval_s", 0.0)  # manual refresh()
+    kw.setdefault("serve_staleness_s", 5.0)
+    return Config(topology=Topology(num_parties=parties,
+                                    workers_per_party=1,
+                                    num_replicas=replicas), **kw)
+
+
+def _wait_for(pred, timeout=20.0, every=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+def _seed_model(sim, n=1000):
+    w = sim.worker(0, 0)
+    w.init(0, np.arange(n, dtype=np.float32))
+    for rep in sim.replicas:
+        assert rep.refresh()
+    return w
+
+
+def _pin_rng(lb):
+    """Make the balancer's p2c pick deterministic: candidates in rank
+    order, no jitter — the shed/failover tests need to know which
+    replica the first attempt lands on."""
+    lb._rng = SimpleNamespace(sample=lambda c, k: sorted(c)[:k],
+                              uniform=lambda a, b: 0.0,
+                              random=lambda: 0.0)
+
+
+# ---------------------------------------------------------------------------
+def test_balancer_p2c_spreads_load_across_replicas():
+    sim = Simulation(_cfg(replicas=2))
+    try:
+        _seed_model(sim)
+        lb = sim.serve_balancer(seed=7)
+        for _ in range(30):
+            arr, meta = lb.pull_tensor(0, 1000)
+            assert np.array_equal(arr, np.arange(1000, dtype=np.float32))
+            assert meta["replica"] in (0, 1)
+        st = lb.stats()
+        assert st["picks"] == 30 and st["failovers"] == 0
+        # p2c with equal scores still lands on both replicas
+        assert st["replicas"][0]["picks"] > 0
+        assert st["replicas"][1]["picks"] > 0
+    finally:
+        sim.shutdown()
+
+
+def test_balancer_fails_over_dead_replica_fast():
+    """The PR 8 regression: a read whose chosen replica is dead must
+    re-pick after ONE bounded attempt (serve_attempt_timeout_s), not
+    burn the caller's whole timeout on the corpse."""
+    sim = Simulation(_cfg(replicas=2, serve_attempt_timeout_s=0.5))
+    try:
+        _seed_model(sim)
+        lb = sim.serve_balancer(seed=3)
+        _pin_rng(lb)  # first pick = replica 0, deterministically
+        lb.pull_tensor(0, 1000)
+        sim.kill_replica(0)
+        t0 = time.monotonic()
+        arr, meta = lb.pull_tensor(0, 1000, timeout=10.0)
+        dt = time.monotonic() - t0
+        assert np.array_equal(arr, np.arange(1000, dtype=np.float32))
+        assert meta["replica"] == 1
+        # one failed 0.5s attempt + the live read — far under the 10s
+        # deadline the old single-target client would have burned
+        assert dt < 3.0, dt
+        assert lb.stats()["failovers"] >= 1
+    finally:
+        sim.shutdown()
+
+
+def test_balancer_ejects_dead_replica_and_half_open_recovers():
+    sim = Simulation(_cfg(replicas=2, serve_attempt_timeout_s=0.3,
+                          serve_eject_errors=2, serve_probe_s=0.4,
+                          serve_lb_refresh_s=3600.0))
+    try:
+        _seed_model(sim)
+        lb = sim.serve_balancer(seed=5)
+        _pin_rng(lb)
+        sim.kill_replica(0)
+        # reads keep succeeding; replica 0 accumulates failures until
+        # it is ejected from the candidate set
+        for _ in range(3):
+            _, meta = lb.pull_tensor(0, 1000, timeout=10.0)
+            assert meta["replica"] == 1
+        assert _wait_for(lambda: lb.stats()["replicas"][0]["ejected"],
+                         timeout=1.0)
+        assert lb.stats()["ejections"] >= 1
+        # while ejected (probe not due), picks never land on 0
+        picks0 = lb.stats()["replicas"][0]["picks"]
+        for _ in range(5):
+            lb.pull_tensor(0, 1000)
+        assert lb.stats()["replicas"][0]["picks"] == picks0
+        # revive replica 0; after serve_probe_s one half-open trial
+        # runs and restores it
+        rep2 = sim.restart_replica(0)
+        assert _wait_for(lambda: rep2.refresh(), timeout=10.0)
+        time.sleep(0.5)  # probe due
+        for _ in range(10):
+            lb.pull_tensor(0, 1000)
+        st = lb.stats()
+        assert st["probes"] >= 1 and st["recoveries"] >= 1
+        assert not st["replicas"][0]["ejected"]
+        assert st["replicas"][0]["picks"] > picks0
+    finally:
+        sim.shutdown()
+
+
+def test_balancer_view_skips_retired_replica():
+    """The cluster-state view (Ctrl.CLUSTER_STATE replica table) feeds
+    the candidate set: a RETIRED replica is skipped without burning a
+    probe on it."""
+    sim = Simulation(_cfg(replicas=2, enable_obs=True,
+                          obs_interval_s=0.0))
+    try:
+        _seed_model(sim)
+        sim.replicas[0].set_active(False)
+        sim.pump_metrics()
+        lb = sim.serve_balancer(seed=1)
+        assert lb.refresh_view()
+        assert lb.candidates() == [1]
+        for _ in range(5):
+            _, meta = lb.pull_tensor(0, 1000)
+            assert meta["replica"] == 1
+        assert lb.stats()["sheds"] == 0  # never even asked replica 0
+    finally:
+        sim.shutdown()
+
+
+# ---------------------------------------------------------------------------
+def test_admission_control_sheds_with_retry_after():
+    """Past the inflight budget, a read is refused with an explicit
+    RETRY_AFTER error carrying the suggested backoff and the current
+    depth — never queued unboundedly."""
+    sim = Simulation(_cfg(replicas=1, serve_max_inflight=2,
+                          serve_staleness_s=0.3,
+                          serve_retry_after_s=0.2))
+    try:
+        w = sim.worker(0, 0)
+        w.init(0, np.arange(256, dtype=np.float32))
+        rep = sim.replicas[0]
+        assert rep.refresh()
+        time.sleep(0.4)  # the copy ages past the bound: reads park
+        clients = [sim.serve_client(0) for _ in range(3)]
+        results = {}
+
+        def read(i):
+            try:
+                results[i] = clients[i].pull_tensor(0, 256, timeout=20.0)
+            except ReplicaError as e:
+                results[i] = e
+
+        threads = [threading.Thread(target=read, args=(i,), daemon=True)
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        assert _wait_for(lambda: len(rep._parked) == 2, timeout=5.0)
+        # budget full (2 parked reads admitted): the third is shed NOW
+        with pytest.raises(ReplicaError, match="RETRY_AFTER") as ei:
+            clients[2].pull_tensor(0, 256, timeout=20.0)
+        assert ei.value.shed
+        assert ei.value.retry_after_s == pytest.approx(0.2)
+        assert ei.value.body["inflight"] >= 2
+        assert rep.serve_sheds == 1
+        # the parked reads serve the moment a refresh lands
+        assert rep.refresh()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert all(not isinstance(results[i], Exception)
+                   for i in range(2))
+        assert rep.stats()["inflight"] == 0
+    finally:
+        sim.shutdown()
+
+
+def test_admission_disabled_path_is_legacy():
+    """serve_max_inflight == 0 (the default): no shed path, no batch
+    thread — overload behaves exactly like PR 8 (reads park)."""
+    sim = Simulation(_cfg(replicas=1, serve_staleness_s=0.3))
+    try:
+        w = sim.worker(0, 0)
+        w.init(0, np.arange(128, dtype=np.float32))
+        rep = sim.replicas[0]
+        assert rep.max_inflight == 0 and rep._batch_thread is None
+        assert rep.refresh()
+        time.sleep(0.4)
+        clients = [sim.serve_client(0) for _ in range(3)]
+        done = []
+
+        def read(i):
+            clients[i].pull_tensor(0, 128, timeout=20.0)
+            done.append(i)
+
+        threads = [threading.Thread(target=read, args=(i,), daemon=True)
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        assert _wait_for(lambda: len(rep._parked) == 3, timeout=5.0)
+        assert rep.serve_sheds == 0  # all three parked, none shed
+        assert rep.refresh()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert len(done) == 3
+    finally:
+        sim.shutdown()
+
+
+def test_shed_retries_elsewhere_and_succeeds():
+    """The client half of explicit load shedding: a shed answer
+    deprioritizes the replica for the suggested backoff and the read
+    lands elsewhere immediately."""
+    sim = Simulation(_cfg(replicas=2, serve_retry_after_s=0.3))
+    try:
+        _seed_model(sim)
+        rep0 = sim.replicas[0]
+        # force replica 0 over budget (white-box: budget 1, one
+        # admitted slot pinned) so every read it sees is shed
+        rep0.max_inflight = 1
+        with rep0._mu:
+            rep0._admitted = 1
+        lb = sim.serve_balancer(seed=2)
+        _pin_rng(lb)  # first attempt lands on replica 0
+        t0 = time.monotonic()
+        arr, meta = lb.pull_tensor(0, 1000, timeout=10.0)
+        dt = time.monotonic() - t0
+        assert meta["replica"] == 1
+        assert np.array_equal(arr, np.arange(1000, dtype=np.float32))
+        assert dt < 2.0, dt  # immediate retry elsewhere, no timeout
+        assert rep0.serve_sheds == 1
+        st = lb.stats()
+        assert st["sheds"] == 1
+        assert st["replicas"][0]["deprioritized"]
+        # within the backoff window the balancer avoids replica 0
+        _, meta = lb.pull_tensor(0, 1000)
+        assert meta["replica"] == 1
+        assert lb.stats()["sheds"] == 1  # no second shed burned
+    finally:
+        sim.shutdown()
+
+
+def test_batched_predict_aggregates_compatible_requests():
+    """Goodput before shedding: N compatible queued PREDICTs execute
+    as ONE forward pass and split back per request."""
+    sim = Simulation(_cfg(replicas=1, serve_batch_max=4,
+                          serve_batch_wait_ms=120.0))
+    try:
+        w = sim.worker(0, 0)
+        w.init(1, np.arange(32, dtype=np.float32) / 32.0)  # 8x4 layer
+        rep = sim.replicas[0]
+        assert rep._batch_thread is not None
+        assert rep.refresh()
+        W = np.arange(32, dtype=np.float32).reshape(8, 4) / 32.0
+        rng = np.random.default_rng(0)
+        xs = [rng.standard_normal((2, 8)).astype(np.float32)
+              for _ in range(4)]
+        clients = [sim.serve_client(0) for _ in range(4)]
+        out = {}
+
+        def ask(i):
+            out[i] = clients[i].predict(xs[i], [(1, (8, 4))],
+                                        timeout=15.0)
+
+        threads = [threading.Thread(target=ask, args=(i,), daemon=True)
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20.0)
+        assert len(out) == 4
+        for i in range(4):
+            y, meta = out[i]
+            assert y.shape == (2, 4)
+            assert np.allclose(y, xs[i] @ W, atol=1e-5)
+        # at least one aggregated execution happened (the 120ms window
+        # is far wider than the thread-start skew)
+        assert rep.predict_batches >= 1
+        assert rep.batched_predicts >= 2
+        assert rep.serve_predicts == 4
+    finally:
+        sim.shutdown()
+
+
+# ---------------------------------------------------------------------------
+def _ingest(mc, node, t, **stats):
+    mc.ingest({"node": node, "boot": 1, "t_mono": float(t),
+               "metrics": {}, "stats": stats})
+
+
+def test_autoscaler_hysteresis_up_flap_and_down():
+    """Scale-up after `patience` overloaded sweeps; a reversal inside
+    cooldown is counted as a flap but never executed; scale-down needs
+    2x patience.  Actuation is the wire retire/reactivate path."""
+    sim = Simulation(_cfg(replicas=3, enable_obs=True,
+                          obs_interval_s=0.0, serve_autoscale=True,
+                          serve_scale_cooldown_s=30.0,
+                          serve_scale_patience=1,
+                          serve_target_qps=100.0))
+    try:
+        _seed_model(sim)
+        asc = sim.replica_autoscaler
+        mc = sim.metrics_collector
+        assert asc is not None and asc.max_replicas == 3
+        # all three replicas visible to the liveness view
+        for r in range(3):
+            _ingest(mc, f"replica:{r}", 1.0, serve_pulls=0,
+                    serve_sheds=0)
+        # start from 2 active: retire rank 2 through the autoscaler's
+        # own actuator (wire SERVE_SCALE + subscriber prune)
+        rank, how = asc._scale_down([0, 1, 2])
+        assert (rank, how) == (2, "retire")
+        assert sim.replicas[2]._retired
+        with pytest.raises(ReplicaError, match="RETRY_AFTER"):
+            sim.serve_client(2).pull_tensor(0, 1000)
+        # overload signal: sheds climbing on the active replicas
+        for r in range(3):
+            _ingest(mc, f"replica:{r}", 2.0, serve_pulls=100,
+                    serve_sheds=0)
+            _ingest(mc, f"replica:{r}", 4.0,
+                    serve_pulls=250, serve_sheds=30 if r < 2 else 0)
+        rec = asc.tick(now=100.0)
+        assert rec is not None and rec["action"] == "scale_up"
+        assert rec["how"] == "reactivate" and rec["replica"] == 2
+        assert not sim.replicas[2]._retired
+        assert _wait_for(lambda: sim.replicas[2].refresh_rounds >= 2
+                         or sim.replicas[2].refresh(), timeout=10.0)
+        _, meta = sim.serve_client(2).pull_tensor(0, 1000)
+        assert meta["staleness_s"] <= 5.0
+        # idle signal now: the desired direction REVERSES inside the
+        # cooldown — counted as a flap, never executed.  The samples
+        # sit past the autoscaler's rate lookback, so the old shed
+        # burst no longer reads as current overload
+        for r in range(3):
+            _ingest(mc, f"replica:{r}", 20.0, serve_pulls=251,
+                    serve_sheds=30 if r < 2 else 0)
+            _ingest(mc, f"replica:{r}", 22.0, serve_pulls=251,
+                    serve_sheds=30 if r < 2 else 0)
+        assert asc.tick(now=110.0) is None  # cooling down
+        assert asc.flaps == 1
+        assert asc.tick(now=112.0) is None  # still cooling: one flap
+        assert asc.flaps == 1               # per window, not per tick
+        # cooldown over: scale-down still needs 2x patience
+        rec = asc.tick(now=140.0)
+        assert rec is not None and rec["action"] == "scale_down"
+        assert rec["replica"] == 2 and sim.replicas[2]._retired
+        # executed decisions never reversed inside a cooldown
+        ts = [d["t_mono"] for d in asc.decisions]
+        dirs = [d["action"] for d in asc.decisions]
+        for i in range(1, len(ts)):
+            if dirs[i] != dirs[i - 1]:
+                assert ts[i] - ts[i - 1] >= asc.cooldown_s
+    finally:
+        sim.shutdown()
+
+
+def test_autoscaler_floor_and_ceiling():
+    sim = Simulation(_cfg(replicas=2, enable_obs=True,
+                          obs_interval_s=0.0, serve_autoscale=True,
+                          serve_scale_patience=1, serve_min_replicas=2,
+                          serve_target_qps=10.0))
+    try:
+        _seed_model(sim)
+        asc = sim.replica_autoscaler
+        mc = sim.metrics_collector
+        for r in range(2):
+            _ingest(mc, f"replica:{r}", 1.0, serve_pulls=0,
+                    serve_sheds=0)
+            _ingest(mc, f"replica:{r}", 3.0, serve_pulls=0,
+                    serve_sheds=0)
+        # idle forever, but min_replicas == num_replicas: never shrinks
+        for i in range(6):
+            assert asc.tick(now=100.0 + 40 * i) is None
+        assert asc.stats()["scale_downs"] == 0
+        # overloaded, but already at the ceiling: never grows
+        for r in range(2):
+            _ingest(mc, f"replica:{r}", 5.0, serve_pulls=500,
+                    serve_sheds=50)
+        for i in range(3):
+            assert asc.tick(now=500.0 + 40 * i) is None
+        assert asc.stats()["scale_ups"] == 0
+    finally:
+        sim.shutdown()
+
+
+def test_health_rules_serve_overload_and_replica_flap():
+    sim = Simulation(Config(topology=Topology(num_parties=1,
+                                              workers_per_party=1),
+                            enable_obs=True, obs_interval_s=0.0,
+                            obs_shed_rate=2.0, obs_replica_flap=2))
+    try:
+        mc, eng = sim.metrics_collector, sim.health
+        # serve_overload: 40 sheds over 4s = 10/s > 2/s
+        _ingest(mc, "replica:3", 1.0, serve_sheds=0)
+        _ingest(mc, "replica:3", 5.0, serve_sheds=40)
+        recs = eng.tick(now=10.0)
+        got = {(r["rule"], r["subject"], r["state"]) for r in recs}
+        assert ("serve_overload", "replica:3", "firing") in got
+        assert not [r for r in eng.tick(now=11.0)
+                    if r["rule"] == "serve_overload"]  # no duplicate
+        # recovery: rate back under the threshold
+        _ingest(mc, "replica:3", 6.0, serve_sheds=40)
+        _ingest(mc, "replica:3", 60.0, serve_sheds=41)
+        got = {(r["rule"], r["subject"], r["state"])
+               for r in eng.tick(now=20.0)}
+        assert ("serve_overload", "replica:3", "recovered") in got
+        # replica_flap: the scheduler's autoscale_flaps counter grew
+        gs = "global_scheduler:0"
+        mc.ingest({"node": gs, "boot": 1, "t_mono": 1.0,
+                   "metrics": {f"{gs}.autoscale_flaps": 0}, "stats": {}})
+        mc.ingest({"node": gs, "boot": 1, "t_mono": 5.0,
+                   "metrics": {f"{gs}.autoscale_flaps": 3}, "stats": {}})
+        got = {(r["rule"], r["subject"], r["state"])
+               for r in eng.tick(now=30.0)}
+        assert ("replica_flap", "autoscaler", "firing") in got
+    finally:
+        sim.shutdown()
+
+
+def test_status_console_shows_shed_and_inflight_columns():
+    sim = Simulation(_cfg(replicas=1, enable_obs=True,
+                          obs_interval_s=0.0, serve_max_inflight=8))
+    try:
+        _seed_model(sim)
+        c = sim.serve_client(0)
+        c.pull_tensor(0, 1000)
+        sim.pump_metrics()
+        state = sim.cluster_state()
+        ent = state["replicas"][0]
+        assert ent["serve_sheds"] == 0
+        assert ent["inflight"] == 0 and ent["max_inflight"] == 8
+        assert ent["retired"] is False
+        from geomx_tpu.obs.state import render_text
+
+        sim.replicas[0].set_active(False)
+        sim.pump_metrics()
+        txt = render_text(sim.cluster_state())
+        assert "inflight=0/8" in txt and "RETIRED" in txt
+    finally:
+        sim.shutdown()
+
+
+# ---------------------------------------------------------------------------
+def test_churn_orchestrator_replica_kill_and_restart():
+    """The serve soak rides the same seeded-tape machinery as the
+    worker/server churn: replica kills are attributed (flight ring +
+    churn_replica_kills), floored, and followed by scheduled
+    restarts."""
+    from geomx_tpu.chaos.churn import (ChurnOrchestrator, ChurnPhase,
+                                       ChurnPlan)
+
+    sim = Simulation(_cfg(replicas=2, heartbeat_interval_s=0.2,
+                          heartbeat_timeout_s=1.0, request_retry_s=1.0,
+                          serve_refresh_interval_s=0.1,
+                          serve_staleness_s=3.0))
+    try:
+        w = sim.worker(0, 0)
+        w.init(0, np.arange(500, dtype=np.float32))
+        assert _wait_for(lambda: all(r.refresh_rounds > 0
+                                     for r in sim.replicas), timeout=10)
+        plan = ChurnPlan(
+            phases=(ChurnPhase(duration_s=1.5, notice_fraction=0.0,
+                               replica_kill_rate=2.0,
+                               replica_restart_s=0.5),),
+            seed=11, min_replicas_live=1)
+        orch = ChurnOrchestrator(sim, plan)
+        orch.run()  # inline: tape + scheduled restarts to completion
+        st = orch.stats()
+        assert st["replica_kills"] >= 1
+        kinds = [e["kind"] for e in orch.events]
+        assert "churn_replica_kill" in kinds
+        assert "churn_replica_restart" in kinds
+        # every killed replica was restarted and serves again
+        assert all(orch._replica_live.values())
+        assert _wait_for(lambda: all(len(r.store) > 0
+                                     and r.refresh_rounds > 0
+                                     for r in sim.replicas),
+                         timeout=15.0)
+        c = sim.serve_client(0)
+        arr, meta = c.pull_tensor(0, 500)
+        assert np.array_equal(arr, np.arange(500, dtype=np.float32))
+        assert meta["staleness_s"] <= 3.0
+    finally:
+        sim.shutdown()
