@@ -1976,6 +1976,98 @@ def child_churn():
     }))
 
 
+def child_partition():
+    """Partition tolerance cost (ISSUE 16): what a region-sized WAN
+    outage costs the party behind it and the deployment healing it.
+    Three readings on a 2-party deployment with a blackholed party-0
+    uplink: degraded-round wall vs the healthy baseline (the party
+    keeps closing LOCAL rounds against frozen weights — the round
+    itself should cost the same or less, there is no WAN leg),
+    heal→catch-up-merged latency, and the catch-up bytes shipped on
+    heal vs a dense resync of the model (2bit delta — the acceptance
+    bound is < 25%)."""
+    import numpy as np
+
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.kvstore import Simulation
+
+    N = int(os.environ.get("BENCH_PARTITION_ELEMS", "262144"))
+    rounds = int(os.environ.get("BENCH_PARTITION_ROUNDS", "20"))
+
+    cfg = Config(topology=Topology(num_parties=2, workers_per_party=1),
+                 enable_flight=False, lightweight=True,
+                 heartbeat_interval_s=0.05, heartbeat_timeout_s=0.4,
+                 enable_partition_mode=True, probe_timeout_s=0.4,
+                 sync_global_mode=False, partition_degrade_s=0.5,
+                 partition_catchup_bound=100000)
+    sim = Simulation(cfg, lightweight=True)
+    try:
+        w0, w1 = sim.all_workers()
+        for w in (w0, w1):
+            w.init(0, np.zeros(N, np.float32))
+        w0.set_optimizer({"type": "sgd", "lr": 0.1})
+        for p in range(2):
+            sim.worker(p, 0).set_gradient_compression({"type": "2bit"})
+        g = np.ones(N, np.float32)
+
+        def timed_rounds(w, n):
+            walls = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                w.push(0, g)
+                w.pull_sync(0)
+                w.wait_all()
+                walls.append(time.perf_counter() - t0)
+            return sorted(walls)[len(walls) // 2]
+
+        healthy = timed_rounds(w0, rounds)
+
+        rm = sim.recovery_monitor
+        ls0 = sim.local_servers[0]
+        sim.partition_party(0)
+        w0.push(0, g)  # the in-flight round the watchdog abandons
+        w0.wait_all()
+        t0 = time.monotonic()
+        while not (ls0._degraded and 0 in rm._quarantined):
+            if time.monotonic() - t0 > 30:
+                raise RuntimeError("degrade/quarantine never fired")
+            time.sleep(0.05)
+        detect_s = time.monotonic() - t0
+        degraded = timed_rounds(w0, rounds)
+
+        dense_bytes = sum(v.nbytes for v in ls0.store.values())
+        before = sim.wan_bytes()["wan_send_bytes"]
+        t0 = time.monotonic()
+        sim.heal_party(0)
+        while ls0.catchup_pushes == 0 or 0 in rm._quarantined:
+            if time.monotonic() - t0 > 60:
+                raise RuntimeError("catch-up rejoin never completed")
+            time.sleep(0.05)
+        heal_s = time.monotonic() - t0
+        shipped = sim.wan_bytes()["wan_send_bytes"] - before
+
+        evictions = sum(m.evictions for m in sim.eviction_monitors)
+        print(json.dumps({
+            "tensor_elems": N, "rounds": rounds,
+            "healthy_round_wall_s": round(healthy, 4),
+            "degraded_round_wall_s": round(degraded, 4),
+            "degraded_overhead_pct": round(
+                100.0 * (degraded - healthy) / max(healthy, 1e-9), 2),
+            "outage_detect_s": round(detect_s, 3),
+            "heal_to_merged_s": round(heal_s, 3),
+            "catchup_bytes": int(shipped),
+            "dense_resync_bytes": int(dense_bytes),
+            "catchup_vs_dense": round(shipped / max(dense_bytes, 1), 4),
+            "degraded_rounds_absorbed": ls0.degraded_rounds,
+            "catchup_fallbacks": ls0.catchup_fallbacks,
+            "quarantines": rm.party_quarantines,
+            "party_folds": rm.party_folds,
+            "worker_evictions": evictions,
+        }))
+    finally:
+        sim.shutdown()
+
+
 def child_serve():
     """Read-serving replica tier (ISSUE 8): ``pulls_per_sec`` at 1/2/4
     replicas under CONCURRENT training — the serving tier's brand-new
@@ -2688,7 +2780,9 @@ def _build_record() -> dict:
                       ("scaling", "scaling"), ("parity", "parity"),
                       ("serde", "serde"), ("shards", "shards"),
                       ("parties", "parties"),
-                      ("merge", "merge"),
+                      ("merge", "merge"), ("obs", "obs"),
+                      ("flight", "flight"), ("churn", "churn"),
+                      ("partition", "partition"),
                       ("serve", "serve"), ("probe", "probe")):
         if name in _results:
             record[key] = _results[name]
@@ -2779,6 +2873,15 @@ def _compact(record: dict) -> dict:
         out["churn_overhead_pct"] = ch["churn_overhead_pct"]
         out["drain_latency_s"] = ch.get("drain_latency_s")
         out["churn_stall_rounds"] = ch.get("stall_rounds")
+    pn = record.get("partition") or {}
+    if pn.get("catchup_vs_dense") is not None:
+        out["partition"] = {
+            "catchup_vs_dense": pn["catchup_vs_dense"],
+            "heal_to_merged_s": pn.get("heal_to_merged_s"),
+            "degraded_overhead_pct": pn.get("degraded_overhead_pct"),
+            "quarantines": pn.get("quarantines"),
+            "evictions": pn.get("worker_evictions"),
+        }
     mg = record.get("merge") or {}
     if mg.get("speedup") is not None:
         out["merge_backend_speedup"] = {
@@ -2951,7 +3054,8 @@ def main():
                              "overlap", "overlap_tpu", "stress", "probe",
                              "flash_autotune", "lm", "scaling", "parity",
                              "serde", "shards", "parties", "obs",
-                             "flight", "serve", "merge", "churn"])
+                             "flight", "serve", "merge", "churn",
+                             "partition"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -2980,6 +3084,7 @@ def main():
          "obs": child_obs,
          "flight": child_flight, "serve": child_serve,
          "merge": child_merge, "churn": child_churn,
+         "partition": child_partition,
          "flash_autotune": child_flash_autotune}[args.child]()
         return
 
@@ -3085,6 +3190,7 @@ def main():
         _do("flight", 180, cpu_env)
         _do("serve", 210, cpu_env)
         _do("churn", 240, cpu_env)
+        _do("partition", 240, cpu_env)
 
     cpu_thread = threading.Thread(target=cpu_chain, daemon=True)
     cpu_thread.start()

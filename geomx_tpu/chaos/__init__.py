@@ -5,5 +5,10 @@ for elasticity soaks (docs/deployment.md "Elasticity & preemption")."""
 
 from geomx_tpu.chaos.churn import (ChurnOrchestrator, ChurnPhase,
                                    ChurnPlan)
+from geomx_tpu.chaos.netfault import (NetFaultOrchestrator,
+                                      NetFaultPhase, NetFaultPlan,
+                                      install_env_netfaults)
 
-__all__ = ["ChurnOrchestrator", "ChurnPhase", "ChurnPlan"]
+__all__ = ["ChurnOrchestrator", "ChurnPhase", "ChurnPlan",
+           "NetFaultOrchestrator", "NetFaultPhase", "NetFaultPlan",
+           "install_env_netfaults"]

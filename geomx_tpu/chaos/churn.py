@@ -20,12 +20,16 @@ tape, and :class:`ChurnOrchestrator` executes it against a live
   ``restart_local_server`` (fold → warm boot → unfold);
 - serve-replica preemption → ``kill_replica`` + a scheduled
   ``restart_replica`` (eviction → view prune → dense-resync rejoin —
-  the serving-plane soak's churn axis, ISSUE 15).
+  the serving-plane soak's churn axis, ISSUE 15);
+- region outage → ``Simulation.partition_party`` + a scheduled
+  ``heal_party`` (WAN uplink dark, processes alive — the
+  quarantine-not-evict axis; scripted standalone fault tapes live in
+  geomx_tpu/chaos/netfault.py).
 
 Every injected event is stamped into the global scheduler's flight
 recorder (``FlightEv.CHURN``) and counted in the registry family
 ``churn_{notices,graceful_leaves,ungraceful_kills,joins,replica_kills,
-stall_rounds}``
+outages,stall_rounds}``
 so a postmortem can attribute a stall to an injected fault vs an
 organic one, and the health engine's ``churn_storm`` rule can page on
 transition rate / survivor floor (obs/health.py).
@@ -60,6 +64,14 @@ class ChurnPhase:
     replica_restart_s: float = 2.0  # replacement delay after a replica
     #                                 kill (fresh boot, empty store —
     #                                 first refresh resyncs dense)
+    outage_rate: float = 0.0        # region (party WAN-uplink) outages
+    #                                 per second — the link-level fault
+    #                                 axis (partition, not crash): the
+    #                                 party's processes stay up, its WAN
+    #                                 links go dark, and the detectors
+    #                                 must QUARANTINE instead of evict
+    outage_duration_s: float = 5.0  # how long each outage lasts before
+    #                                 the uplink heals
 
 
 @dataclasses.dataclass
@@ -89,7 +101,8 @@ class ChurnPlan:
             for kind, rate in (("depart", ph.departure_rate),
                                ("join", ph.join_rate),
                                ("server_kill", ph.server_kill_rate),
-                               ("replica_kill", ph.replica_kill_rate)):
+                               ("replica_kill", ph.replica_kill_rate),
+                               ("outage", ph.outage_rate)):
                 if rate <= 0:
                     continue
                 t = t0
@@ -154,6 +167,9 @@ class ChurnOrchestrator:
                               for r in range(sim.topology.num_replicas)}
         self._restarts: List[Tuple[float, int]] = []  # (t, party)
         self._replica_restarts: List[Tuple[float, int]] = []  # (t, rank)
+        self._outage_heals: List[Tuple[float, int]] = []  # (t, party)
+        self._partitioned: Dict[int, bool] = {
+            p: False for p in range(sim.topology.num_parties)}
         self.noticed: set = set()      # nodes that got a graceful notice
         self.killed: set = set()       # nodes killed ungracefully
         self.drain_latencies: List[float] = []
@@ -168,6 +184,7 @@ class ChurnOrchestrator:
         self._c_joins = system_counter(f"{self.node}.churn_joins")
         self._c_replica_kills = system_counter(
             f"{self.node}.churn_replica_kills")
+        self._c_outages = system_counter(f"{self.node}.churn_outages")
         self._c_stalls = system_counter(
             f"{self.node}.churn_stall_rounds")
         self._g_survivors = system_gauge(f"{self.node}.churn_survivors")
@@ -200,6 +217,7 @@ class ChurnOrchestrator:
                 "ungraceful_kills": self._c_kills.value,
                 "joins": self._c_joins.value,
                 "replica_kills": self._c_replica_kills.value,
+                "outages": self._c_outages.value,
                 "stall_rounds": self._c_stalls.value,
                 "transitions": len(self.events),
                 "survivors": self._survivor_count(),
@@ -224,8 +242,12 @@ class ChurnOrchestrator:
             for r in [r for r in self._replica_restarts if r[0] <= now]:
                 self._replica_restarts.remove(r)
                 self._do_replica_restart(r[1])
+            for r in [r for r in self._outage_heals if r[0] <= now]:
+                self._outage_heals.remove(r)
+                self._do_outage_heal(r[1])
             deadlines = [r[0] for r in self._restarts]
             deadlines += [r[0] for r in self._replica_restarts]
+            deadlines += [r[0] for r in self._outage_heals]
             if i < len(self._tape):
                 deadlines.append(t_start + self._tape[i][0])
             if not deadlines:
@@ -382,6 +404,30 @@ class ChurnOrchestrator:
             self.sim.kill_replica(r)
             self._replica_restarts.append(
                 (time.monotonic() + ph.replica_restart_s, r))
+        elif kind == "outage":
+            # region outage: the party's WAN uplink dies, every process
+            # behind it keeps running — the quarantine-not-evict axis.
+            # Only parties whose server is UP and not already dark
+            # qualify (an outage of a dead server tests nothing).
+            with self._mu:
+                cands = [p for p, up in self._server_live.items()
+                         if up and not self._partitioned[p]]
+                if not cands:
+                    return
+                p = self._rng.choice(sorted(cands))
+                self._partitioned[p] = True
+            self._c_outages.inc()
+            self._stamp("churn_outage", f"server:0@p{p}")
+            self.sim.partition_party(p)
+            self._outage_heals.append(
+                (time.monotonic() + ph.outage_duration_s, p))
+
+    def _do_outage_heal(self, party: int):
+        self.sim.heal_party(party)
+        with self._mu:
+            self._partitioned[party] = False
+        self._stamp("churn_outage_heal", f"server:0@p{party}")
+        print(f"churn: healed outage of party {party}", flush=True)
 
     def _do_replica_restart(self, rank: int):
         self.sim.restart_replica(rank)

@@ -535,6 +535,29 @@ class Config:
     #                                before it leaves anyway, and how
     #                                long the party scheduler holds
     #                                eviction for a draining member
+    # --- partition tolerance (Control.PROBE_INDIRECT + Cmd.CATCHUP; see
+    # docs/deployment.md "Partition tolerance").  When on, a heartbeat-
+    # expired node is not immediately evicted: the monitor asks k peers
+    # to relay a SWIM-style indirect probe, and if any peer still hears
+    # the suspect it is QUARANTINED — folded out of rounds/barriers
+    # reversibly, incarnation NOT fenced — instead of evicted.  A
+    # quarantined party's local server keeps closing degraded-mode
+    # rounds against a frozen model, accumulating a bounded per-key
+    # gradient delta it ships as one staleness-stamped catch-up push on
+    # heal (dense warm boot only past the bound).  Off (default): the
+    # legacy expire→evict path is untouched — no probes, no new state.
+    enable_partition_mode: bool = False
+    probe_indirect_k: int = 2       # peers asked to relay each probe
+    probe_timeout_s: float = 0.5    # per-relay ping wait at the peer
+    partition_catchup_bound: int = 50  # max degraded rounds a catch-up
+    #                                    delta may cover before the heal
+    #                                    falls back to a dense resync
+    #                                    (warm boot); 0 = always dense
+    partition_degrade_s: float = 0.0  # WAN-silence window before a
+    #                                   local server with stuck un-ACKed
+    #                                   pushes enters degraded mode;
+    #                                   0 = follow max(heartbeat_
+    #                                   timeout_s, 1.0)
     # --- distributed tracing (geomx_tpu/trace; beyond the reference —
     # its profiler is per-process only).  trace_sample_every = N traces
     # every N-th synchronization round end-to-end: causal spans ride the
@@ -734,6 +757,29 @@ class Config:
             "GEOMX_RETRY_JITTER", self.retry_jitter)
         self.policy_fence_max_retries = _env_int(
             "GEOMX_POLICY_FENCE_MAX_RETRIES", self.policy_fence_max_retries)
+        # partition-tolerance knobs follow the same env-wins idiom so the
+        # chaos soaks and demo scripts reach directly-constructed Configs
+        self.enable_partition_mode = _env_bool(
+            "GEOMX_PARTITION_MODE", self.enable_partition_mode)
+        self.probe_indirect_k = _env_int(
+            "GEOMX_PROBE_K", self.probe_indirect_k)
+        self.probe_timeout_s = _env_float(
+            "GEOMX_PROBE_TIMEOUT_S", self.probe_timeout_s)
+        self.partition_catchup_bound = _env_int(
+            "GEOMX_PARTITION_CATCHUP_BOUND", self.partition_catchup_bound)
+        self.partition_degrade_s = _env_float(
+            "GEOMX_PARTITION_DEGRADE_S", self.partition_degrade_s)
+        if self.probe_indirect_k < 1:
+            raise ValueError("probe_indirect_k must be >= 1")
+        if self.probe_timeout_s <= 0.0:
+            raise ValueError("probe_timeout_s must be > 0")
+        if self.partition_catchup_bound < 0:
+            raise ValueError(
+                "partition_catchup_bound must be >= 0 (0 = always fall "
+                "back to a dense resync on heal)")
+        if self.partition_degrade_s < 0.0:
+            raise ValueError("partition_degrade_s must be >= 0 "
+                             "(0 = follow max(heartbeat_timeout_s, 1.0))")
         if self.retry_backoff_cap < 1:
             raise ValueError("retry_backoff_cap must be >= 1")
         if self.retry_jitter < 0.0:
@@ -977,6 +1023,12 @@ class Config:
             ),
             enable_preempt=_env_bool("GEOMX_PREEMPT_NOTICE"),
             preempt_drain_s=_env_float("GEOMX_PREEMPT_DRAIN_S", 30.0),
+            enable_partition_mode=_env_bool("GEOMX_PARTITION_MODE"),
+            probe_indirect_k=_env_int("GEOMX_PROBE_K", 2),
+            probe_timeout_s=_env_float("GEOMX_PROBE_TIMEOUT_S", 0.5),
+            partition_catchup_bound=_env_int(
+                "GEOMX_PARTITION_CATCHUP_BOUND", 50),
+            partition_degrade_s=_env_float("GEOMX_PARTITION_DEGRADE_S", 0.0),
             trace_sample_every=_env_int("GEOMX_TRACE_SAMPLE_EVERY", 0),
             trace_dir=os.environ.get("GEOMX_TRACE_DIR", ""),
             trace_batch_events=_env_int("GEOMX_TRACE_BATCH_EVENTS", 256),

@@ -344,6 +344,14 @@ class Cmd(enum.IntEnum):
     #                      body) over the replica's local copy and return
     #                      the logits — inference without ever touching
     #                      the training lanes
+    CATCHUP = 9          # healed local server -> global tier: the bounded
+    #                      per-key gradient delta its party accumulated
+    #                      while QUARANTINED behind a partition (degraded-
+    #                      mode rounds).  Rides the WAN push codec; body
+    #                      carries {catchup: {rounds, age_s}} so the
+    #                      global optimizer can staleness-compensate
+    #                      (DC-ASGD) the merge.  Does NOT advance sync
+    #                      round accounting — the party was folded out
 
 
 class Ctrl(enum.IntEnum):

@@ -55,7 +55,7 @@ from geomx_tpu.core.config import NodeId, Role
 from geomx_tpu.ps import Postoffice
 from geomx_tpu.trace.recorder import get_tracer
 from geomx_tpu.transport.message import Control, Domain, Message
-from geomx_tpu.utils.metrics import system_counter
+from geomx_tpu.utils.metrics import system_counter, system_gauge
 
 _LOG = logging.getLogger(__name__)
 
@@ -102,7 +102,8 @@ class _HeartbeatActuator:
         raise NotImplementedError
 
     def _on_control(self, msg: Message) -> bool:
-        if (msg.control in (Control.EVICT, Control.REJOIN)
+        if (msg.control in (Control.EVICT, Control.REJOIN,
+                            Control.PROBE_INDIRECT)
                 and not msg.request):
             body = msg.body if isinstance(msg.body, dict) else {}
             token = body.get("token")
@@ -142,6 +143,24 @@ class _HeartbeatActuator:
                                      timeout=per_try_s):
                     return self._replies.pop(token)
         return None
+
+    def _probe_any_alive(self, suspect: str, relays, domain: Domain) -> bool:
+        """SWIM-style indirect probe: ask up to ``Config.probe_indirect_k``
+        relays (in the given order — put the relay that shares the
+        suspect's LAN first) to ping the suspect on this monitor's
+        behalf.  True the moment any relay hears a pong — the suspect
+        is PARTITIONED from this monitor, not dead.  One attempt per
+        relay: an unreachable relay is itself evidence for a real
+        outage, and the sweep re-probes next tick anyway."""
+        cfg = self.po.config
+        timeout = float(cfg.probe_timeout_s)
+        for peer in list(relays)[:int(cfg.probe_indirect_k)]:
+            reply = self._rpc(peer, Control.PROBE_INDIRECT,
+                              {"suspect": str(suspect), "timeout": timeout},
+                              domain, attempts=1, per_try_s=timeout + 1.0)
+            if reply is not None and reply.get("alive"):
+                return True
+        return False
 
     @staticmethod
     def _age(info: dict, node_s: str, baseline: float, now: float) -> float:
@@ -183,8 +202,20 @@ class WorkerEvictionMonitor(_HeartbeatActuator):
         self._noticed: Dict[str, float] = {}
         self.notice_holds = 0
         self.evictions = 0
+        # partition tolerance (Config.enable_partition_mode): members
+        # whose heartbeats expired but whose indirect probes still
+        # answered — folded out REVERSIBLY (incarnation not fenced),
+        # re-probed every sweep, readmitted the moment heartbeats
+        # resume, escalated to the legacy eviction once the probes go
+        # dark too.  node -> boot at quarantine.
+        self._quarantined: Dict[str, int] = {}
+        self.quarantines = 0
         self._counter = system_counter(
             f"{postoffice.node}.worker_evictions")
+        self._q_counter = system_counter(
+            f"{postoffice.node}.partition_quarantines")
+        self._q_gauge = system_gauge(
+            f"{postoffice.node}.quarantined_nodes")
         super().__init__(postoffice, check_interval_s)
 
     def _on_extra(self, msg: Message) -> bool:
@@ -242,7 +273,9 @@ class WorkerEvictionMonitor(_HeartbeatActuator):
             candidates = [n for n in sorted(self._members)
                           if n not in self._evicted
                           and n not in self._evicting
-                          and n not in self._noticed]
+                          and n not in self._noticed
+                          and n not in self._quarantined]
+            quarantined = dict(self._quarantined)
             baselines = dict(self._baseline)
         for n in candidates:
             if NodeId.parse(n).role is not Role.WORKER:
@@ -251,7 +284,104 @@ class WorkerEvictionMonitor(_HeartbeatActuator):
                          now) <= self._timeout:
                 continue
             boot = info.get(n, (None, 0))[1]
-            self._evict(n, boot)
+            self._suspect(n, boot)
+        for n, boot in sorted(quarantined.items()):
+            if self._age(info, n, baselines.get(n, epoch),
+                         now) <= self._timeout:
+                # the partition healed — heartbeats are flowing again
+                self._unquarantine(n)
+            elif not self._probe_any_alive(n, self._relays_for(n),
+                                           Domain.LOCAL):
+                # the probes went dark too: the partition became (or
+                # always was, and the path just died) a crash —
+                # escalate to the legacy eviction, fence and all
+                with self._mu:
+                    self._quarantined.pop(n, None)
+                self._q_gauge.set(len(self._quarantined))
+                self._evict(n, boot)
+
+    def _relays_for(self, suspect: str):
+        """Probe relays for a suspect worker: the party server first
+        (it shares the suspect's LAN, so a cut that only severed the
+        worker↔scheduler path still hears it), then live siblings."""
+        with self._mu:
+            sibs = [n for n in sorted(self._members)
+                    if n != suspect and n not in self._evicted
+                    and n not in self._quarantined]
+        return ([self.topology.server(self.party)]
+                + [NodeId.parse(n) for n in sibs])
+
+    def _suspect(self, node_s: str, boot: int):
+        """Heartbeats expired: dead, or just unreachable from here?
+        Partition mode asks k peers before deciding; off (default), the
+        legacy expire→evict path runs untouched."""
+        if (self.po.config.enable_partition_mode
+                and self._probe_any_alive(node_s, self._relays_for(node_s),
+                                          Domain.LOCAL)):
+            self._quarantine(node_s, boot)
+        else:
+            self._evict(node_s, boot)
+
+    def _quarantine(self, node_s: str, boot: int):
+        with self._mu:
+            self._evicting.add(node_s)
+        try:
+            # barrier liveness FIRST, exactly like the eviction path:
+            # survivors blocked on the unreachable member release now
+            self.po.exclude_node(node_s)
+            reply = self._rpc(
+                self.topology.server(self.party), Control.EVICT,
+                {"action": "quarantine", "node": node_s, "boot": boot},
+                Domain.LOCAL)
+            if reply is None:
+                return  # server unreachable — the next sweep retries
+            with self._mu:
+                self._quarantined[node_s] = boot
+                self.quarantines += 1
+            self._q_counter.inc()
+            self._q_gauge.set(len(self._quarantined))
+            get_tracer(str(self.po.node)).instant(
+                "quarantine.worker", node=node_s, boot=boot)
+            if self.po.flight is not None:
+                from geomx_tpu.obs.flight import FlightEv
+
+                self.po.flight.record(FlightEv.NETFAULT, d=boot,
+                                      peer=node_s,
+                                      note="netfault_quarantine")
+            print(f"{self.po.node}: quarantined {node_s} (heartbeats "
+                  "expired but an indirect probe still hears it) — "
+                  "folded out reversibly, incarnation NOT fenced",
+                  flush=True)
+        finally:
+            with self._mu:
+                self._evicting.discard(node_s)
+
+    def _unquarantine(self, node_s: str):
+        with self._mu:
+            self._evicting.add(node_s)
+        try:
+            reply = self._rpc(
+                self.topology.server(self.party), Control.EVICT,
+                {"action": "unquarantine", "node": node_s}, Domain.LOCAL)
+            if reply is None:
+                return  # server unreachable — the next sweep retries
+            with self._mu:
+                self._quarantined.pop(node_s, None)
+            self._q_gauge.set(len(self._quarantined))
+            self.po.readmit_node(node_s)
+            get_tracer(str(self.po.node)).instant(
+                "quarantine.worker_heal", node=node_s)
+            if self.po.flight is not None:
+                from geomx_tpu.obs.flight import FlightEv
+
+                self.po.flight.record(FlightEv.NETFAULT, peer=node_s,
+                                      note="netfault_unquarantine")
+            print(f"{self.po.node}: {node_s} healed — heartbeats "
+                  "resumed, quarantine lifted and membership restored",
+                  flush=True)
+        finally:
+            with self._mu:
+                self._evicting.discard(node_s)
 
     def _evict(self, node_s: str, boot: int):
         with self._mu:
@@ -317,12 +447,26 @@ class LocalServerRecoveryMonitor(_HeartbeatActuator):
         self.party_folds = 0
         self.party_unfolds = 0
         self.preempt_folds = 0
+        # partition tolerance (Config.enable_partition_mode): parties
+        # whose local server stopped heartbeating but still answers an
+        # indirect probe.  Folded out at the shards (the fold is already
+        # reversible and unfenced at this tier), but tracked HERE as
+        # quarantined: the heal path asks for a catch-up rejoin instead
+        # of a dense warm boot, the console shows QUARANTINED, and the
+        # fold only becomes final once the probes go dark too.
+        # party -> boot at quarantine.
+        self._quarantined: Dict[int, int] = {}
+        self.party_quarantines = 0
         self._fold_counter = system_counter(
             f"{postoffice.node}.party_folds")
         self._unfold_counter = system_counter(
             f"{postoffice.node}.party_unfolds")
         self._preempt_counter = system_counter(
             f"{postoffice.node}.preempt_folds")
+        self._q_counter = system_counter(
+            f"{postoffice.node}.partition_quarantines")
+        self._q_gauge = system_gauge(
+            f"{postoffice.node}.quarantined_nodes")
         super().__init__(postoffice, check_interval_s)
 
     def _on_extra(self, msg: Message) -> bool:
@@ -372,9 +516,21 @@ class LocalServerRecoveryMonitor(_HeartbeatActuator):
                 folded = p in self._folded
                 pending = p in self._pending_death
                 boot_at_fold = self._folded.get(p, 0)
+                quarantined = p in self._quarantined
+                boot_at_q = self._quarantined.get(p, 0)
+            if quarantined:
+                if age <= self._timeout:
+                    # the partition healed: heartbeats resumed — drive
+                    # the catch-up rejoin (the server decides catch-up
+                    # vs dense from its own accumulated state)
+                    self._spawn(p, self._recover_quarantined, p)
+                else:
+                    self._spawn(p, self._requarantine_or_fold, p,
+                                boot_at_q)
+                continue
             if not folded and age > self._timeout:
                 boot = info.get(node_s, (None, 0))[1]
-                self._spawn(p, self._fold, p, boot)
+                self._spawn(p, self._suspect_party, p, boot)
             elif folded and pending and age > self._timeout:
                 # the noticed incarnation finally died — from here the
                 # next resumed heartbeat is a replacement to recover
@@ -433,6 +589,125 @@ class LocalServerRecoveryMonitor(_HeartbeatActuator):
         print(f"{self.po.node}: folded party {party} out of global "
               f"rounds ({node_s} heartbeat expired) — the WAN root "
               "continues on the survivor parties", flush=True)
+
+    # ---- partition-tolerant party quarantine (enable_partition_mode) ----
+    def _party_relays(self, party: int):
+        """Probe relays for a suspect local server: the suspect party's
+        OWN scheduler first (it shares the suspect's LAN — the relay a
+        WAN-uplink blackhole cannot cut), then the other parties'
+        servers and the global shards (alternate WAN paths)."""
+        t = self.topology
+        relays = [t.scheduler(party)]
+        relays += [t.server(q) for q in range(t.num_parties) if q != party]
+        relays += list(self._shards.global_servers())
+        return relays
+
+    def _suspect_party(self, party: int, boot: int):
+        """Heartbeats expired: partition mode probes before folding for
+        good; off (default), the legacy expire→fold path is untouched."""
+        if (self.po.config.enable_partition_mode
+                and self._probe_any_alive(
+                    str(self.topology.server(party)),
+                    self._party_relays(party), Domain.GLOBAL)):
+            self._quarantine_party(party, boot)
+        else:
+            self._fold(party, boot)
+
+    def _quarantine_party(self, party: int, boot: int):
+        node_s = str(self.topology.server(party))
+        # the same reversible fold the crash path uses — global rounds
+        # close on the survivors — but tracked as QUARANTINED: nothing
+        # is fenced, and the heal path prefers a catch-up rejoin
+        for gs in self._shards.global_servers():
+            self._rpc(gs, Control.EVICT,
+                      {"action": "party_fold", "node": node_s},
+                      Domain.GLOBAL)
+        with self._mu:
+            self._quarantined[party] = boot
+            self.party_quarantines += 1
+        self._q_counter.inc()
+        self._q_gauge.set(len(self._quarantined))
+        get_tracer(str(self.po.node)).instant(
+            "quarantine.party", party=party, node=node_s)
+        if self.po.flight is not None:
+            from geomx_tpu.obs.flight import FlightEv
+
+            self.po.flight.record(FlightEv.NETFAULT, a=party, d=boot,
+                                  peer=node_s,
+                                  note="netfault_quarantine")
+        print(f"{self.po.node}: quarantined party {party} ({node_s} "
+              "heartbeats expired but an indirect probe still hears "
+              "it) — folded out reversibly, catch-up rejoin armed",
+              flush=True)
+
+    def _requarantine_or_fold(self, party: int, boot: int):
+        """Still dark: re-probe.  Alive somewhere → stay quarantined
+        (the partition persists).  Probes dark too → the partition
+        became a crash: the fold goes final and the legacy dense
+        recovery takes over when something heartbeats again."""
+        if self._probe_any_alive(str(self.topology.server(party)),
+                                 self._party_relays(party), Domain.GLOBAL):
+            return
+        node_s = str(self.topology.server(party))
+        with self._mu:
+            self._quarantined.pop(party, None)
+            self._folded[party] = boot
+        self._q_gauge.set(len(self._quarantined))
+        self.party_folds += 1
+        self._fold_counter.inc()
+        get_tracer(str(self.po.node)).instant(
+            "evict.party_fold", party=party, node=node_s)
+        if self.po.flight is not None:
+            from geomx_tpu.obs.flight import FlightEv
+
+            self.po.flight.record(FlightEv.FOLD, b=party, d=boot,
+                                  peer=node_s, note="party_fold")
+        print(f"{self.po.node}: party {party} quarantine escalated to a "
+              f"fold ({node_s} stopped answering indirect probes too)",
+              flush=True)
+
+    def _recover_quarantined(self, party: int):
+        node = self.topology.server(party)
+        # 1. catch-up rejoin: the healed server ships its accumulated
+        #    degraded-round delta (or falls back to a dense warm boot
+        #    past the bound — ITS call; the reply says which)
+        reply = self._rpc(node, Control.REJOIN, {"mode": "catchup"},
+                          Domain.GLOBAL, attempts=8, per_try_s=5.0)
+        if reply is None or not reply.get("ok"):
+            return  # not ready yet — the next sweep retries
+        # 2. the party counts toward global rounds again
+        for gs in self._shards.global_servers():
+            self._rpc(gs, Control.EVICT,
+                      {"action": "party_unfold", "node": str(node)},
+                      Domain.GLOBAL)
+        # 3. the party's workers replay their un-ACKed requests NOW
+        for w in self.topology.workers(party):
+            try:
+                self.po.van.send(Message(
+                    recipient=w, control=Control.REJOIN,
+                    domain=Domain.GLOBAL, request=False,
+                    body={"event": "server_back", "server": str(node)}))
+            except (KeyError, OSError):
+                pass  # a dead worker is the party monitor's business
+        with self._mu:
+            self._quarantined.pop(party, None)
+        self._q_gauge.set(len(self._quarantined))
+        self.party_unfolds += 1
+        self._unfold_counter.inc()
+        mode = reply.get("mode", "dense")
+        get_tracer(str(self.po.node)).instant(
+            "quarantine.party_heal", party=party, mode=mode,
+            keys=int(reply.get("keys", 0)))
+        if self.po.flight is not None:
+            from geomx_tpu.obs.flight import FlightEv
+
+            self.po.flight.record(FlightEv.NETFAULT, a=party,
+                                  c=int(reply.get("keys", 0)),
+                                  peer=str(node),
+                                  note="netfault_unquarantine")
+        print(f"{self.po.node}: party {party} healed — {node} rejoined "
+              f"via {mode} ({reply.get('keys', 0)} keys) and folded "
+              "back into global rounds", flush=True)
 
     def _recover(self, party: int):
         node = self.topology.server(party)

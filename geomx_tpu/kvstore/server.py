@@ -313,6 +313,34 @@ class LocalServer:
         self._wan_inflight = 0  # WAN push batches awaiting group acks
         self._preempt_waiters: List[Message] = []
         self._preempt_busy = False
+        # partition tolerance (Config.enable_partition_mode; docs/
+        # deployment.md "Partition tolerance").  Quarantined WORKERS:
+        # members the party scheduler folded out reversibly — rank
+        # stashed for restore, incarnation NOT fenced.  Quarantined
+        # SELF: when this server's own WAN uplink goes dark (a stuck
+        # un-ACKed push with no ack progress for the degrade window),
+        # it keeps closing party rounds DEGRADED — the merged gradient
+        # accumulates into a bounded per-key catch-up delta against
+        # FROZEN weights (DC-ASGD compensates the staleness at the
+        # merge) — and the heal ships one staleness-stamped Cmd.CATCHUP
+        # push instead of discarding the party's progress behind a
+        # dense warm boot.
+        self._quarantined_members: Dict[str, int] = {}  # node -> rank
+        self._partition_mode = bool(self.config.enable_partition_mode)
+        self._degraded = False
+        self._catchup: Dict[int, np.ndarray] = {}
+        self._catchup_rounds = 0
+        self._catchup_since: Optional[float] = None
+        self._catchup_invalid = False  # HFA rounds push weights, not
+        #                                gradients — delta semantics
+        #                                break, heal must dense-resync
+        self.degraded_rounds = 0
+        self.catchup_pushes = 0
+        self.catchup_fallbacks = 0
+        self._wan_progress_t = time.monotonic()
+        self._degrade_window = (
+            self.config.partition_degrade_s
+            or max(self.config.heartbeat_timeout_s, 1.0))
         self.store: Dict[int, np.ndarray] = {}
         self._keys: Dict[int, _KeyState] = {}
         # key-sharded server state: ``stripe(k)`` guards key k's merge /
@@ -447,6 +475,19 @@ class LocalServer:
             self._merge_q: "_queue.Queue" = _queue.Queue()
             threading.Thread(target=self._inter_merge_loop, daemon=True,
                              name=f"inter-merge-{postoffice.node}").start()
+        # WAN-silence watchdog (partition mode only): detects this
+        # server's OWN partition — a push-up whose group acks stopped
+        # arriving — and flips to degraded-mode rounds so the party
+        # keeps training instead of wedging on the dead uplink
+        self._degrade_ticker = None
+        if self._partition_mode:
+            from geomx_tpu.transport.reactor import Periodic
+
+            self._degrade_ticker = Periodic(
+                max(self._degrade_window / 4.0, 0.05),
+                self._degrade_sweep,
+                name=f"degrade-watchdog-{postoffice.node}",
+                reactor=getattr(postoffice.van.fabric, "reactor", None))
 
     # ---- request handling ---------------------------------------------------
     def _handle(self, msg: Message, kvs: Optional[KVPairs], server: KVServer):
@@ -701,7 +742,10 @@ class LocalServer:
         if msg.control is not Control.EVICT or not msg.request:
             return False
         body = msg.body if isinstance(msg.body, dict) else {}
-        if "node" not in body or body.get("action"):
+        action = body.get("action")
+        if action in ("quarantine", "unquarantine") and "node" in body:
+            return self._on_quarantine(msg, body, action)
+        if "node" not in body or action:
             return False  # party_fold/unfold belong to the global tier
         node_s = str(body["node"])
         boot = int(body.get("boot", 0))
@@ -710,6 +754,9 @@ class LocalServer:
             if folded:
                 self.evicted_workers += 1
             self._evicted.setdefault(node_s, boot)
+            # a quarantine that escalated to an eviction: the reversible
+            # fold already happened, the fence above makes it final
+            self._quarantined_members.pop(node_s, None)
             total = self._workers_target
         if folded:
             from geomx_tpu.utils.metrics import system_counter
@@ -721,6 +768,46 @@ class LocalServer:
             self._broadcast_membership()
         self.po.van.send(msg.reply_to(control=Control.EVICT, body={
             "evicted": folded, "num_workers": total,
+            "token": body.get("token")}))
+        return True
+
+    def _on_quarantine(self, msg: Message, body: dict, action: str) -> bool:
+        """Control.EVICT {action: quarantine|unquarantine} from the
+        party scheduler's monitor: the member is unreachable from the
+        scheduler but an indirect probe still hears it — fold it out of
+        round targets REVERSIBLY (its rank is stashed, its incarnation
+        is NOT fenced; a LAN-reachable quarantined member's pushes
+        still accumulate, at worst completing a lowered-target round
+        early) and restore it verbatim when heartbeats resume.
+        Idempotent both ways."""
+        node_s = str(body["node"])
+        with self._mu:
+            if action == "quarantine":
+                rank = self._members.get(node_s)
+                changed = self._fold_member_out_locked(node_s)
+                if changed and rank is not None:
+                    self._quarantined_members[node_s] = rank
+                ok = changed or node_s in self._quarantined_members
+            else:
+                rank = self._quarantined_members.pop(node_s, None)
+                changed = (rank is not None
+                           and node_s not in self._members)
+                if changed:
+                    self._members[node_s] = rank
+                    self._workers_target += 1
+                    self._membership_seq += 1
+                ok = changed or node_s in self._members
+            total = self._workers_target
+        if changed:
+            if self._flight is not None:
+                self._flight.record(FlightEv.NETFAULT, peer=node_s,
+                                    note=f"member_{action}")
+            print(f"{self.po.node}: {action}d {node_s} — "
+                  f"{total} workers count toward fresh rounds, "
+                  "incarnation not fenced", flush=True)
+            self._broadcast_membership()
+        self.po.van.send(msg.reply_to(control=Control.EVICT, body={
+            "ok": ok, "num_workers": total,
             "token": body.get("token")}))
         return True
 
@@ -776,8 +863,19 @@ class LocalServer:
         return True
 
     def _warm_boot_thread(self):
+        mode = "dense"
         try:
-            n = self.warm_boot()
+            n = None
+            if self._partition_mode and (self._degraded or self._catchup
+                                         or self._catchup_rounds):
+                # this process SURVIVED the partition with live state — a
+                # bounded catch-up delta re-merges it; a genuinely crashed
+                # replacement has neither flag set and dense-boots below
+                n = self._ship_catchup()
+                if n is not None:
+                    mode = "catchup"
+            if n is None:
+                n = self.warm_boot()
             ok = True
         except Exception:
             import logging
@@ -791,7 +889,7 @@ class LocalServer:
         for m in waiters:
             try:
                 self.po.van.send(m.reply_to(control=Control.REJOIN, body={
-                    "ok": ok, "keys": n,
+                    "ok": ok, "keys": n, "mode": mode,
                     "token": (m.body or {}).get("token")}))
             except (KeyError, OSError):
                 pass  # the monitor re-asks
@@ -867,6 +965,186 @@ class LocalServer:
         print(f"{self.po.node}: warm boot adopted {len(got)} keys from "
               "the global tier", flush=True)
         return len(got)
+
+    # ---- degraded-mode rounds & catch-up (partition tolerance) -------------
+    def _degrade_sweep(self):
+        """Periodic watchdog (partition mode only): a WAN push batch
+        whose group acks have made no progress for the degrade window
+        means the uplink is dark — switch to degraded rounds instead of
+        letting every subsequent party round wedge behind it."""
+        if self._degraded or not self._partition_mode:
+            return
+        with self._ctr_mu:
+            inflight = self._wan_inflight
+            last = self._wan_progress_t
+        if (inflight > 0
+                and time.monotonic() - last > self._degrade_window
+                and self._wan_heartbeat_silent()):
+            self._enter_degraded()
+
+    def _wan_heartbeat_silent(self) -> bool:
+        """Second opinion before degrading: a stalled WAN push ack can
+        be LEGITIMATE (a sync-mode global round parks this party's push
+        until every other party contributes), but a genuinely dark
+        uplink also starves this server's own heartbeat echoes from the
+        global scheduler — require both before abandoning the round.
+        Heartbeats off → no echo evidence either way → the ack stall
+        alone decides."""
+        if self.config.heartbeat_interval_s <= 0:
+            return True
+        age = self.po.heartbeat_echo_age(
+            self.po.topology.global_scheduler())
+        return age > self._degrade_window
+
+    def _enter_degraded(self):
+        """Abandon the stuck WAN round(s) and start accumulating.  The
+        stuck keys' epochs are bumped FIRST so a late pull-down from the
+        abandoned batch (delivered after a partial partition heals)
+        cannot clobber weights the degraded rounds moved past; the
+        merged-but-unacked push gradients are NOT folded into the
+        catch-up delta — the van's replay layer re-delivers the push
+        itself once the fabric heals (request_retry_s > 0), and
+        double-counting them here would apply them twice."""
+        with self._mu:
+            if self._degraded:
+                return
+            self._degraded = True
+            self._catchup_since = time.monotonic()
+            stuck = [k for k, st in self._keys.items()
+                     if st.in_flight > 0]
+            for k in stuck:
+                self._keys[k].epoch += 1
+        while True:
+            open_keys = []
+            with self._mu:
+                open_keys = [k for k in stuck
+                             if self._keys[k].in_flight > 0]
+            if not open_keys:
+                break
+            self._finish_round(open_keys)
+        with self._ctr_mu:
+            self._wan_inflight = 0  # abandoned; the ack-side clamp
+            #                         absorbs any late arrivals
+        if self._flight is not None:
+            self._flight.record(FlightEv.NETFAULT, a=len(stuck),
+                                note="netfault_degraded")
+        print(f"{self.po.node}: entered degraded mode — WAN uplink "
+              f"silent for {self._degrade_window:.1f}s, party rounds "
+              "continue against frozen weights and accumulate a "
+              "catch-up delta", flush=True)
+
+    def _absorb_degraded_round(self, kvs: KVPairs, keys: List[int]):
+        """A party round completed while the WAN uplink is dark: fold
+        the merged gradient into the bounded per-key catch-up delta and
+        close the round against the frozen weights.  Under HFA the
+        push-up carries party-mean WEIGHTS, not a gradient — summing
+        those is meaningless, so the accumulator is poisoned and the
+        heal falls back to a dense resync."""
+        with self._ctr_mu:
+            self.degraded_rounds += 1
+            self._catchup_rounds += 1
+            rounds = self._catchup_rounds
+        from geomx_tpu.utils.metrics import system_counter
+
+        system_counter(f"{self.po.node}.degraded_rounds").inc()
+        if self.hfa_enabled:
+            self._catchup_invalid = True
+        else:
+            with self._mu:
+                for k, v in kvs.slices():
+                    k = int(k)
+                    prev = self._catchup.get(k)
+                    if prev is None:
+                        self._catchup[k] = np.array(v, dtype=np.float32,
+                                                    copy=True)
+                    else:
+                        prev += v.astype(np.float32)
+        if self._flight is not None:
+            self._flight.record(FlightEv.ROUND_COMPLETE, a=len(keys),
+                                b=rounds, note="degraded")
+        self._finish_round(keys)
+
+    def _ship_catchup(self) -> Optional[int]:
+        """Heal path (REJOIN with surviving state): ship the
+        accumulated delta as ONE staleness-stamped Cmd.CATCHUP push —
+        the global tier merges it through the normal optimizer path
+        (DC-ASGD compensates the staleness) — and return the key
+        count.  Returns None when the delta is not trustworthy (HFA
+        rounds, or more degraded rounds than
+        Config.partition_catchup_bound): the caller dense-boots
+        instead.  Fresh weights are NOT pulled here; the next normal
+        round's pull-down refreshes them as ordinary training traffic,
+        which is what keeps the heal cost at a fraction of a dense
+        resync."""
+        with self._mu:
+            delta = self._catchup
+            rounds = self._catchup_rounds
+            since = self._catchup_since
+            invalid = self._catchup_invalid
+            self._catchup = {}
+            self._catchup_rounds = 0
+            self._catchup_since = None
+            self._catchup_invalid = False
+            self._degraded = False  # cleared BEFORE shipping so the
+            #                         catch-up push is not diverted
+        if not delta and rounds == 0:
+            return 0
+        bound = int(self.config.partition_catchup_bound)
+        from geomx_tpu.utils.metrics import system_counter
+
+        if invalid or rounds > bound:
+            self.catchup_fallbacks += 1
+            system_counter(
+                f"{self.po.node}.partition_catchup_fallbacks").inc()
+            if self._flight is not None:
+                self._flight.record(FlightEv.NETFAULT, a=len(delta),
+                                    b=rounds,
+                                    note="netfault_catchup_fallback")
+            why = ("HFA weight-mean rounds" if invalid else
+                   f"{rounds} degraded rounds > bound {bound}")
+            print(f"{self.po.node}: catch-up delta not trustworthy "
+                  f"({why}) — dense resync instead", flush=True)
+            return None
+        ks = sorted(delta)
+        kvs = KVPairs(np.array(ks, dtype=np.int64),
+                      np.concatenate([delta[k] for k in ks]),
+                      np.array([len(delta[k]) for k in ks],
+                               dtype=np.int64))
+        age = time.monotonic() - since if since is not None else 0.0
+        body = {"catchup": {"rounds": rounds, "age_s": round(age, 3)}}
+        groups = self._encode_wan_groups(kvs)
+        remaining = [len(groups)]
+        done = threading.Event()
+        lock = threading.Lock()
+
+        def acked():
+            with lock:
+                remaining[0] -= 1
+                if remaining[0] == 0:
+                    done.set()
+
+        for tag, pairs in groups.items():
+            ks2 = np.array([k for k, _ in pairs], dtype=np.int64)
+            vals2 = (pairs[0][1] if len(pairs) == 1
+                     else np.concatenate([p for _, p in pairs]))
+            lens2 = np.array([len(p) for _, p in pairs], dtype=np.int64)
+            self.up.zpush(KVPairs(ks2, vals2, lens2), cmd=Cmd.CATCHUP,
+                          on_complete=acked, compr=tag, body=dict(body),
+                          donated=True)
+        if not done.wait(60.0):
+            raise TimeoutError(
+                f"{self.po.node}: catch-up push not acked; the "
+                "recovery monitor re-asks")
+        self.catchup_pushes += 1
+        system_counter(f"{self.po.node}.partition_catchup_pushes").inc()
+        if self._flight is not None:
+            self._flight.record(FlightEv.NETFAULT, a=len(ks), b=rounds,
+                                note="netfault_catchup_push")
+        print(f"{self.po.node}: healed — shipped catch-up delta "
+              f"({len(ks)} keys, {rounds} degraded rounds, "
+              f"{age:.1f}s stale); fresh weights ride the next round's "
+              "pull-down", flush=True)
+        return len(ks)
 
     def _on_preempt(self, msg: Message) -> bool:
         """Control.PREEMPT_NOTICE request: this local server's host is
@@ -1409,9 +1687,15 @@ class LocalServer:
 
     def _push_up_send(self, kvs: KVPairs, rs_keys=frozenset(),
                       push_body=None):
+        keys = [int(k) for k in kvs.keys]
+        if self._degraded:
+            # the WAN uplink is dark (partition mode): the round stays
+            # in the party — accumulate the merged gradient into the
+            # catch-up delta and finish against the frozen weights
+            self._absorb_degraded_round(kvs, keys)
+            return
         if self._prof.running:
             self._prof.count("wan_rounds", 1.0)
-        keys = [int(k) for k in kvs.keys]
         raw = None
         if self._adaptive:
             with self._mu:
@@ -1426,6 +1710,11 @@ class LocalServer:
         with self._ctr_mu:  # rounds of disjoint keys dispatch from
             self.wan_push_rounds += 1  # parallel lanes
             wan_round = self.wan_push_rounds
+            if self._wan_inflight == 0:
+                # degrade watchdog: the window opens at the FIRST
+                # outstanding batch only — later dispatches piling up
+                # behind a dark uplink must not keep resetting it
+                self._wan_progress_t = time.monotonic()
             self._wan_inflight += 1  # decremented when the batch's
             #                          groups are all acked (the
             #                          preempt drain waits on zero)
@@ -1494,9 +1783,14 @@ class LocalServer:
             with lock:
                 remaining[0] -= 1
                 done = remaining[0] == 0
+            with self._ctr_mu:
+                # every group ack is WAN progress for the degrade
+                # watchdog; the clamp absorbs acks from batches a
+                # degrade entry already abandoned
+                self._wan_progress_t = time.monotonic()
+                if done:
+                    self._wan_inflight = max(0, self._wan_inflight - 1)
             if done:
-                with self._ctr_mu:
-                    self._wan_inflight -= 1
                 pull_down()
 
         for tag, pairs in groups.items():
@@ -2121,6 +2415,13 @@ class LocalServer:
             "joined_workers": self.joined_workers,
             "left_workers": self.left_workers,
             "preempt_server_drains": self.preempt_server_drains,
+            # partition-tolerance observability (quarantine-not-evict)
+            "degraded": self._degraded,
+            "degraded_rounds": self.degraded_rounds,
+            "catchup_pending_rounds": self._catchup_rounds,
+            "catchup_pushes": self.catchup_pushes,
+            "catchup_fallbacks": self.catchup_fallbacks,
+            "quarantined_workers": len(self._quarantined_members),
             "mpq_bsc_picks": getattr(self.push_codec, "bsc_picks", 0),
             "mpq_fp16_picks": getattr(self.push_codec, "fp16_picks", 0),
             "pq_overtakes": van.pq_overtakes,
@@ -2212,6 +2513,8 @@ class LocalServer:
         return results
 
     def stop(self):
+        if self._degrade_ticker is not None:
+            self._degrade_ticker.stop()
         if self.ts_client is not None:
             self.ts_client.stop()
         if self.ts_inter is not None:
@@ -2330,6 +2633,7 @@ class GlobalServer:
         self._policy_epoch = 0
         self.policy_fenced_pushes = 0
         self.rejected_compr_tags = 0
+        self.catchup_merges = 0  # healed-party Cmd.CATCHUP deltas merged
         # per-endpoint stateful-decoder cache (replaces the process-wide
         # _TWOBIT_DECODERS dict two concurrent Simulations used to share)
         from geomx_tpu.compression import DecoderBank
@@ -2642,7 +2946,13 @@ class GlobalServer:
         if msg.push and msg.compr and kvs is not None:
             kvs = self._decompress_push(msg, kvs)
         if msg.push:
-            if self.sync_mode:
+            if msg.cmd == Cmd.CATCHUP:
+                # partition heal: a quarantined party's bounded degraded-
+                # round delta — merged through the optimizer, but NEVER
+                # part of sync-round accounting (the party was folded
+                # out; survivors' rounds already closed without it)
+                self._push_catchup(msg, kvs)
+            elif self.sync_mode:
                 self._push_sync(msg, kvs)
             else:
                 self._push_async(msg, kvs)
@@ -3035,6 +3345,60 @@ class GlobalServer:
             self.server.response(msg)
         if dissem is not None:
             self.ts_inter.disseminate_async(*dissem, Cmd.TS_AUTOPULL)
+
+    def _push_catchup(self, msg: Message, kvs: KVPairs):
+        """Merge a healed party's staleness-stamped catch-up delta
+        (Cmd.CATCHUP) through the SAME optimizer path as a live async
+        push — DC-ASGD's per-sender backup compensates the staleness
+        exactly as it would for a slow party — WITHOUT advancing sync-
+        round accounting or the timestamp overlay: the quarantined
+        party was folded out of those rounds, and replaying it into
+        them would stall survivors waiting on a contributor that
+        already left.  Bypasses the adaptive policy-epoch fence by
+        construction (``_reject_bad_push`` only fences Cmd.DEFAULT):
+        the delta was encoded under the healing party's last-known
+        policy, and a refusal here would discard the partition's entire
+        surviving progress over a codec-parameter quibble."""
+        state = self._recent.check(msg)
+        if state == "pending":
+            return
+        if state == "done":
+            self.server.response(msg, body=self._recent.done_body(msg))
+            return
+        meta = (msg.body or {}).get("catchup", {}) \
+            if isinstance(msg.body, dict) else {}
+        rounds = int(meta.get("rounds", 0))
+        with self._mu:
+            for k, v in kvs.slices():
+                k = int(k)
+                if k not in self.store:
+                    continue  # key retired while the party was dark
+                grad = v.astype(np.float32)
+                if self._dev_opt is not None:
+                    self.store[k] = self._dev_opt.step(
+                        k, self.store.raw(k), grad, 1.0)
+                elif isinstance(self.optimizer, DCASGD):
+                    self.store[k] = self.optimizer.update(
+                        k, self.store[k], grad, sender=str(msg.sender))
+                else:
+                    self.store[k] = self.optimizer.update_scaled(
+                        k, self.store[k], grad, 1.0)
+            self.catchup_merges += 1
+            self._auto_ckpt_locked(len(kvs.keys))
+            if self._repl is not None:
+                self._repl.mark_locked(len(kvs.keys))
+        from geomx_tpu.utils.metrics import system_counter
+
+        system_counter(f"{self.po.node}.partition_catchup_merges").inc()
+        if self._flight is not None:
+            self._flight.record(FlightEv.NETFAULT, a=len(kvs.keys),
+                                b=rounds, peer=msg.sender,
+                                note="netfault_catchup_merge")
+        print(f"{self.po.node}: merged catch-up delta from "
+              f"{msg.sender} ({len(kvs.keys)} keys, {rounds} degraded "
+              f"rounds, {meta.get('age_s', 0)}s stale)", flush=True)
+        self._recent.mark_done(msg)
+        self.server.response(msg)
 
     # ---- pulls --------------------------------------------------------------
     def _pull(self, msg: Message, kvs: KVPairs):
@@ -3841,6 +4205,8 @@ class GlobalServer:
             "party_folds": self.party_folds,
             "party_unfolds": self.party_unfolds,
             "num_global_workers": self.num_contributors,
+            # partition heals merged through the optimizer (Cmd.CATCHUP)
+            "catchup_merges": self.catchup_merges,
             # adaptive WAN: receiver-side epoch + fence observables
             "policy_epoch": self._policy_epoch,
             "policy_fenced_pushes": self.policy_fenced_pushes,

@@ -363,6 +363,20 @@ class Simulation:
         return [self.workers[str(w)] for w in self.topology.all_workers()]
 
     # ---- targeted fault injection ---------------------------------------
+    def _stamp_netfault(self, note: str, target, extra: int = 0):
+        """Every injected cut/heal lands in the global scheduler's
+        flight ring (FlightEv.NETFAULT) — postmortems separate INJECTED
+        partitions from organic silence the same way CHURN events
+        separate injected kills from crashes."""
+        po = self.offices.get(str(self.topology.global_scheduler()))
+        fl = getattr(po, "flight", None) if po is not None else None
+        if fl is not None:
+            from geomx_tpu.obs.flight import FlightEv
+
+            fl.record(FlightEv.NETFAULT, a=extra,
+                      peer=None if target is None else str(target),
+                      note=note)
+
     def partition(self, a, b="*", symmetric: bool = True):
         """Cut the link a→b (both directions unless ``symmetric=False``)
         at the fabric, CONTROL TRAFFIC INCLUDED — heartbeats starve, so
@@ -371,12 +385,65 @@ class Simulation:
         single argument isolates exactly that node's links — what the
         shard-failure and split-brain soaks use instead of approximating
         with a global drop_rate."""
-        self.fabric.fault.partition(str(a), str(b), symmetric=symmetric)
+        from geomx_tpu.utils.metrics import system_counter
 
-    def heal(self, a=None, b=None):
-        """Undo :meth:`partition` cuts (all of them with no args)."""
+        self.fabric.fault.partition(str(a), str(b), symmetric=symmetric)
+        gsched = str(self.topology.global_scheduler())
+        system_counter(f"{gsched}.partition_cuts").inc()
+        self._stamp_netfault("netfault_cut", a)
+
+    def heal(self, a=None, b=None, symmetric: bool = True):
+        """Undo :meth:`partition` cuts (all of them with no args;
+        ``symmetric=False`` restores only the a→b direction)."""
+        from geomx_tpu.utils.metrics import system_counter
+
         self.fabric.fault.heal(None if a is None else str(a),
-                               None if b is None else str(b))
+                               None if b is None else str(b),
+                               symmetric=symmetric)
+        gsched = str(self.topology.global_scheduler())
+        system_counter(f"{gsched}.partition_heals").inc()
+        self._stamp_netfault("netfault_heal", a)
+
+    def _wan_peers_of(self, party: int) -> List[str]:
+        """The WAN-side endpoints of one party's local server: the
+        global tier plus every OTHER party's server (inter-party TS
+        relays) — everything a region-scoped blackhole must cut while
+        leaving the party's own LAN intact."""
+        t = self.topology
+        peers = [str(t.global_scheduler())]
+        peers += [str(n) for n in t.global_servers()]
+        peers += [str(n) for n in t.standby_globals()]
+        peers += [str(t.server(p)) for p in range(t.num_parties)
+                  if p != party]
+        return peers
+
+    def partition_party(self, party: int, symmetric: bool = True):
+        """Region outage: blackhole ``party``'s WAN uplink (its local
+        server ↔ the global tier and every other party) while the
+        party-internal LAN keeps working — workers keep pushing, the
+        server keeps merging, only the up-stream goes dark.  This is
+        the partition-tolerance soak's primary fault (ROADMAP item 5's
+        "blackhole a whole region")."""
+        srv = str(self.topology.server(party))
+        self.fabric.fault.blackhole(srv, self._wan_peers_of(party),
+                                    symmetric=symmetric)
+        from geomx_tpu.utils.metrics import system_counter
+
+        gsched = str(self.topology.global_scheduler())
+        system_counter(f"{gsched}.partition_cuts").inc()
+        self._stamp_netfault("netfault_cut", srv, extra=party)
+
+    def heal_party(self, party: int):
+        """Undo :meth:`partition_party` — both directions of every WAN
+        pair come back at once (a real uplink heal)."""
+        srv = str(self.topology.server(party))
+        for p in self._wan_peers_of(party):
+            self.fabric.fault.heal(srv, p)
+        from geomx_tpu.utils.metrics import system_counter
+
+        gsched = str(self.topology.global_scheduler())
+        system_counter(f"{gsched}.partition_heals").inc()
+        self._stamp_netfault("netfault_heal", srv, extra=party)
 
     def set_duplicate_rate(self, rate: float):
         """Message-duplication injection: each data message is

@@ -242,6 +242,12 @@ def build_runtime(node: NodeId, config: Config, base_port: int = 9200,
         po.metrics_pump = MetricsPump(
             po, config, stats_fn=stats_fn,
             collector=getattr(po, "metrics_collector", None))
+    # scripted link faults (GEOMX_NETFAULT_PLAN): a JSON tape of WAN
+    # cuts/heals applied to THIS process's fabric fault policy — the
+    # partition demo's in-fabric blackhole (no iptables, no root)
+    from geomx_tpu.chaos import install_env_netfaults
+
+    install_env_netfaults(po)
     if advertise is not None:
         announce_address(po, *advertise)
     return po, role_obj, stop_ev

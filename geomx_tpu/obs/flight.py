@@ -99,6 +99,16 @@ class FlightEv(enum.IntEnum):
     #                      attribute stalls to INJECTED vs organic
     #                      faults by joining these with the fold/evict
     #                      timeline
+    NETFAULT = 21        # partition-tolerance transition (chaos/netfault
+    #                      injection + kvstore quarantine machinery):
+    #                      note=netfault_{cut,heal,quarantine,
+    #                      unquarantine,degraded,catchup_merge,
+    #                      catchup_fallback}, peer=the affected node/
+    #                      party server; a=context int (keys merged,
+    #                      party id, ...), b=rounds accumulated —
+    #                      postmortems can separate INJECTED cuts from
+    #                      organic silence and audit every quarantine
+    #                      state-machine edge without logs
 
 
 _EV_NAMES = {int(e): e.name for e in FlightEv}

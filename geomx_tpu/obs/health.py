@@ -52,6 +52,13 @@ Rules (thresholds are ``Config.obs_*`` knobs):
   ``obs_replica_flap`` within the window: the scaling signals are
   oscillating faster than the hysteresis can follow — widen the
   deadband or lengthen the cooldown.
+- **net_partition** — some monitor's ``quarantined_nodes`` gauge is
+  nonzero: a node/party is heartbeat-dead but an indirect probe still
+  hears it, so it was folded out REVERSIBLY instead of evicted
+  (docs/deployment.md "Partition tolerance").  Training is running
+  degraded; the alert recovers when the partition heals (or escalates
+  into eviction/fold events, which page through fence_spike /
+  churn_storm instead).
 """
 
 from __future__ import annotations
@@ -76,7 +83,7 @@ _FENCE_KEYS = ("eviction_fenced_pushes", "fenced_rejects",
 RULES = ("round_stall", "replication_lag", "shard_imbalance",
          "goodput_collapse", "rtt_outlier", "fence_spike",
          "replica_staleness", "churn_storm", "serve_overload",
-         "replica_flap")
+         "replica_flap", "net_partition")
 
 # membership-transition counters summed by the churn_storm rule: the
 # churn orchestrator's injected-event family (registered on the global
@@ -177,7 +184,8 @@ class HealthEngine:
                      self._rule_shard_imbalance, self._rule_goodput_collapse,
                      self._rule_rtt_outlier, self._rule_fence_spike,
                      self._rule_replica_staleness, self._rule_churn_storm,
-                     self._rule_serve_overload, self._rule_replica_flap):
+                     self._rule_serve_overload, self._rule_replica_flap,
+                     self._rule_net_partition):
             try:
                 records.extend(rule(now))
             except Exception:  # one broken rule must not mute the rest
@@ -541,6 +549,32 @@ class HealthEngine:
             message=f"{total:.0f} suppressed direction reversals in "
                     f"the window (threshold {self.replica_flap})",
             reversals=total, threshold=self.replica_flap)
+        return [rec] if rec else []
+
+    def _rule_net_partition(self, now: float) -> List[dict]:
+        """A nonzero ``quarantined_nodes`` gauge (shipped by the party
+        schedulers' worker monitors and the global scheduler's recovery
+        monitor) means the quarantine-not-evict machinery is holding a
+        suspect in limbo: heartbeats expired but an indirect probe
+        still hears it.  Degraded but self-healing — the alert clears
+        on heal (unquarantine) or when the escalation paths (eviction /
+        party fold) take over."""
+        total = 0.0
+        seen = False
+        for node in self.collector.nodes():
+            v = self.collector.value(node, "quarantined_nodes")
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                seen = True
+                total += float(v)
+        if not seen:
+            return []
+        rec = self._set_state(
+            "net_partition", "cluster", total > 0, now,
+            message=(f"{total:.0f} node(s)/part(ies) quarantined — "
+                     "heartbeat-dead but probe-alive; training runs "
+                     "degraded until the partition heals" if total > 0
+                     else "all quarantines lifted"),
+            quarantined=total)
         return [rec] if rec else []
 
     def _rule_replica_staleness(self, now: float) -> List[dict]:

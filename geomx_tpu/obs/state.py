@@ -179,16 +179,20 @@ class ClusterStateService:
 
         rm = self.recovery_monitor
         folded = set(rm._folded) if rm is not None else set()
+        quarantined = set(getattr(rm, "_quarantined", ())) \
+            if rm is not None else set()
         parties = {}
         for p in range(topo.num_parties):
             server = str(topo.server(p))
             entry = {"server": server, "folded": p in folded,
+                     "quarantined": p in quarantined,
                      "alive": nodes.get(server, {}).get("alive"),
                      "workers": topo.workers_per_party}
             if self.collector is not None:
                 st = self.collector.latest_stats(server) or {}
                 for key in ("wan_push_rounds", "policy_epoch", "uptime_s",
-                            "merge_backend"):
+                            "merge_backend", "degraded",
+                            "degraded_rounds", "quarantined_workers"):
                     if key in st:
                         entry[key] = st[key]
                 press = self._pressure_of(server)
@@ -357,6 +361,15 @@ def render_text(state: dict) -> str:
     for p in sorted(parties, key=int):
         e = parties[p]
         extra = " FOLDED-OUT" if e.get("folded") else ""
+        if e.get("quarantined"):
+            # heartbeat-dead but probe-alive: folded out REVERSIBLY
+            # (never alongside FOLDED-OUT — escalation moves the party
+            # from one set to the other)
+            extra += " QUARANTINED"
+        if e.get("degraded"):
+            extra += f" DEGRADED({int(e.get('degraded_rounds', 0))}r)"
+        if e.get("quarantined_workers"):
+            extra += f" qworkers={int(e['quarantined_workers'])}"
         if e.get("wan_push_rounds") is not None:
             extra += f" wan_rounds={int(e['wan_push_rounds'])}"
         if e.get("merge_backend"):

@@ -108,6 +108,8 @@ class Postoffice:
         # uses to merge per-node span timestamps onto one timeline
         self._clock_offsets: Dict[str, float] = {}
         self._hb_rtts: Dict[str, float] = {}
+        self._hb_echo_t: Dict[str, float] = {}  # last echo arrival per
+        #                                         scheduler (monotonic)
         self._rtt_gauge = None
         self._offset_gauge = None
         self._tracer = None
@@ -115,6 +117,10 @@ class Postoffice:
         # eviction monitor stop counting toward barrier quorums, so FSA
         # degrades to the survivor set instead of timing out
         self._excluded: set = set()
+        # SWIM-style indirect-probe relays in flight FROM this node
+        # (Control.PROBE_INDIRECT): relay token -> Event set when the
+        # suspect's pong lands (kvstore/eviction.py drives these)
+        self._probe_pending: Dict[str, threading.Event] = {}
         self._started = False
         # black-box flight recorder (geomx_tpu/obs/flight): DEFAULT ON —
         # a fixed-size per-node event ring tapped by the van (message
@@ -330,6 +336,21 @@ class Postoffice:
         with self._lock:
             return dict(self._hb_rtts)
 
+    def heartbeat_echo_age(self, sched) -> float:
+        """Seconds since the last heartbeat ECHO arrived from scheduler
+        ``sched`` (age since this postoffice's start when none ever
+        did).  The liveness view in the OTHER direction from
+        :meth:`dead_nodes`: a non-scheduler node asking "can I still
+        hear my scheduler?" — the degraded-mode watchdog's second
+        opinion that a silent WAN link is a partition and not merely a
+        slow round (kvstore/server.py)."""
+        import time as _time
+
+        now = _time.monotonic()
+        with self._lock:
+            base = self._hb_epoch if self._started else now
+            return now - self._hb_echo_t.get(str(sched), base)
+
     def query_dead_nodes(self, timeout: float = 10.0) -> List[str]:
         """Ask my scheduler for its dead-node list
         (ref: kv.get_num_dead_node kvstore_dist.h:225-234)."""
@@ -399,6 +420,7 @@ class Postoffice:
                 with self._lock:
                     self._hb_rtts[str(msg.sender)] = rtt
                     self._clock_offsets[str(msg.sender)] = offset
+                    self._hb_echo_t[str(msg.sender)] = now
                     if self._rtt_gauge is None:
                         from geomx_tpu.utils.metrics import system_gauge
 
@@ -433,6 +455,12 @@ class Postoffice:
             if update is not None:
                 update(b["node"], (b["host"], int(b["port"])))
             return
+        if msg.control is Control.PROBE_INDIRECT:
+            if self._handle_probe_indirect(msg):
+                return
+            # not consumed: a relay's {alive} verdict falls through to
+            # the control hooks — the monitor's actuator collects it by
+            # token exactly like EVICT/REJOIN replies
         if msg.control is not Control.EMPTY:
             with self._lock:
                 hooks = list(self._control_hooks)
@@ -452,6 +480,70 @@ class Postoffice:
                 f"request={msg.request} for message from {msg.sender}"
             )
         cust.accept(msg)
+
+    # ---- SWIM-style indirect probes (Control.PROBE_INDIRECT) ---------------
+    def _handle_probe_indirect(self, msg: Message) -> bool:
+        """Three legs, all stateless beyond ``_probe_pending``:
+
+        * request ``{ping}`` → answer ``{pong}`` inline (pure liveness
+          — nothing else is touched, so a quarantined node still pongs);
+        * request ``{suspect, timeout}`` → relay a ping to the suspect
+          on a short-lived thread (the van send + wait would block the
+          dispatch/handler thread — reactor-blocking lint) and reply
+          ``{alive, suspect, token}`` to the asking monitor;
+        * response ``{pong}`` → complete the pending relay by token.
+
+        Returns False for the one leg it does NOT consume: an ``{alive}``
+        relay verdict, which the monitor's control hook collects."""
+        b = msg.body if isinstance(msg.body, dict) else {}
+        if msg.request and b.get("ping"):
+            try:
+                self.van.send(msg.reply_to(body={"pong": True,
+                                                 "token": b.get("token")}))
+            except (KeyError, OSError):
+                pass  # asker vanished between ping and pong
+            return True
+        if msg.request and "suspect" in b:
+            t = threading.Thread(
+                target=self._relay_probe, args=(msg,),
+                name=f"probe-relay-{self.node}", daemon=True)
+            t.start()
+            return True
+        if not msg.request and "pong" in b:
+            with self._lock:
+                ev = self._probe_pending.get(b.get("token"))
+            if ev is not None:
+                ev.set()
+            return True
+        return False
+
+    def _relay_probe(self, msg: Message):
+        import uuid
+
+        b = msg.body if isinstance(msg.body, dict) else {}
+        timeout = float(b.get("timeout") or self.config.probe_timeout_s)
+        token = f"{self.node}#probe-{uuid.uuid4().hex[:8]}"
+        ev = threading.Event()
+        with self._lock:
+            self._probe_pending[token] = ev
+        alive = False
+        try:
+            self.van.send(Message(
+                recipient=NodeId.parse(str(b["suspect"])),
+                control=Control.PROBE_INDIRECT, domain=msg.domain,
+                request=True, body={"ping": True, "token": token}))
+            alive = ev.wait(timeout)
+        except (KeyError, OSError):
+            alive = False  # no route to the suspect = dead from here
+        finally:
+            with self._lock:
+                self._probe_pending.pop(token, None)
+        try:
+            self.van.send(msg.reply_to(
+                body={"alive": bool(alive), "suspect": str(b["suspect"]),
+                      "token": b.get("token")}))
+        except (KeyError, OSError):
+            pass  # the asking monitor vanished mid-probe
 
     # ---- barriers -----------------------------------------------------------
     def _scheduler_for(self, group: Group) -> NodeId:

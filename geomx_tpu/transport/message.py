@@ -98,6 +98,18 @@ class Control(enum.Enum):
     #                    drain window; {event: "server_drained", party,
     #                    node, boot} tells the recovery monitor the fold
     #                    already happened so the rejoin path arms
+    PROBE_INDIRECT = 19  # SWIM-style indirect probe (partition-vs-crash
+    #                    disambiguation, requires Config.
+    #                    enable_partition_mode).  As a REQUEST with
+    #                    body {suspect, timeout} to a peer: relay a ping
+    #                    to the suspect on my behalf and reply
+    #                    {alive, suspect, token}.  As a request with
+    #                    body {ping: true}: answer {pong: true} inline
+    #                    (liveness only — no state touched).  A monitor
+    #                    whose direct heartbeat view expired but whose
+    #                    indirect probes still hear the suspect
+    #                    QUARANTINES instead of evicting (kvstore/
+    #                    eviction.py; docs/deployment.md)
 
 
 class Domain(enum.Enum):

@@ -105,10 +105,14 @@ class FaultPolicy:
             if symmetric:
                 self._cuts.add((b, a))
 
-    def heal(self, a: Optional[str] = None, b: Optional[str] = None):
+    def heal(self, a: Optional[str] = None, b: Optional[str] = None,
+             symmetric: bool = True):
         """Remove cuts.  No arguments heals everything; ``heal(a)``
         heals every cut naming ``a`` on either side; ``heal(a, b)``
-        heals that pair (both directions)."""
+        heals that pair — both directions by default, only the a→b
+        direction with ``symmetric=False`` (the asymmetric-cut inverse:
+        a one-way cut healed one way, or one leg of a full cut restored
+        while the other stays dark)."""
         with self._lock:
             if a is None:
                 self._cuts.clear()
@@ -119,7 +123,16 @@ class FaultPolicy:
             else:
                 b = str(b)
                 self._cuts.discard((a, b))
-                self._cuts.discard((b, a))
+                if symmetric:
+                    self._cuts.discard((b, a))
+
+    def blackhole(self, node: str, peers, symmetric: bool = True):
+        """Cut ``node``'s links to every peer in ``peers`` — the party/
+        region-scoped blackhole (one WAN uplink dies, the LAN behind it
+        keeps working) that a bare wildcard ``partition(node, "*")``
+        cannot express without also cutting intra-party traffic."""
+        for p in peers:
+            self.partition(node, p, symmetric=symmetric)
 
     def is_cut(self, msg: Message) -> bool:
         if not self._cuts:

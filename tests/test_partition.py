@@ -1,0 +1,591 @@
+"""Partition-tolerant geo-training (ISSUE 16): a WAN cut must be
+QUARANTINED, not evicted.
+
+The eviction machinery (PR 2) reads heartbeat silence as death — right
+for crashes, wrong for partitions: a region whose WAN uplink goes dark
+still has every process running, and evicting it throws away its state
+and its in-flight progress.  This file covers the detection matrix
+(asymmetric cut → quarantine; full blackhole → the legacy eviction,
+untouched), degraded-mode rounds behind the cut, the staleness-stamped
+catch-up re-merge on heal (bitwise continuity), the dense fallback past
+``Config.partition_catchup_bound``, the flag-off guard, and the scripted
+``NetFaultPlan`` fault tape.  Fast tests run under BOTH the threads
+harness and the lightweight reactor dispatch path; the 30 s asymmetric
+region-outage soak with loss parity is marked slow.
+"""
+
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from geomx_tpu.core.config import Config, Topology
+from geomx_tpu.kvstore import Simulation
+from geomx_tpu.utils.metrics import system_snapshot
+
+pytestmark = pytest.mark.chaos
+
+# the quarantine/degrade windows shake under the thread-per-endpoint
+# harness AND the shared-reactor serial-dispatch path
+TRANSPORTS = [pytest.param(False, id="threads"),
+              pytest.param(True, id="reactor")]
+
+
+def _cfg(parties=1, workers=2, **kw):
+    kw.setdefault("heartbeat_interval_s", 0.05)
+    kw.setdefault("heartbeat_timeout_s", 0.4)
+    kw.setdefault("enable_partition_mode", True)
+    kw.setdefault("probe_timeout_s", 0.4)
+    return Config(topology=Topology(num_parties=parties,
+                                    workers_per_party=workers), **kw)
+
+
+def _wait_for(pred, timeout=20.0, every=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+def _delta(base, snap, key):
+    """System counters are process-global; tests assert DELTAS so any
+    earlier chaos test in the same pytest process can't bleed in."""
+    return snap.get(key, 0) - base.get(key, 0)
+
+
+def _msg(sender, recipient):
+    return types.SimpleNamespace(sender=sender, recipient=recipient)
+
+
+# ---------------------------------------------------------------------------
+# fault-injection surface
+# ---------------------------------------------------------------------------
+
+
+def test_fault_policy_heals_a_single_direction():
+    """Satellite: ``FaultPolicy.heal(a, b, symmetric=False)`` restores
+    only the a→b leg of a cut — the asymmetric-cut inverse (one leg of
+    a full partition healed while the other stays dark)."""
+    from geomx_tpu.transport.van import FaultPolicy
+
+    fp = FaultPolicy()
+    fp.partition("a", "b")  # symmetric: both legs dark
+    assert fp.is_cut(_msg("a", "b")) and fp.is_cut(_msg("b", "a"))
+    fp.heal("a", "b", symmetric=False)
+    assert not fp.is_cut(_msg("a", "b")), "healed leg still cut"
+    assert fp.is_cut(_msg("b", "a")), "symmetric=False healed both legs"
+    fp.heal("b", "a", symmetric=False)
+    assert not fp.is_cut(_msg("b", "a"))
+    # ...and the one-argument wildcard clears every cut naming the node
+    fp.partition("a", "b", symmetric=False)
+    fp.partition("c", "a", symmetric=False)
+    fp.heal("a")
+    assert not fp.is_cut(_msg("a", "b")) and not fp.is_cut(_msg("c", "a"))
+
+
+def test_netfault_plan_tape_is_seed_deterministic():
+    """The scripted fault tape is pre-expanded and seeded like a
+    ChurnPlan: same seed → the SAME cut/heal instants (a flaky soak
+    reproduces), different seed → different flap jitter."""
+    from geomx_tpu.chaos import NetFaultPhase, NetFaultPlan
+
+    phases = (NetFaultPhase(at_s=1.0, duration_s=2.0, party=0),
+              NetFaultPhase(at_s=4.0, duration_s=6.0, kind="flap",
+                            party=1, period_s=2.0, duty=0.5))
+    a = NetFaultPlan(phases, seed=7).schedule()
+    b = NetFaultPlan(phases, seed=7).schedule()
+    c = NetFaultPlan(phases, seed=8).schedule()
+    assert a == b, "same seed produced a different tape"
+    assert a != c, "flap jitter ignored the seed"
+    # the tape is time-sorted and cut/heal balanced per phase
+    assert [t for t, _, _ in a] == sorted(t for t, _, _ in a)
+    cuts = sum(1 for _, act, _ in a if act == "cut")
+    heals = sum(1 for _, act, _ in a if act == "heal")
+    assert cuts == heals >= 4  # plain pair + >= 3 flap periods
+    with pytest.raises(ValueError, match="asym_cut"):
+        NetFaultPhase(at_s=0, duration_s=1, kind="asym_cut")
+
+
+# ---------------------------------------------------------------------------
+# detection matrix: quarantine vs the legacy eviction
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("lightweight", TRANSPORTS)
+def test_asymmetric_cut_quarantines_worker_not_evicts(lightweight):
+    """A worker whose heartbeats stop reaching the scheduler — but whom
+    the party server still hears (the indirect probe) — is quarantined:
+    folded out reversibly, incarnation NOT fenced, membership restored
+    verbatim the moment heartbeats resume.  The survivor's rounds close
+    at the lowered target meanwhile."""
+    sim = Simulation(_cfg(), lightweight=lightweight)
+    base = system_snapshot()
+    try:
+        w0, w1 = sim.all_workers()
+        for w in (w0, w1):
+            w.init(0, np.zeros(8, np.float32))
+        w0.set_optimizer({"type": "sgd", "lr": 1.0})
+        for w in (w0, w1):
+            w.push(0, np.ones(8, np.float32))
+        np.testing.assert_allclose(w0.pull_sync(0),
+                                   -2 * np.ones(8, np.float32))
+        for w in (w0, w1):
+            w.wait_all()
+
+        # the gray failure: only the worker→scheduler direction dies
+        sched = str(sim.topology.scheduler(0))
+        sim.partition("worker:1@p0", sched, symmetric=False)
+        mon = sim.eviction_monitors[0]
+        assert _wait_for(lambda: mon.quarantines == 1), \
+            (mon.quarantines, mon.evictions)
+        assert mon.evictions == 0, "partition was treated as a crash"
+        ls = sim.local_servers[0]
+        assert "worker:1@p0" in ls._quarantined_members
+
+        # survivor rounds close at the lowered target
+        w0.push(0, np.ones(8, np.float32))
+        np.testing.assert_allclose(w0.pull_sync(0),
+                                   -3 * np.ones(8, np.float32))
+
+        # heal: heartbeats resume → quarantine lifts, rank restored —
+        # no rejoin door, no fresh incarnation
+        sim.heal("worker:1@p0", sched, symmetric=False)
+        assert _wait_for(lambda: not mon._quarantined)
+        assert _wait_for(lambda: "worker:1@p0" not in
+                         ls._quarantined_members)
+        # the quarantined incarnation was never fenced: its next push
+        # merges (both members → a full round)
+        for w in (w0, w1):
+            w.push(0, np.ones(8, np.float32))
+        np.testing.assert_allclose(w0.pull_sync(0),
+                                   -5 * np.ones(8, np.float32))
+        for w in (w0, w1):
+            w.wait_all()
+        assert mon.evictions == 0 and ls.evicted_workers == 0
+        assert ls.eviction_fenced_pushes == 0, "quarantine fenced"
+
+        snap = system_snapshot()
+        assert _delta(base, snap,
+                      "scheduler:0@p0.partition_quarantines") == 1
+        assert _delta(base, snap, "scheduler:0@p0.worker_evictions") == 0
+        assert snap.get("scheduler:0@p0.quarantined_nodes") == 0
+    finally:
+        sim.shutdown()
+
+
+def test_full_blackhole_still_evicts():
+    """The legacy path is untouched by partition mode: a worker cut
+    from EVERYONE (probes dark too — indistinguishable from a crash)
+    is evicted, fence and all."""
+    sim = Simulation(_cfg())
+    try:
+        w0, w1 = sim.all_workers()
+        for w in (w0, w1):
+            w.init(0, np.zeros(8, np.float32))
+        w0.set_optimizer({"type": "sgd", "lr": 1.0})
+        for w in (w0, w1):
+            w.push(0, np.ones(8, np.float32))
+        np.testing.assert_allclose(w0.pull_sync(0),
+                                   -2 * np.ones(8, np.float32))
+        for w in (w0, w1):
+            w.wait_all()
+
+        sim.partition("worker:1@p0")  # wildcard: every link, both ways
+        mon = sim.eviction_monitors[0]
+        assert _wait_for(lambda: mon.evictions == 1, 30), \
+            (mon.evictions, mon.quarantines)
+        assert mon._quarantined == {}, "a dead node stayed quarantined"
+        # survivor rounds fold to the survivor set (the PR 2 contract)
+        w0.push(0, np.ones(8, np.float32))
+        np.testing.assert_allclose(w0.pull_sync(0),
+                                   -3 * np.ones(8, np.float32))
+    finally:
+        sim.shutdown()
+
+
+@pytest.mark.parametrize("lightweight", TRANSPORTS)
+def test_partition_mode_off_keeps_legacy_fold(lightweight):
+    """Flag-off guard: without ``enable_partition_mode`` a partitioned
+    party takes the legacy expire→fold path (no probes, no quarantine,
+    no degrade watchdog) — bit-for-bit the PR 2 behavior."""
+    sim = Simulation(_cfg(parties=2, workers=1,
+                          enable_partition_mode=False,
+                          request_retry_s=0.5),
+                     lightweight=lightweight)
+    try:
+        rm = sim.recovery_monitor
+        ls0 = sim.local_servers[0]
+        assert getattr(ls0, "_degrade_ticker", None) is None
+        sim.partition_party(0)
+        assert _wait_for(lambda: rm.party_folds == 1, 30)
+        assert rm.party_quarantines == 0 and rm._quarantined == {}
+        assert ls0._degraded is False
+        sim.heal_party(0)
+        # legacy recovery: dense warm boot, then fold back in
+        assert _wait_for(lambda: rm.party_unfolds == 1, 30)
+        assert ls0.warm_boots == 1
+        assert ls0.catchup_pushes == 0
+    finally:
+        sim.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# degraded-mode rounds + catch-up re-merge
+# ---------------------------------------------------------------------------
+
+
+def _partitioned_party_cfg(**kw):
+    kw.setdefault("sync_global_mode", False)
+    kw.setdefault("partition_degrade_s", 0.6)
+    return _cfg(parties=2, workers=1, **kw)
+
+
+@pytest.mark.parametrize("lightweight", TRANSPORTS)
+def test_party_blackhole_degraded_rounds_and_bitwise_catchup(lightweight):
+    """The tentpole ledger, bit-for-bit: a party behind a WAN blackhole
+    keeps closing LOCAL rounds against frozen weights while its gradient
+    delta accumulates; the stuck in-flight round is abandoned (bounded
+    loss, by design); survivors keep moving the global model; on heal
+    the catch-up delta merges through the optimizer path so the global
+    weights land EXACTLY where survivor rounds + the accumulated delta
+    say — no dense resync, no eviction, no incarnation fence."""
+    sim = Simulation(_partitioned_party_cfg(), lightweight=lightweight)
+    base = system_snapshot()
+    try:
+        w0, w1 = sim.all_workers()  # one per party
+        for w in (w0, w1):
+            w.init(0, np.zeros(8, np.float32))
+        w0.set_optimizer({"type": "sgd", "lr": 1.0})
+        for w in (w0, w1):
+            w.push(0, np.ones(8, np.float32))
+            w.wait_all()
+        gs = sim.global_servers[0]
+        rm = sim.recovery_monitor
+        ls0 = sim.local_servers[0]
+        assert _wait_for(lambda: float(gs.store[0][0]) == -2.0)
+
+        sim.partition_party(0)
+        # exactly ONE WAN push is in flight when the watchdog fires —
+        # that round is abandoned (its gradient is the bounded loss the
+        # docs promise), everything after it lands in the delta
+        w0.push(0, np.ones(8, np.float32))
+        w0.wait_all()
+        assert _wait_for(lambda: ls0._degraded, 15), \
+            "degrade watchdog never fired"
+        assert _wait_for(lambda: 0 in rm._quarantined, 15)
+        assert rm.party_quarantines == 1 and rm.party_folds == 0
+
+        # 3 degraded rounds: absorbed into the catch-up delta
+        for _ in range(3):
+            w0.push(0, np.ones(8, np.float32))
+            w0.wait_all()
+        assert _wait_for(lambda: ls0._catchup_rounds == 3, 10), \
+            ls0._catchup_rounds
+        # ...while the party's workers still see rounds closing (frozen
+        # weights — the LAN behind the cut is alive)
+        np.testing.assert_allclose(w0.pull_sync(0), ls0.store[0])
+
+        # survivors close 2 more global rounds during the outage
+        for _ in range(2):
+            w1.push(0, np.ones(8, np.float32))
+            w1.wait_all()
+        assert _wait_for(lambda: float(gs.store[0][0]) == -4.0)
+
+        # heal: the catch-up delta (3 rounds of +1) merges exactly
+        wb = ls0.warm_boots
+        sim.heal_party(0)
+        assert _wait_for(lambda: ls0.catchup_pushes == 1, 30)
+        assert _wait_for(lambda: gs.catchup_merges == 1, 10)
+        assert _wait_for(lambda: 0 not in rm._quarantined, 30)
+        np.testing.assert_array_equal(
+            gs.store[0], -7 * np.ones(8, np.float32))
+        assert ls0.warm_boots == wb, "heal fell back to a dense resync"
+        assert ls0.catchup_fallbacks == 0
+        assert ls0._catchup == {} and ls0._catchup_rounds == 0
+
+        # the healed party trains end-to-end again: fresh weights ride
+        # the next round's pull-down
+        w0.push(0, np.ones(8, np.float32))
+        w0.wait_all()
+        assert _wait_for(lambda: float(gs.store[0][0]) == -8.0)
+        np.testing.assert_allclose(w0.pull_sync(0),
+                                   -8 * np.ones(8, np.float32))
+
+        # nothing was evicted or fenced anywhere in the process
+        assert rm.party_folds == 0
+        assert ls0.evicted_workers == 0
+        snap = system_snapshot()
+        assert _delta(base, snap,
+                      "global_scheduler:0.partition_quarantines") == 1
+        assert _delta(base, snap,
+                      "server:0@p0.partition_catchup_pushes") == 1
+        assert _delta(base, snap,
+                      "global_server:0.partition_catchup_merges") == 1
+        assert _delta(base, snap, "global_scheduler:0.party_folds") == 0
+        assert _delta(base, snap, "server:0@p0.degraded_rounds") == 3
+
+        # every injected cut/heal and every quarantine decision is
+        # attributable in the flight ring
+        gsched = str(sim.topology.global_scheduler())
+        notes = [e["note"] for e in sim.offices[gsched].flight.events()
+                 if e["ev"] == "NETFAULT"]
+        for expected in ("netfault_cut", "netfault_heal",
+                         "netfault_quarantine", "netfault_unquarantine"):
+            assert expected in notes, (expected, notes)
+    finally:
+        sim.shutdown()
+
+
+def test_catchup_past_bound_falls_back_to_dense_resync():
+    """An outage that outlives ``partition_catchup_bound`` degraded
+    rounds abandons the delta (staleness past the compensation's reach)
+    and heals through the legacy dense warm boot instead."""
+    sim = Simulation(_partitioned_party_cfg(partition_catchup_bound=2))
+    try:
+        w0, w1 = sim.all_workers()
+        for w in (w0, w1):
+            w.init(0, np.zeros(8, np.float32))
+        w0.set_optimizer({"type": "sgd", "lr": 1.0})
+        for w in (w0, w1):
+            w.push(0, np.ones(8, np.float32))
+            w.wait_all()
+        gs = sim.global_servers[0]
+        rm = sim.recovery_monitor
+        ls0 = sim.local_servers[0]
+
+        sim.partition_party(0)
+        w0.push(0, np.ones(8, np.float32))
+        w0.wait_all()
+        assert _wait_for(lambda: ls0._degraded and 0 in rm._quarantined,
+                         15)
+        for _ in range(3):  # 3 > bound of 2
+            w0.push(0, np.ones(8, np.float32))
+            w0.wait_all()
+        assert _wait_for(lambda: ls0._catchup_rounds == 3, 10)
+        gval = float(gs.store[0][0])
+
+        sim.heal_party(0)
+        assert _wait_for(lambda: ls0.catchup_fallbacks == 1, 30)
+        assert _wait_for(lambda: 0 not in rm._quarantined, 30)
+        assert _wait_for(lambda: ls0.warm_boots == 1, 10)
+        assert ls0.catchup_pushes == 0 and gs.catchup_merges == 0
+        # the overflowed delta was DISCARDED, not merged
+        assert float(gs.store[0][0]) == gval
+        # the dense boot adopted the global weights verbatim
+        np.testing.assert_array_equal(ls0.store[0], gs.store[0])
+    finally:
+        sim.shutdown()
+
+
+def test_catchup_ships_under_a_quarter_of_dense_bytes():
+    """Acceptance: the healed party's catch-up (2bit-encoded delta)
+    ships < 25% of what a dense resync of the model would move over the
+    WAN.  Measured on a quiesced deployment so the window holds only
+    heartbeats + the rejoin control chatter + the catch-up itself."""
+    dim = 65536  # 256 KiB dense/key — dwarfs heartbeat chatter
+    sim = Simulation(_partitioned_party_cfg())
+    try:
+        w0, w1 = sim.all_workers()
+        for w in (w0, w1):
+            w.init(0, np.zeros(dim, np.float32))
+        w0.set_optimizer({"type": "sgd", "lr": 0.1})
+        for p in range(2):  # every party's rank-0 configures its tier
+            sim.worker(p, 0).set_gradient_compression({"type": "2bit"})
+        for w in (w0, w1):
+            w.push(0, np.ones(dim, np.float32))
+            w.wait_all()
+        rm = sim.recovery_monitor
+        ls0 = sim.local_servers[0]
+
+        sim.partition_party(0)
+        w0.push(0, np.ones(dim, np.float32))
+        w0.wait_all()
+        assert _wait_for(lambda: ls0._degraded and 0 in rm._quarantined,
+                         15)
+        for _ in range(4):
+            w0.push(0, np.ones(dim, np.float32))
+            w0.wait_all()
+        assert _wait_for(lambda: ls0._catchup_rounds == 4, 10)
+
+        dense_bytes = sum(v.nbytes for v in ls0.store.values())
+        before = sim.wan_bytes()["wan_send_bytes"]
+        sim.heal_party(0)
+        assert _wait_for(lambda: ls0.catchup_pushes == 1, 30)
+        assert _wait_for(lambda: 0 not in rm._quarantined, 30)
+        shipped = sim.wan_bytes()["wan_send_bytes"] - before
+        assert ls0.catchup_fallbacks == 0
+        assert shipped < 0.25 * dense_bytes, (shipped, dense_bytes)
+    finally:
+        sim.shutdown()
+
+
+@pytest.mark.parametrize("lightweight", TRANSPORTS)
+def test_netfault_orchestrator_drives_quarantine_and_heal(lightweight):
+    """Tentpole part 1 end-to-end: a scripted ``NetFaultPlan`` phase
+    (cut at t=0, heal after 2.5 s) drives the whole arc — quarantine,
+    degraded rounds, catch-up rejoin — with zero manual injection
+    calls, and the orchestrator's executed tape matches the plan."""
+    from geomx_tpu.chaos import (NetFaultOrchestrator, NetFaultPhase,
+                                 NetFaultPlan)
+
+    sim = Simulation(_partitioned_party_cfg(), lightweight=lightweight)
+    try:
+        w0, w1 = sim.all_workers()
+        for w in (w0, w1):
+            w.init(0, np.zeros(8, np.float32))
+        w0.set_optimizer({"type": "sgd", "lr": 1.0})
+        for w in (w0, w1):
+            w.push(0, np.ones(8, np.float32))
+            w.wait_all()
+        rm = sim.recovery_monitor
+        ls0 = sim.local_servers[0]
+
+        plan = NetFaultPlan((NetFaultPhase(at_s=0.0, duration_s=2.5,
+                                           party=0),), seed=3)
+        orch = NetFaultOrchestrator(sim, plan).start()
+        # keep the partitioned party training so degraded rounds accrue
+        assert _wait_for(lambda: 0 in rm._quarantined, 15)
+        w0.push(0, np.ones(8, np.float32))
+        w0.wait_all()
+        orch.join(60)
+        assert not orch._thread.is_alive(), "orchestrator wedged"
+        assert [e["action"] for e in orch.events] == ["cut", "heal"]
+        assert _wait_for(lambda: 0 not in rm._quarantined, 30)
+        assert rm.party_quarantines == 1 and rm.party_folds == 0
+        assert ls0.warm_boots == 0, "scripted heal dense-resynced"
+        # healed party trains end-to-end again
+        w0.push(0, np.ones(8, np.float32))
+        w0.wait_all()
+        assert np.isfinite(w0.pull_sync(0)).all()
+    finally:
+        sim.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the region-outage soak (slow): 30 s asymmetric partition, loss parity
+# ---------------------------------------------------------------------------
+
+
+def _quad_loop(kv, name, target, state, stop_all, errs):
+    """Free-running round loop on a quadratic objective (the churn
+    soak's): push grad((w-t)^2)/n + noise, pull, record loss."""
+    rng = np.random.RandomState(abs(hash(name)) % (2 ** 31))
+    w = np.zeros_like(target)
+    try:
+        while not stop_all.is_set():
+            g = (w - target + rng.normal(0, 0.01, target.shape)
+                 .astype(np.float32)) / kv.num_workers
+            kv.push(0, g)
+            got = []
+            ts = kv.pull(0, lambda t, a: got.append(a))
+            deadline = time.monotonic() + 120
+            while not got:
+                try:
+                    kv.worker.customer.wait(ts, timeout=0.5)
+                except TimeoutError:
+                    if stop_all.is_set():
+                        return  # teardown: abandon the in-flight round
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(f"{name}: round stuck >120s")
+            w = got[0]
+            state["loss"] = float(np.mean((w - target) ** 2))
+            state["rounds"] = state.get("rounds", 0) + 1
+    except Exception as e:  # noqa: BLE001 — asserted by the caller
+        errs.append((name, repr(e)))
+    state["stopped"] = True
+
+
+@pytest.mark.slow
+def test_region_outage_soak_quarantine_catchup_loss_parity():
+    """Acceptance (ISSUE 16): a 30 s ASYMMETRIC partition of one
+    party's WAN uplink mid-training.  Zero evictions, zero party
+    folds, zero incarnation fences; the survivor party keeps closing
+    rounds the whole time; the partitioned party accrues degraded
+    rounds; the heal ships a catch-up merge (not a dense resync); and
+    after rejoin the healed party's loss sits at the same noise floor
+    as the survivor's."""
+    dim = 128
+    cfg = _cfg(parties=2, workers=2, heartbeat_interval_s=0.1,
+               heartbeat_timeout_s=0.8, sync_global_mode=False,
+               partition_degrade_s=1.0, partition_catchup_bound=100000,
+               request_retry_s=0.5, lightweight=True)
+    sim = Simulation(cfg, lightweight=True)
+    target = np.linspace(-1.0, 1.0, dim).astype(np.float32)
+    stop_all = threading.Event()
+    errs, states, threads = [], {}, []
+    base = system_snapshot()
+    try:
+        ws = sim.all_workers()
+        for kv in ws:
+            kv.init(0, np.zeros(dim, np.float32))
+        ws[0].set_optimizer({"type": "sgd", "lr": 0.3})
+        for kv in ws:
+            name = str(kv.po.node)
+            st = states.setdefault(name, {})
+            th = threading.Thread(target=_quad_loop,
+                                  args=(kv, name, target, st, stop_all,
+                                        errs),
+                                  name=f"soak-{name}", daemon=True)
+            threads.append(th)
+            th.start()
+        rm = sim.recovery_monitor
+        ls0 = sim.local_servers[0]
+        survivor = "worker:0@p1"
+        assert _wait_for(
+            lambda: states[survivor].get("rounds", 0) >= 5, 60)
+
+        # the asymmetric outage: party 0's OUTBOUND WAN legs only
+        sim.partition_party(0, symmetric=False)
+        t_cut = time.monotonic()
+        assert _wait_for(lambda: 0 in rm._quarantined, 30)
+        assert _wait_for(lambda: ls0._degraded, 30)
+        mid = states[survivor].get("rounds", 0)
+        while time.monotonic() - t_cut < 30.0:
+            time.sleep(0.5)
+        # survivors kept closing rounds THROUGHOUT the outage...
+        assert states[survivor].get("rounds", 0) > mid + 5
+        # ...and the dark party kept training locally
+        assert ls0._catchup_rounds > 5
+        assert rm.party_folds == 0, "outage escalated to a fold"
+        for mon in sim.eviction_monitors:
+            assert mon.evictions == 0, "outage evicted a worker"
+
+        sim.heal_party(0)
+        assert _wait_for(lambda: ls0.catchup_pushes == 1, 60)
+        assert _wait_for(lambda: 0 not in rm._quarantined, 60)
+        assert ls0.catchup_fallbacks == 0 and ls0.warm_boots == 0
+
+        # post-heal parity: both parties settle on the same noise floor
+        heal_round = states[survivor].get("rounds", 0)
+        assert _wait_for(
+            lambda: states[survivor].get("rounds", 0) >= heal_round + 20
+            and states["worker:0@p0"].get("loss", 1.0) < 0.05, 120)
+        l0 = states["worker:0@p0"]["loss"]
+        l1 = states[survivor]["loss"]
+        assert abs(l0 - l1) < 0.05, (l0, l1)
+
+        stop_all.set()
+        for th in threads:
+            th.join(60)
+        assert not any(th.is_alive() for th in threads), \
+            "a round wedged across the outage"
+        assert not errs, errs
+        # zero incarnation fences, zero evictions — the whole run
+        snap = system_snapshot()
+        for p in (0, 1):
+            assert _delta(base, snap,
+                          f"scheduler:0@p{p}.worker_evictions") == 0
+            assert _delta(base, snap,
+                          f"server:0@p{p}.eviction_fenced_pushes") == 0
+        assert _delta(base, snap, "global_scheduler:0.party_folds") == 0
+        assert _delta(base, snap,
+                      "global_scheduler:0.partition_quarantines") == 1
+        assert _delta(base, snap,
+                      "global_server:0.partition_catchup_merges") == 1
+    finally:
+        stop_all.set()
+        sim.shutdown()
