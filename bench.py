@@ -2068,6 +2068,149 @@ def child_partition():
         sim.shutdown()
 
 
+def child_integrity():
+    """Data-integrity plane cost & coverage (ISSUE 17).  Three readings:
+
+    1. wire-checksum overhead — median encode+decode wall for a
+       representative gradient frame with ``GEOMX_INTEGRITY_WIRE`` off
+       vs on.  The serde leg alone is CRC-dominated (zlib.crc32 runs
+       ~1 GB/s, the v2 encode is near-zero-copy), so the honest
+       acceptance number is ``wan_path_overhead_pct``: the CRC's added
+       wall against the frame's WAN transfer time at the deployment's
+       link speed (``BENCH_INTEGRITY_WAN_MBPS``, default 100 — the
+       cross-region WAN class GeoMX targets; the bound is < 5 %);
+    2. detection coverage — a seeded single-bit-flip sweep over a
+       stamped frame: every flip must surface as a typed decode error,
+       never a silently different message (``silent_deliveries`` is
+       the number that must be 0);
+    3. corruption soak — a 2-party in-proc deployment trains while a
+       seeded bit-flip tap corrupts 20 % of one party's WAN uplink
+       frames; the fabric ledger must show every injected corruption
+       detected + dropped (the NACK resend path re-delivers), the model
+       must stay finite, and zero corrupted payloads may reach a merge.
+    """
+    import numpy as np
+
+    from geomx_tpu.transport import message as M
+
+    N = int(os.environ.get("BENCH_INTEGRITY_ELEMS", "1048576"))
+    reps = int(os.environ.get("BENCH_INTEGRITY_REPS", "30"))
+    flips = int(os.environ.get("BENCH_INTEGRITY_FLIPS", "1500"))
+
+    rng = np.random.default_rng(7)
+
+    def mk_msg(elems):
+        return M.Message(
+            sender=M.NodeId.parse("server:0@p0"),
+            recipient=M.NodeId.parse("global_server:0"),
+            request=True, push=True, timestamp=7, msg_sig=1234,
+            keys=np.array([0], np.int64),
+            vals=rng.standard_normal(elems).astype(np.float32),
+            lens=np.array([elems], np.int64))
+
+    def median_roundtrip(msg, n):
+        walls = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            M.Message.from_bytes(msg.to_bytes())
+            walls.append(time.perf_counter() - t0)
+        return sorted(walls)[len(walls) // 2]
+
+    wan_mbps = float(os.environ.get("BENCH_INTEGRITY_WAN_MBPS", "100"))
+
+    saved = M.WIRE_INTEGRITY
+    try:
+        msg = mk_msg(N)
+        M.WIRE_INTEGRITY = False
+        legacy = median_roundtrip(msg, reps)
+        M.WIRE_INTEGRITY = True
+        stamped = median_roundtrip(msg, reps)
+        frame_bytes = len(msg.to_bytes())
+        # One CRC pass on encode + one on verify; the extra wall is what
+        # the stamps cost on top of the near-zero-copy legacy serde.
+        crc_extra = max(stamped - legacy, 0.0)
+        wire_s = frame_bytes * 8.0 / (wan_mbps * 1e6)
+        wan_path_overhead = 100.0 * crc_extra / max(legacy + wire_s, 1e-9)
+
+        # 2. seeded bit-flip sweep over a small stamped frame
+        small = mk_msg(4096)
+        raw = bytearray(small.to_bytes())
+        ref = small.vals.tobytes()
+        detected = silent = benign = 0
+        for pos in rng.choice(len(raw) * 8, size=min(flips, len(raw) * 8),
+                              replace=False):
+            byte, bit = int(pos) // 8, int(pos) % 8
+            raw[byte] ^= 1 << bit
+            try:
+                out = M.Message.from_bytes(bytes(raw))
+                if (out.vals is not None
+                        and out.vals.tobytes() == ref
+                        and out.msg_sig == small.msg_sig):
+                    benign += 1  # flip landed outside any decoded field
+                else:
+                    silent += 1
+            except Exception:
+                detected += 1
+            finally:
+                raw[byte] ^= 1 << bit
+    finally:
+        M.WIRE_INTEGRITY = saved
+
+    # 3. corruption soak on the in-proc fabric (wire stamps forced on)
+    from geomx_tpu.core.config import Config, Topology
+    from geomx_tpu.kvstore import Simulation
+
+    soak_rounds = int(os.environ.get("BENCH_INTEGRITY_ROUNDS", "25"))
+    M.WIRE_INTEGRITY = True
+    cfg = Config(topology=Topology(num_parties=2, workers_per_party=1),
+                 enable_flight=False, lightweight=True,
+                 sync_global_mode=False, resend_timeout_ms=200)
+    sim = Simulation(cfg, lightweight=True)
+    try:
+        w0, w1 = sim.all_workers()
+        for w in (w0, w1):
+            w.init(0, np.zeros(8192, np.float32))
+        w0.set_optimizer({"type": "sgd", "lr": 0.1})
+        src = str(sim.local_servers[0].po.node)
+        dst = str(sim.global_servers[0].po.node)
+        sim.corrupt_link(src, dst, rate=0.2, mode="bitflip", seed=17)
+        g = np.ones(8192, np.float32)
+        for _ in range(soak_rounds):
+            for w in (w0, w1):
+                w.push(0, g)
+            for w in (w0, w1):
+                w.wait_all()
+        sim.heal_corrupt(src, dst)
+        final = w0.pull_sync(0)
+        fab = sim.fabric
+        print(json.dumps({
+            "tensor_elems": N, "reps": reps,
+            "frame_bytes": frame_bytes,
+            "legacy_roundtrip_s": round(legacy, 6),
+            "stamped_roundtrip_s": round(stamped, 6),
+            "crc_throughput_mb_s": round(
+                2.0 * frame_bytes / max(crc_extra, 1e-9) / 1e6, 1),
+            "serde_overhead_pct": round(
+                100.0 * crc_extra / max(legacy, 1e-9), 2),
+            "wan_mbps": wan_mbps,
+            "wan_frame_transfer_s": round(wire_s, 6),
+            "wan_path_overhead_pct": round(wan_path_overhead, 2),
+            "bitflips_tried": detected + silent + benign,
+            "bitflips_detected": detected,
+            "bitflips_benign": benign,
+            "silent_deliveries": silent,
+            "soak_rounds": soak_rounds,
+            "soak_corrupt_injected": fab.corrupt_injected,
+            "soak_corrupt_detected": fab.corrupt_detected,
+            "soak_corrupt_dropped": fab.corrupt_dropped,
+            "soak_corrupt_delivered": fab.corrupt_delivered,
+            "soak_model_finite": bool(np.isfinite(final).all()),
+        }))
+    finally:
+        sim.shutdown()
+        M.WIRE_INTEGRITY = saved
+
+
 def child_serve():
     """Read-serving replica tier (ISSUE 8): ``pulls_per_sec`` at 1/2/4
     replicas under CONCURRENT training — the serving tier's brand-new
@@ -3055,7 +3198,7 @@ def main():
                              "flash_autotune", "lm", "scaling", "parity",
                              "serde", "shards", "parties", "obs",
                              "flight", "serve", "merge", "churn",
-                             "partition"])
+                             "partition", "integrity"])
     ap.add_argument("--wan", action="store_true",
                     help="legacy: run only the WAN codec benchmark")
     ap.add_argument("--skip-tpu", action="store_true")
@@ -3084,7 +3227,7 @@ def main():
          "obs": child_obs,
          "flight": child_flight, "serve": child_serve,
          "merge": child_merge, "churn": child_churn,
-         "partition": child_partition,
+         "partition": child_partition, "integrity": child_integrity,
          "flash_autotune": child_flash_autotune}[args.child]()
         return
 

@@ -16,6 +16,7 @@ from geomx_tpu.analysis.baseline import (DEFAULT_BASELINE, Baseline,
                                          BaselineError, skeleton)
 from geomx_tpu.analysis.config_drift import ConfigDrift
 from geomx_tpu.analysis.core import Checker, Finding, Project
+from geomx_tpu.analysis.decode_bounds import DecodeBounds
 from geomx_tpu.analysis.doc_drift import MetricsDoc
 from geomx_tpu.analysis.lock_discipline import LockDiscipline
 from geomx_tpu.analysis.reactor_blocking import ReactorBlocking
@@ -24,7 +25,7 @@ from geomx_tpu.analysis.wire_protocol import WireProtocol
 #: name -> checker class, in catalog order
 CHECKERS: Dict[str, Type[Checker]] = {
     c.name: c for c in (LockDiscipline, ReactorBlocking, WireProtocol,
-                        ConfigDrift, MetricsDoc)
+                        ConfigDrift, MetricsDoc, DecodeBounds)
 }
 
 

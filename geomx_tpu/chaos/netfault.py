@@ -34,7 +34,7 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
-_KINDS = ("party_blackhole", "asym_cut", "flap")
+_KINDS = ("party_blackhole", "asym_cut", "flap", "corrupt")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,17 +53,25 @@ class NetFaultPhase:
       ``period_s`` seconds (``duty`` = cut fraction of each period,
       edges jittered by the plan seed) for ``duration_s`` — the
       retry-storm shaker.
+    - ``corrupt``: damage data frames on the ``src``→``dst`` link in
+      flight for ``duration_s`` (``"*"`` wildcards allowed): each frame
+      is corrupted with probability ``rate`` in ``corrupt_mode``
+      ("bitflip" | "truncate"), on a deterministic per-rule tape seeded
+      from the plan seed — the rot a flaky NIC inflicts, which the wire
+      checksums (GEOMX_INTEGRITY_WIRE) must catch and NACK-resend.
     """
 
     at_s: float
     duration_s: float
     kind: str = "party_blackhole"
     party: int = 0
-    src: Optional[str] = None    # asym_cut only
-    dst: Optional[str] = None    # asym_cut only
+    src: Optional[str] = None    # asym_cut / corrupt
+    dst: Optional[str] = None    # asym_cut / corrupt
     symmetric: bool = True       # party_blackhole / flap
     period_s: float = 2.0        # flap only
     duty: float = 0.5            # flap only: fraction of period cut
+    rate: float = 1.0            # corrupt only: per-frame damage prob
+    corrupt_mode: str = "bitflip"  # corrupt only: bitflip | truncate
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -74,6 +82,18 @@ class NetFaultPhase:
         if self.kind == "flap" and not (0.0 < self.duty < 1.0
                                         and self.period_s > 0):
             raise ValueError("flap needs period_s > 0 and 0 < duty < 1")
+        if self.kind == "corrupt":
+            if not (self.src and self.dst):
+                raise ValueError(
+                    "corrupt needs src and dst node strings ('*' ok)")
+            if not 0.0 < self.rate <= 1.0:
+                raise ValueError("corrupt needs 0 < rate <= 1")
+            from geomx_tpu.transport.van import _CORRUPT_MODES
+
+            if self.corrupt_mode not in _CORRUPT_MODES:
+                raise ValueError(
+                    f"unknown corrupt_mode '{self.corrupt_mode}' "
+                    f"(one of {_CORRUPT_MODES})")
 
 
 @dataclasses.dataclass
@@ -179,7 +199,15 @@ class NetFaultOrchestrator:
                     pass
 
     def _execute(self, action: str, ph: NetFaultPhase):
-        if ph.kind == "asym_cut":
+        if ph.kind == "corrupt":
+            if action == "cut":
+                self.sim.corrupt_link(
+                    ph.src, ph.dst, rate=ph.rate, mode=ph.corrupt_mode,
+                    seed=_corrupt_seed(self.plan.seed, ph))
+            else:
+                self.sim.heal_corrupt(ph.src, ph.dst)
+            target = f"{ph.src}->{ph.dst}"
+        elif ph.kind == "asym_cut":
             if action == "cut":
                 self.sim.partition(ph.src, ph.dst, symmetric=False)
             else:
@@ -194,6 +222,15 @@ class NetFaultOrchestrator:
             target = f"party:{ph.party}"
         self.events.append({"t": time.monotonic(), "action": action,
                             "kind": ph.kind, "target": target})
+
+
+def _corrupt_seed(plan_seed: int, ph: NetFaultPhase) -> int:
+    """Per-link corruption-tape seed: stable across runs (plan seed ⊕
+    link name), distinct per link so two corrupt phases don't share a
+    tape."""
+    import zlib
+
+    return plan_seed ^ zlib.crc32(f"{ph.src}->{ph.dst}".encode())
 
 
 def _wan_peers_of(topology, party: int) -> List[str]:
@@ -240,6 +277,16 @@ def install_env_netfaults(po) -> Optional[threading.Thread]:
     topo = po.topology
 
     def _apply(action: str, ph: NetFaultPhase):
+        if ph.kind == "corrupt":
+            if action == "cut":
+                fault.corrupt(ph.src, ph.dst, rate=ph.rate,
+                              mode=ph.corrupt_mode,
+                              seed=_corrupt_seed(seed, ph))
+            else:
+                fault.heal_corrupt(ph.src, ph.dst)
+            print(f"{me}: netfault {action} corrupt "
+                  f"{ph.src}->{ph.dst}", flush=True)
+            return
         if ph.kind == "asym_cut":
             if action == "cut":
                 fault.partition(ph.src, ph.dst, symmetric=False)

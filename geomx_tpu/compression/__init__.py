@@ -1,5 +1,5 @@
 from geomx_tpu.compression.codecs import (  # noqa: F401
-    Codec, Fp16Codec, TwoBitCodec, BscCodec, MpqSelector,
+    Codec, CodecError, Fp16Codec, TwoBitCodec, BscCodec, MpqSelector,
     BroadcastCompressor, make_push_codec, decompress_payload,
     DecoderBank, compression_allowed, KNOWN_PUSH_TAGS, WEIGHT_SAFE_CODECS,
 )

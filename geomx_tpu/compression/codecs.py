@@ -51,6 +51,43 @@ def _native():
         return None
 
 
+class CodecError(ValueError):
+    """A payload failed structural validation at decode time.
+
+    Every decode entry point raises THIS (never a bare IndexError /
+    ValueError / reshape error, and never a silent wrong-shaped tensor)
+    when a payload is truncated, mis-sized, or carries out-of-range
+    indices — so receivers can fence the one bad push instead of letting
+    a corrupt buffer take down the merge thread or, worse, scatter into
+    the wrong coordinates.  Subclasses ValueError so pre-existing
+    catch-sites keep working."""
+
+    def __init__(self, what: str, *, tag: str = "", key: int = -1):
+        self.what = what
+        self.tag = tag
+        self.key = int(key)
+        detail = f" (tag '{tag}'" + (f", key {key})" if key >= 0 else ")") \
+            if tag else (f" (key {key})" if key >= 0 else "")
+        super().__init__(f"corrupt codec payload: {what}{detail}")
+
+
+def _check_f32_vector(payload: np.ndarray, tag: str, key: int) -> np.ndarray:
+    """Common structural gate for the bit-cast sparse formats: the
+    [values ‖ indices] layouts re-view raw bits as int32, which is only
+    meaningful on a contiguous 1-D 4-byte-item array."""
+    arr = np.asarray(payload)
+    if arr.ndim != 1:
+        raise CodecError(f"expected 1-D payload, got ndim={arr.ndim}",
+                         tag=tag, key=key)
+    if arr.dtype.itemsize != 4:
+        raise CodecError(
+            f"expected 4-byte items for index bit-cast, got {arr.dtype}",
+            tag=tag, key=key)
+    # bit-cast (never a value conversion): the indices half only decodes
+    # correctly if the raw 4-byte patterns are preserved
+    return np.ascontiguousarray(arr).view(np.float32)
+
+
 class Codec:
     name = "none"
 
@@ -74,6 +111,10 @@ class Fp16Codec(Codec):
         return arr.astype(np.float16)
 
     def decompress(self, key, payload, orig_len):
+        if len(payload) != orig_len:
+            raise CodecError(
+                f"fp16 payload carries {len(payload)} values for a "
+                f"{orig_len}-element tensor", tag="fp16", key=key)
         return payload.astype(np.float32)
 
 
@@ -120,6 +161,14 @@ class TwoBitCodec(Codec):
 
     def decompress(self, key, payload, orig_len):
         b = np.ascontiguousarray(payload, dtype=np.uint8)
+        if len(b) < (orig_len + 3) // 4:
+            # length gate BEFORE either decoder touches the buffer: the
+            # native geo_unpack2bit reads orig_len/4 bytes unchecked (a
+            # truncated payload would read out of bounds), and the numpy
+            # path would return a silently short boolean mask
+            raise CodecError(
+                f"2bit payload holds {len(b) * 4} codes for a "
+                f"{orig_len}-element tensor", tag="2bit", key=key)
         nlib = _native()
         if nlib is not None:
             out = np.empty(orig_len, dtype=np.float32)
@@ -147,8 +196,13 @@ def pack_sparse(values: np.ndarray, indices: np.ndarray) -> np.ndarray:
     ])
 
 
-def unpack_sparse(payload: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    assert len(payload) % 2 == 0, "sparse payload must be [values ‖ indices]"
+def unpack_sparse(payload: np.ndarray, *, tag: str = "bsc",
+                  key: int = -1) -> Tuple[np.ndarray, np.ndarray]:
+    payload = _check_f32_vector(payload, tag, key)
+    if len(payload) % 2 != 0:
+        raise CodecError(
+            f"sparse payload must be [values ‖ indices] (even length, "
+            f"got {len(payload)})", tag=tag, key=key)
     k = len(payload) // 2
     values = payload[:k].astype(np.float32)
     indices = payload[k:].view(np.int32).astype(np.int64)
@@ -166,15 +220,38 @@ def pack_rows(row_ids: np.ndarray, rows: np.ndarray) -> np.ndarray:
 
 def unpack_rows(payload: np.ndarray, cols: int):
     """Inverse of pack_rows → (row_ids int64 [k], rows float32 [k, cols])."""
+    if cols < 1:
+        raise CodecError(f"row-sparse decode needs cols >= 1, got {cols}",
+                         tag="rows")
+    payload = _check_f32_vector(payload, "rows", -1)
+    if len(payload) % (cols + 1) != 0:
+        raise CodecError(
+            f"row-sparse payload of {len(payload)} values does not "
+            f"split into (row ‖ id) groups of {cols + 1}", tag="rows")
     k = len(payload) // (cols + 1)
     rows = payload[:k * cols].reshape(k, cols).astype(np.float32)
     row_ids = payload[k * cols:].view(np.int32).astype(np.int64)
     return row_ids, rows
 
 
-def scatter_sparse(payload: np.ndarray, orig_len: int) -> np.ndarray:
+def _check_index_bounds(idx: np.ndarray, orig_len: int, tag: str,
+                        key: int) -> None:
+    """Reject out-of-range scatter indices BEFORE any write: a negative
+    int32 from a flipped bit would silently wrap through numpy fancy
+    indexing into the wrong coordinate, and the native geo_sparse_add
+    would write out of bounds."""
+    if len(idx) and (int(idx.min()) < 0 or int(idx.max()) >= orig_len):
+        raise CodecError(
+            f"scatter index out of range [0, {orig_len}) "
+            f"(min {int(idx.min())}, max {int(idx.max())})",
+            tag=tag, key=key)
+
+
+def scatter_sparse(payload: np.ndarray, orig_len: int, *,
+                   key: int = -1) -> np.ndarray:
     """Densify a [values ‖ indices] payload (shared by all bsc decoders)."""
-    vals, idx = unpack_sparse(payload)
+    vals, idx = unpack_sparse(payload, key=key)
+    _check_index_bounds(idx, orig_len, "bsc", key)
     out = np.zeros(orig_len, dtype=np.float32)
     out[idx] = vals
     return out
@@ -257,7 +334,7 @@ class BscCodec(Codec):
         return pack_sparse(vals, idx)
 
     def decompress(self, key, payload, orig_len):
-        return scatter_sparse(payload, orig_len)
+        return scatter_sparse(payload, orig_len, key=key)
 
     @property
     def dense_delta(self) -> bool:
@@ -488,6 +565,7 @@ class BroadcastCompressor:
     @staticmethod
     def decompress_into(store_val: np.ndarray, payload: np.ndarray) -> np.ndarray:
         vals, idx = unpack_sparse(payload)
+        _check_index_bounds(idx, len(store_val), "bsc", -1)
         out = np.ascontiguousarray(store_val, dtype=np.float32)
         if np.may_share_memory(out, store_val) or not out.flags.writeable:
             # ascontiguousarray of an already-contiguous same-dtype
@@ -622,11 +700,15 @@ def decompress_payload(compr: str, key: int, payload: np.ndarray,
     to the calling endpoint; without one a fresh (stateless-for-decode)
     codec is used."""
     if compr == "fp16":
+        if len(payload) != orig_len:
+            raise CodecError(
+                f"fp16 payload carries {len(payload)} values for a "
+                f"{orig_len}-element tensor", tag="fp16", key=key)
         return payload.astype(np.float32)
     if compr == "bsc":
-        return scatter_sparse(payload, orig_len)
+        return scatter_sparse(payload, orig_len, key=key)
     if compr == "2bit":
         dec = bank.twobit(threshold) if bank is not None \
             else TwoBitCodec(threshold)
         return dec.decompress(key, payload, orig_len)
-    raise ValueError(f"unknown compr tag '{compr}'")
+    raise CodecError(f"unknown compr tag '{compr}'", tag=compr, key=key)

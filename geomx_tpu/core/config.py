@@ -558,6 +558,31 @@ class Config:
     #                                   pushes enters degraded mode;
     #                                   0 = follow max(heartbeat_
     #                                   timeout_s, 1.0)
+    # --- data-integrity plane (docs/deployment.md "Data integrity").
+    # integrity_push_screen: servers screen every gradient push for
+    # NaN/Inf (and |g| > poison_mag_max when set) BEFORE it merges — a
+    # poisoned push is zeroed out of the round (so sync accounting
+    # still completes) and answered with a typed error; a sender
+    # crossing poison_quarantine_n strikes is QUARANTINED through the
+    # reversible fold machinery, never evicted.  The wire-checksum and
+    # checkpoint-stamp halves of the plane are process-wide encode
+    # decisions and live on env flags read at import
+    # (GEOMX_INTEGRITY_WIRE in transport/message.py,
+    # GEOMX_INTEGRITY_CKPT in kvstore/checkpoint.py).  All default OFF:
+    # flags off is bit-for-bit legacy behavior.
+    integrity_push_screen: bool = False
+    poison_quarantine_n: int = 3    # strikes before the sender is
+    #                                 quarantined (0 = never quarantine,
+    #                                 just reject each poisoned push)
+    poison_mag_max: float = 0.0     # reject |gradient| above this too;
+    #                                 0 = finiteness screen only
+    ckpt_generations: int = 1       # on-disk checkpoint generations to
+    #                                 retain; restore falls back to the
+    #                                 newest one that verifies
+    obs_corruption_events: int = 8  # data_corruption health rule: total
+    #                                 integrity rejects per node over the
+    #                                 collector window before the engine
+    #                                 pages
     # --- distributed tracing (geomx_tpu/trace; beyond the reference —
     # its profiler is per-process only).  trace_sample_every = N traces
     # every N-th synchronization round end-to-end: causal spans ride the
@@ -769,6 +794,25 @@ class Config:
             "GEOMX_PARTITION_CATCHUP_BOUND", self.partition_catchup_bound)
         self.partition_degrade_s = _env_float(
             "GEOMX_PARTITION_DEGRADE_S", self.partition_degrade_s)
+        self.integrity_push_screen = _env_bool(
+            "GEOMX_INTEGRITY_PUSH_SCREEN", self.integrity_push_screen)
+        self.poison_quarantine_n = _env_int(
+            "GEOMX_POISON_QUARANTINE_N", self.poison_quarantine_n)
+        self.poison_mag_max = _env_float(
+            "GEOMX_POISON_MAG_MAX", self.poison_mag_max)
+        self.ckpt_generations = _env_int(
+            "GEOMX_CKPT_GENERATIONS", self.ckpt_generations)
+        self.obs_corruption_events = _env_int(
+            "GEOMX_OBS_CORRUPTION_EVENTS", self.obs_corruption_events)
+        if self.poison_quarantine_n < 0:
+            raise ValueError("poison_quarantine_n must be >= 0 "
+                             "(0 = reject poisoned pushes but never "
+                             "quarantine the sender)")
+        if self.poison_mag_max < 0.0:
+            raise ValueError("poison_mag_max must be >= 0 "
+                             "(0 = finiteness screen only)")
+        if self.ckpt_generations < 1:
+            raise ValueError("ckpt_generations must be >= 1")
         if self.probe_indirect_k < 1:
             raise ValueError("probe_indirect_k must be >= 1")
         if self.probe_timeout_s <= 0.0:
@@ -1029,6 +1073,10 @@ class Config:
             partition_catchup_bound=_env_int(
                 "GEOMX_PARTITION_CATCHUP_BOUND", 50),
             partition_degrade_s=_env_float("GEOMX_PARTITION_DEGRADE_S", 0.0),
+            integrity_push_screen=_env_bool("GEOMX_INTEGRITY_PUSH_SCREEN"),
+            poison_quarantine_n=_env_int("GEOMX_POISON_QUARANTINE_N", 3),
+            poison_mag_max=_env_float("GEOMX_POISON_MAG_MAX", 0.0),
+            ckpt_generations=_env_int("GEOMX_CKPT_GENERATIONS", 1),
             trace_sample_every=_env_int("GEOMX_TRACE_SAMPLE_EVERY", 0),
             trace_dir=os.environ.get("GEOMX_TRACE_DIR", ""),
             trace_batch_events=_env_int("GEOMX_TRACE_BATCH_EVENTS", 256),

@@ -99,6 +99,18 @@ class MergeBackend:
         """Observability: merged into the server's QUERY_STATS body."""
         return {"merge_backend": self.name}
 
+    def screen_finite(self, v: np.ndarray, mag_max: float = 0.0) -> bool:
+        """Gradient-hygiene screen (Config.integrity_push_screen): True
+        iff every element of the push payload is finite — and, when
+        ``mag_max`` > 0, within ``[-mag_max, mag_max]``.  The host
+        reference is one fused pass; accelerator backends override with
+        a jitted device reduction so the screen ships one scalar back
+        instead of the tensor."""
+        if mag_max > 0.0:
+            with np.errstate(invalid="ignore"):
+                return bool((np.abs(v) <= mag_max).all())
+        return bool(np.isfinite(v).all())
+
     def make_device_optimizer(self, spec: dict):
         """Optimizer stage of the round close: return a device-resident
         optimizer for ``spec`` (a ``make_optimizer`` config dict), or

@@ -137,6 +137,14 @@ class JaxBackend(MergeBackend):
         # float would be baked into the jaxpr and retrace per distinct
         # HFA renormalization value
         self._scale = jax.jit(lambda a, s: a * s, donate_argnums=(0,))
+        # gradient-hygiene screen: one fused device reduction to a
+        # scalar — |x| <= m subsumes the finiteness check (NaN/inf
+        # compare False), so both modes are a single pass and the only
+        # host traffic is the bool
+        self._screen = jax.jit(
+            lambda x, m: jnp.where(m > np.float32(0),
+                                   (jnp.abs(x) <= m).all(),
+                                   jnp.isfinite(x).all()))
         self._mesh_cache: Dict[int, object] = {}
         self._reducers: Dict[tuple, object] = {}
         # per-key error-feedback residual for the quantized collective:
@@ -341,6 +349,12 @@ class JaxBackend(MergeBackend):
         # downstream single-device consumers (the jitted optimizer
         # update, the donated scale) see one device, not the mesh
         return self._jax.device_put(out[0], self._devices[0])
+
+    def screen_finite(self, v: np.ndarray, mag_max: float = 0.0) -> bool:
+        """Device screen: the jitted fused reduction ships one scalar
+        back (single sync) instead of round-tripping the tensor."""
+        arr = np.ascontiguousarray(v, dtype=np.float32)
+        return bool(self._screen(arr, np.float32(mag_max)))
 
     # ---- optimizer stage ----------------------------------------------------
     def make_device_optimizer(self, spec: dict):

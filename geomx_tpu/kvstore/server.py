@@ -35,6 +35,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from geomx_tpu.compression.codecs import CodecError
 from geomx_tpu.core.config import Config, Group, NodeId, Topology
 from geomx_tpu.kvstore.backend import _adopt_or_copy, make_merge_backend
 from geomx_tpu.kvstore.common import (APP_PS, Cmd, Ctrl, RecentRequests,
@@ -298,6 +299,23 @@ class LocalServer:
         self._evicted: Dict[str, int] = {}
         self.evicted_workers = 0
         self.eviction_fenced_pushes = 0
+        # gradient hygiene (Config.integrity_push_screen; docs/
+        # deployment.md "Data integrity"): every push payload is
+        # screened for NaN/Inf (and, under poison_mag_max, magnitude)
+        # before it can touch an accumulator.  A poisoned push merges
+        # ZERO contribution — it still counts toward round completion,
+        # so one faulty worker cannot stall the party barrier — and its
+        # sender gets a typed error instead of the ack.  At
+        # poison_quarantine_n strikes the sender is folded out through
+        # the REVERSIBLE quarantine machinery (rank stashed,
+        # incarnation NOT fenced) — quarantine, not eviction: a node
+        # whose NaNs came from a transient (bad batch, flaky HBM) heals
+        # back in via unquarantine; a truly poisoned one stays folded
+        # out without zombie-fence complications.
+        self._poison_strikes: Dict[str, int] = {}
+        self.integrity_poison_rejects = 0
+        self.poison_quarantines = 0
+        self.integrity_codec_rejects = 0
         # local-server recovery: REJOIN warm boots served (observability)
         self.warm_boots = 0
         self._rejoin_waiters: List[Message] = []
@@ -843,6 +861,65 @@ class LocalServer:
         self.server.response(msg, body=err)
         return True
 
+    def _poison_strike(self, sender_s: str) -> dict:
+        """Record one poison strike against ``sender_s``; quarantine it
+        (reversible fold, PR-16 machinery) once the strike count
+        crosses ``poison_quarantine_n``.  Returns the typed error body
+        the push's ack path sends instead of a clean ack."""
+        quarantined = False
+        with self._mu:
+            self.integrity_poison_rejects += 1
+            strikes = self._poison_strikes.get(sender_s, 0) + 1
+            self._poison_strikes[sender_s] = strikes
+            n = self.config.poison_quarantine_n
+            if n and strikes >= n and sender_s in self._members:
+                rank = self._members.get(sender_s)
+                if self._fold_member_out_locked(sender_s):
+                    if rank is not None:
+                        self._quarantined_members[sender_s] = rank
+                    self.poison_quarantines += 1
+                    quarantined = True
+            quarantined_total = len(self._quarantined_members)
+        from geomx_tpu.utils.metrics import system_counter, system_gauge
+
+        system_counter(f"{self.po.node}.integrity_poison_rejects").inc()
+        if self._flight is not None:
+            self._flight.record(FlightEv.CORRUPT, a=strikes,
+                                peer=sender_s, note="poison_push")
+        if quarantined:
+            system_counter(f"{self.po.node}.poison_quarantines").inc()
+            system_gauge(f"{self.po.node}.quarantined_nodes").set(
+                quarantined_total)
+            if self._flight is not None:
+                self._flight.record(FlightEv.CORRUPT, a=strikes,
+                                    peer=sender_s,
+                                    note="poison_quarantine")
+            print(f"{self.po.node}: quarantined {sender_s} after "
+                  f"{strikes} poisoned pushes — folded out reversibly, "
+                  "unquarantine heals it back in", flush=True)
+            self._broadcast_membership()
+        return {"error": f"poisoned push rejected: payload failed the "
+                         f"finiteness/magnitude screen (strike "
+                         f"{strikes}); contribution zeroed"
+                         + (", sender quarantined" if quarantined
+                            else "")}
+
+    def _screen_push(self, msg: Message, kvs: KVPairs) -> KVPairs:
+        """Gradient-hygiene gate on the push ingest path (one fused
+        backend reduction; the jax backend syncs a single device
+        scalar).  A clean payload passes through untouched; a poisoned
+        one is replaced with zeros — zero contribution keeps the sync
+        round's completion accounting intact — and the typed error body
+        rides to the ack via ``msg._gx_poisoned``."""
+        if not self.config.integrity_push_screen:
+            return kvs
+        if self._backend.screen_finite(kvs.vals,
+                                       self.config.poison_mag_max):
+            return kvs
+        msg._gx_poisoned = self._poison_strike(str(msg.sender))
+        return KVPairs(kvs.keys, np.zeros(len(kvs.vals), np.float32),
+                       kvs.lens)
+
     def _on_rejoin(self, msg: Message) -> bool:
         """Control.REJOIN request from the global scheduler's recovery
         monitor: this (replacement or revived) local server must adopt
@@ -1352,6 +1429,7 @@ class LocalServer:
         # first push from a dynamic joiner: it is established now — its
         # later pulls park during partial merges like everyone else's
         self._bootstrapping.discard(sender_s)
+        kvs = self._screen_push(msg, kvs)
         # a TS-merged push carries several workers' contributions at once
         # (ref: num_merge counting van.cc:1197-1252)
         num_merge = 1
@@ -1420,6 +1498,7 @@ class LocalServer:
         finished its last slice: ack (or park the piggyback pull), then
         dispatch any rounds the message completed.  Runs with no
         stripes held."""
+        poisoned = getattr(msg, "_gx_poisoned", None)
         if not self.sync_mode:
             # async local tier: no rounds — clear the aggregation state
             # FIRST (the accumulate lanes raised st.count, which blocks
@@ -1434,15 +1513,29 @@ class LocalServer:
                     st.completing = False  # no round to complete async
                     st.contributors.clear()
                     st.hfa_inv = 0.0
-                if msg.pull:
+                if msg.pull and poisoned is None:
                     self._try_serve_pull(msg)
+            if poisoned is not None:
+                # typed reject in place of the ack (the piggyback pull
+                # gets the error too, like a fence); nothing useful to
+                # forward — the payload was zeroed
+                self._recent.mark_done(msg, poisoned)
+                self.server.response(msg, body=poisoned)
+                return
             if not msg.pull:
                 self._recent.mark_done(msg)
                 self.server.response(msg)
             self._push_up(KVPairs(kvs.keys, kvs.vals.astype(np.float32),
                                   kvs.lens))
             return
-        if msg.pull:
+        if poisoned is not None:
+            # sync tier: the zeroed contribution already counted toward
+            # the round barrier on the lanes; the sender is told loudly
+            # instead of acked (a piggyback pull is NOT parked — the
+            # error rides the push response, exactly like a fence)
+            self._recent.mark_done(msg, poisoned)
+            self.server.response(msg, body=poisoned)
+        elif msg.pull:
             # P3 piggyback: the push response carries the updated values
             # once the round completes (ref: server replies with values in
             # the push-response when enable_p3, kvstore_dist_server.h:
@@ -1463,6 +1556,7 @@ class LocalServer:
         (ref: row-sparse server merge kvstore_dist_server.h row_sparse
         handlers).  The client rejects HFA×row-sparse, but guard here too
         — adopting a gradient sum as HFA weights would corrupt training."""
+        from geomx_tpu.compression import codecs as codecs_mod
         from geomx_tpu.compression.codecs import unpack_rows
 
         state = self._recent.check(msg)
@@ -1481,11 +1575,39 @@ class LocalServer:
             self.server.response(msg, body=err)
             return
         cols = int(msg.body["rs_cols"])
-        row_ids, rows = unpack_rows(kvs.vals, cols)
         key = int(kvs.keys[0])
+        try:
+            row_ids, rows = unpack_rows(kvs.vals, cols)
+            # bounds BEFORE the merge lane: a corrupt negative row id
+            # would silently wrap through np.add.at into the wrong row
+            with self._mu.stripe(key):
+                nrows = (len(self.store[key]) // cols
+                         if key in self.store and cols else None)
+            if nrows is not None:
+                codecs_mod._check_index_bounds(row_ids, nrows, "rows", key)
+        except codecs_mod.CodecError as e:
+            self.integrity_codec_rejects += 1
+            from geomx_tpu.utils.metrics import system_counter
+
+            system_counter(f"{self.po.node}.integrity_codec_rejects").inc()
+            if self._flight is not None:
+                self._flight.record(FlightEv.CORRUPT, peer=msg.sender,
+                                    note="corrupt_codec_payload")
+            err = {"error": f"row-sparse push rejected before merge: {e}"}
+            self._recent.mark_done(msg, err)
+            self.server.response(msg, body=err)
+            return
         sender_s = str(msg.sender)
         self._bootstrapping.discard(sender_s)
         self._saw_row_sparse = True
+        # gradient hygiene on the unpacked rows only — the packed
+        # row-id halves are bit-cast integers and may legitimately look
+        # non-finite as floats
+        if (self.config.integrity_push_screen
+                and not self._backend.screen_finite(
+                    rows, self.config.poison_mag_max)):
+            msg._gx_poisoned = self._poison_strike(sender_s)
+            rows = np.zeros_like(rows)
 
         # rides the key's merge lane like every other mutation of this
         # key, so row-sparse and dense pushes of one key keep their
@@ -1499,11 +1621,14 @@ class LocalServer:
                     dense = np.zeros_like(self.store[key], dtype=np.float32)
                     np.add.at(dense.reshape(-1, cols), row_ids, rows)
                     self._drain_parked_locked(st)
-                self._recent.mark_done(msg)
-                self.server.response(msg)
-                self._push_up(KVPairs(kvs.keys, dense,
-                                      np.array([len(dense)], np.int64)),
-                              rs_keys={key})
+                err = getattr(msg, "_gx_poisoned", None)
+                self._recent.mark_done(msg, err)
+                self.server.response(msg, body=err)
+                if err is None:
+                    self._push_up(KVPairs(
+                        kvs.keys, dense,
+                        np.array([len(dense)], np.int64)),
+                        rs_keys={key})
                 return
             bundle = None
             with self._mu.stripe(key):
@@ -1523,8 +1648,9 @@ class LocalServer:
                 if (st.count >= (st.expected or self.num_workers)
                         and not st.completing):
                     bundle = self._take_completed_locked(key)
-            self._recent.mark_done(msg)
-            self.server.response(msg)
+            err = getattr(msg, "_gx_poisoned", None)
+            self._recent.mark_done(msg, err)
+            self.server.response(msg, body=err)
             if bundle is not None:
                 self._dispatch_rounds([bundle])
 
@@ -2422,6 +2548,10 @@ class LocalServer:
             "catchup_pushes": self.catchup_pushes,
             "catchup_fallbacks": self.catchup_fallbacks,
             "quarantined_workers": len(self._quarantined_members),
+            # data-integrity observability (gradient hygiene)
+            "integrity_poison_rejects": self.integrity_poison_rejects,
+            "poison_quarantines": self.poison_quarantines,
+            "integrity_codec_rejects": self.integrity_codec_rejects,
             "mpq_bsc_picks": getattr(self.push_codec, "bsc_picks", 0),
             "mpq_fp16_picks": getattr(self.push_codec, "fp16_picks", 0),
             "pq_overtakes": van.pq_overtakes,
@@ -2634,6 +2764,14 @@ class GlobalServer:
         self.policy_fenced_pushes = 0
         self.rejected_compr_tags = 0
         self.catchup_merges = 0  # healed-party Cmd.CATCHUP deltas merged
+        # gradient hygiene at the WAN tier (Config.integrity_push_screen)
+        self._poison_strikes: Dict[str, int] = {}
+        self.integrity_poison_rejects = 0
+        # verified durable state (GEOMX_INTEGRITY_CKPT): corrupt
+        # checkpoint generations / replication snapshots rejected
+        self.integrity_ckpt_rejects = 0
+        # structurally-corrupt compressed payloads fenced at decode time
+        self.integrity_codec_rejects = 0
         # per-endpoint stateful-decoder cache (replaces the process-wide
         # _TWOBIT_DECODERS dict two concurrent Simulations used to share)
         from geomx_tpu.compression import DecoderBank
@@ -2944,7 +3082,27 @@ class GlobalServer:
         if msg.push and msg.request and self._reject_bad_push(msg):
             return  # fenced at message-decode time, before any merge
         if msg.push and msg.compr and kvs is not None:
-            kvs = self._decompress_push(msg, kvs)
+            try:
+                kvs = self._decompress_push(msg, kvs)
+            except CodecError as e:
+                # a truncated / bit-rotted payload that slipped past (or
+                # never crossed) the wire checksums: fence the one push,
+                # never the merge thread.  Like _reject_bad_push this
+                # sits ahead of the replay-dedup window, so the sender's
+                # retried re-encode is processed fresh.
+                self.integrity_codec_rejects += 1
+                from geomx_tpu.utils.metrics import system_counter
+
+                system_counter(
+                    f"{self.po.node}.integrity_codec_rejects").inc()
+                if self._flight is not None:
+                    self._flight.record(FlightEv.CORRUPT, d=msg.boot,
+                                        peer=msg.sender,
+                                        note="corrupt_codec_payload")
+                self.server.response(msg, body={
+                    "error": f"corrupt compressed push from {msg.sender} "
+                             f"refused before merge: {e}"})
+                return
         if msg.push:
             if msg.cmd == Cmd.CATCHUP:
                 # partition heal: a quarantined party's bounded degraded-
@@ -3011,6 +3169,42 @@ class GlobalServer:
             return True
         return False
 
+    def _screen_push(self, msg: Message, kvs: KVPairs) -> KVPairs:
+        """Gradient-hygiene screen at the WAN tier — the belt to the
+        local tier's suspenders: a party whose local screen is off, or
+        whose merged gradient rotted past the wire checksums, must not
+        poison the global model.  A poisoned payload is replaced with
+        zeros and tagged via ``msg._gx_poisoned``; the sync path merges
+        the zero contribution (the round counts parties — a reject
+        without a merge would stall survivors) and the parked ack
+        carries the typed error, while the async/catch-up paths reject
+        outright.  Party-level quarantine deliberately stays the
+        scheduler's call — the ``data_corruption`` health rule surfaces
+        repeat offenders; folding out a whole party over NaNs is a far
+        bigger hammer than the local tier's single-worker quarantine."""
+        if not self.config.integrity_push_screen:
+            return kvs
+        if self._backend.screen_finite(kvs.vals,
+                                       self.config.poison_mag_max):
+            return kvs
+        sender_s = str(msg.sender)
+        self.integrity_poison_rejects += 1  # GIL-atomic, as the fences
+        strikes = self._poison_strikes.get(sender_s, 0) + 1
+        self._poison_strikes[sender_s] = strikes
+        from geomx_tpu.utils.metrics import system_counter
+
+        system_counter(f"{self.po.node}.integrity_poison_rejects").inc()
+        if self._flight is not None:
+            self._flight.record(FlightEv.CORRUPT, a=strikes,
+                                peer=sender_s, note="poison_push")
+        msg._gx_poisoned = {
+            "error": f"poisoned push rejected at the global tier: "
+                     f"payload from {sender_s} failed the finiteness/"
+                     f"magnitude screen (strike {strikes}); "
+                     "contribution zeroed"}
+        return KVPairs(kvs.keys, np.zeros(len(kvs.vals), np.float32),
+                       kvs.lens)
+
     def _decompress_push(self, msg: Message, kvs: KVPairs) -> KVPairs:
         """Decode a compressed gradient push to dense before aggregation
         (ref: BSCDecompress gradient_compression.cc:310-336; fp16/2bit
@@ -3072,6 +3266,8 @@ class GlobalServer:
             else:
                 self.server.response(msg, body=body)
             return
+        kvs = self._screen_push(msg, kvs)  # after dedup: retries of a
+        #                                    poisoned push don't restrike
         # an inter-TS-merged push carries several parties' contributions
         # (ref: num_merge counting in the global ASK_PUSH path)
         num_merge = 1
@@ -3256,6 +3452,11 @@ class GlobalServer:
 
     def _flush_completions(self, to_ack: List[tuple], dissem):
         for req, err in to_ack:
+            if err is None:
+                # a poisoned push completed its rounds with a zeroed
+                # contribution; its ack is the typed reject, and the
+                # piggyback pull (if any) gets the error, not values
+                err = getattr(req, "_gx_poisoned", None)
             self._recent.mark_done(req, err)
             if err is None and req.pull:
                 # P3 piggyback on the WAN tier: the push response carries
@@ -3302,6 +3503,14 @@ class GlobalServer:
                 self._respond_pull(msg)
             else:
                 self.server.response(msg, body=body)
+            return
+        self._screen_push(msg, kvs)
+        poisoned = getattr(msg, "_gx_poisoned", None)
+        if poisoned is not None:
+            # async tier: no round barrier to keep honest — reject
+            # outright before any optimizer touch
+            self._recent.mark_done(msg, poisoned)
+            self.server.response(msg, body=poisoned)
             return
         dissem = None
         with self._mu:
@@ -3364,6 +3573,15 @@ class GlobalServer:
             return
         if state == "done":
             self.server.response(msg, body=self._recent.done_body(msg))
+            return
+        self._screen_push(msg, kvs)
+        if getattr(msg, "_gx_poisoned", None) is not None:
+            # a NaN catch-up delta would poison every key it touches
+            # through the optimizer; the healed party re-syncs dense
+            # instead (same fallback as an invalidated delta)
+            err = msg._gx_poisoned
+            self._recent.mark_done(msg, err)
+            self.server.response(msg, body=err)
             return
         meta = (msg.body or {}).get("catchup", {}) \
             if isinstance(msg.body, dict) else {}
@@ -3625,6 +3843,12 @@ class GlobalServer:
 
         def write():
             try:
+                # N-generation retention (Config.ckpt_generations): the
+                # previous checkpoint shifts to path.1 (… path.N-1)
+                # BEFORE the new write lands, so a generation that rots
+                # on disk still leaves a verified older one for
+                # load_checkpoint's fallback scan
+                ckpt.rotate_generations(path, self.config.ckpt_generations)
                 ckpt.save_server_state(path, store_snap,
                                        {"optimizer": opt_snap}, meta)
             except Exception:  # any failure must not wedge _ckpt_busy —
@@ -3939,26 +4163,59 @@ class GlobalServer:
                 # range's term past anything the old stream carries.
                 from geomx_tpu.kvstore import checkpoint as ckpt
 
-                store, opt, meta = ckpt.loads_server_state(
-                    np.ascontiguousarray(kvs.vals).tobytes())
-                if self.is_standby:
-                    self._install_state_locked(store, opt, meta)
+                try:
+                    store, opt, meta = ckpt.loads_server_state(
+                        np.ascontiguousarray(kvs.vals).tobytes())
+                except ckpt.CheckpointCorruption as e:
+                    err = self._reject_corrupt_snapshot_locked(e, msg)
                 else:
-                    self._merge_state_locked(store, opt, meta)
-                self.merged_handoffs += 1
-                self._repl_seq = max(self._repl_seq, seq)
+                    if self.is_standby:
+                        self._install_state_locked(store, opt, meta)
+                    else:
+                        self._merge_state_locked(store, opt, meta)
+                    self.merged_handoffs += 1
+                    self._repl_seq = max(self._repl_seq, seq)
             elif seq > self._repl_seq and kvs is not None:
                 from geomx_tpu.kvstore import checkpoint as ckpt
                 from geomx_tpu.utils.metrics import system_gauge
 
-                store, opt, meta = ckpt.loads_server_state(
-                    np.ascontiguousarray(kvs.vals).tobytes())
-                self._install_state_locked(store, opt, meta)
-                self._repl_seq = seq
-                system_gauge(f"{self.po.node}.replication_seq").set(seq)
+                try:
+                    store, opt, meta = ckpt.loads_server_state(
+                        np.ascontiguousarray(kvs.vals).tobytes())
+                except ckpt.CheckpointCorruption as e:
+                    # the standby KEEPS its previous verified generation
+                    # — a rotted stream frame must never replace good
+                    # replica state; the primary's next mark re-ships
+                    err = self._reject_corrupt_snapshot_locked(e, msg)
+                else:
+                    self._install_state_locked(store, opt, meta)
+                    self._repl_seq = seq
+                    system_gauge(
+                        f"{self.po.node}.replication_seq").set(seq)
             # else: an out-of-order older snapshot — ack without applying
         self._recent.mark_done(msg, err)
         self.server.response(msg, body=err)
+
+    def _reject_corrupt_snapshot_locked(self, e: Exception,
+                                        msg: Message) -> dict:
+        """A replication/handoff snapshot failed checkpoint verification
+        (caller holds ``_mu``): count it, keep the state we already
+        have, and answer with a typed error.  The body deliberately
+        avoids the word "fenced" — the primary's Replicator reads
+        fence-flavored replies as a deposition signal, and one rotted
+        frame must not depose a healthy primary."""
+        self.integrity_ckpt_rejects += 1
+        from geomx_tpu.utils.metrics import system_counter
+
+        system_counter(f"{self.po.node}.integrity_ckpt_rejects").inc()
+        if self._flight is not None:
+            self._flight.record(FlightEv.CORRUPT, peer=msg.sender,
+                                note="corrupt_snapshot")
+        print(f"{self.po.node}: rejected corrupt replication snapshot "
+              f"from {msg.sender} ({e}) — keeping previous generation",
+              flush=True)
+        return {"error": "corrupt replication snapshot rejected "
+                         f"({e}); receiver keeps its previous state"}
 
     def _on_promote(self, msg: Message) -> bool:
         """Control.PROMOTE from the global scheduler: become the shard's
@@ -4049,7 +4306,29 @@ class GlobalServer:
         (GEOMX_CHECKPOINT_DIR)."""
         from geomx_tpu.kvstore import checkpoint as ckpt
 
-        store, opt, meta = ckpt.load_server_state(path)
+        store = opt = meta = None
+        last_err: Optional[Exception] = None
+        for i, cand in enumerate(ckpt.restore_candidates(path) or [path]):
+            try:
+                store, opt, meta = ckpt.load_server_state(cand)
+                break
+            except (ckpt.CheckpointCorruption, OSError) as e:
+                # newest generation rotted (or vanished): fall back to
+                # the next one that verifies instead of dying on it
+                last_err = e
+                self.integrity_ckpt_rejects += 1
+                from geomx_tpu.utils.metrics import system_counter
+
+                system_counter(
+                    f"{self.po.node}.integrity_ckpt_rejects").inc()
+                if self._flight is not None:
+                    self._flight.record(FlightEv.CORRUPT, a=i,
+                                        note="ckpt_fallback")
+                print(f"{self.po.node}: checkpoint {cand} failed "
+                      f"verification ({e}); trying previous generation",
+                      flush=True)
+        if store is None:
+            raise last_err  # no generation verified — caller surfaces it
         self._shards.drain()  # pre-restore merges must not land on the
         #                       restored state
         with self._mu:
@@ -4144,6 +4423,8 @@ class GlobalServer:
                         opt_snap = self._export_opt_locked()
                         meta = {"sync_mode": self.sync_mode,
                                 "compression": dict(self.compression)}
+                    ckpt.rotate_generations(body["path"],
+                                            self.config.ckpt_generations)
                     ckpt.save_server_state(
                         body["path"], store_snap,
                         {"optimizer": opt_snap}, meta)
@@ -4207,6 +4488,11 @@ class GlobalServer:
             "num_global_workers": self.num_contributors,
             # partition heals merged through the optimizer (Cmd.CATCHUP)
             "catchup_merges": self.catchup_merges,
+            # data-integrity observability: gradient hygiene + verified
+            # durable state (docs/deployment.md "Data integrity")
+            "integrity_poison_rejects": self.integrity_poison_rejects,
+            "integrity_ckpt_rejects": self.integrity_ckpt_rejects,
+            "integrity_codec_rejects": self.integrity_codec_rejects,
             # adaptive WAN: receiver-side epoch + fence observables
             "policy_epoch": self._policy_epoch,
             "policy_fenced_pushes": self.policy_fenced_pushes,

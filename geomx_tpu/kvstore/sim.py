@@ -445,6 +445,34 @@ class Simulation:
         system_counter(f"{gsched}.partition_heals").inc()
         self._stamp_netfault("netfault_heal", srv, extra=party)
 
+    def corrupt_link(self, a, b="*", rate: float = 1.0,
+                     mode: str = "bitflip", seed: int = 0):
+        """Seeded in-flight payload corruption on the link a→b: each
+        data frame is serialized, damaged (single seeded bit flip or a
+        seeded truncation — a deterministic per-rule tape) and decoded
+        back at the fabric, the rot a flaky NIC/switch buffer inflicts
+        on a real WAN.  The wire checksums (GEOMX_INTEGRITY_WIRE)
+        detect it and the NACK fast-resend recovers; with the flag off
+        the fabric's ``corrupt_delivered`` ledger counts how much
+        damage would have reached the merge silently."""
+        self.fabric.fault.corrupt(str(a), str(b), rate=rate, mode=mode,
+                                  seed=seed)
+        from geomx_tpu.utils.metrics import system_counter
+
+        gsched = str(self.topology.global_scheduler())
+        system_counter(f"{gsched}.corruption_cuts").inc()
+        self._stamp_netfault("netfault_corrupt", a)
+
+    def heal_corrupt(self, a=None, b=None):
+        """Undo :meth:`corrupt_link` rules (all of them with no args)."""
+        self.fabric.fault.heal_corrupt(None if a is None else str(a),
+                                       None if b is None else str(b))
+        from geomx_tpu.utils.metrics import system_counter
+
+        gsched = str(self.topology.global_scheduler())
+        system_counter(f"{gsched}.corruption_heals").inc()
+        self._stamp_netfault("netfault_corrupt_heal", a)
+
     def set_duplicate_rate(self, rate: float):
         """Message-duplication injection: each data message is
         re-delivered (a copy, ahead of the original) with probability

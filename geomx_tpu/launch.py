@@ -465,6 +465,26 @@ def _test_step_sleep_s(node) -> float:
         return 0.0
 
 
+def _test_poison_steps(node) -> tuple:
+    """Per-node poison injection for integrity acceptance runs
+    (scripts/run_integrity_demo.sh): env
+    ``GEOMX_TEST_POISON_STEPS='{"worker:1@p0": 40}'`` — from that step
+    on, this worker's pushed gradients are all-NaN.  Returns
+    ``(start_step,)`` or ``()``.  The payload corruption happens at the
+    gradient source, so every hop downstream (codec, wire, server
+    screen) sees exactly what a diverged or faulty worker produces."""
+    import json
+
+    raw = os.environ.get("GEOMX_TEST_POISON_STEPS")
+    if not raw:
+        return ()
+    try:
+        start = json.loads(raw).get(str(node))
+    except (ValueError, AttributeError, TypeError):
+        return ()
+    return () if start is None else (int(start),)
+
+
 def _worker_demo(po, kv, args, join_advertise=None):
     """The reference demo workload (examples/cnn.py) for launcher smoke
     runs: tiny CNN on synthetic data.  ``join_advertise``: this worker
@@ -491,6 +511,35 @@ def _worker_demo(po, kv, args, join_advertise=None):
         def grad_fn(p, xb, yb):  # noqa: F811 — deliberate wrap
             time.sleep(sleep_s)
             return inner(p, xb, yb)
+
+    poison_from = _test_poison_steps(po.node)
+    if poison_from:
+        # integrity-demo byzantine worker: from step N on, every pushed
+        # gradient is all-NaN.  The server screen zeroes the merge and
+        # answers with a typed rejection; claim those acks so this
+        # worker keeps stepping (a real diverged worker wouldn't stop
+        # either) instead of raising out of wait_all.
+        inner_g = grad_fn
+        step_ctr = [0]
+
+        def grad_fn(p, xb, yb):  # noqa: F811 — deliberate wrap
+            loss, acc, grads = inner_g(p, xb, yb)
+            step, step_ctr[0] = step_ctr[0], step_ctr[0] + 1
+            if step >= poison_from[0]:
+                grads = jax.tree_util.tree_map(
+                    lambda g: np.full(np.shape(g), np.nan, np.float32),
+                    grads)
+            return loss, acc, grads
+
+        prev_handler = kv.worker.error_handler
+
+        def _claim_poison_ack(m, _prev=prev_handler):
+            err = str((m.body or {}).get("error", ""))
+            if "poisoned push rejected" in err:
+                return True
+            return bool(_prev is not None and _prev(m))
+
+        kv.worker.error_handler = _claim_poison_ack
 
     def train(kv, params, it, steps, barrier_init):
         # HFA servers average WEIGHTS — pushing gradients at them (the

@@ -109,6 +109,18 @@ class FlightEv(enum.IntEnum):
     #                      postmortems can separate INJECTED cuts from
     #                      organic silence and audit every quarantine
     #                      state-machine edge without logs
+    CORRUPT = 22         # data-integrity plane verdict: note=
+    #                      wire_nack_resend (sender retransmitting after
+    #                      a receiver checksum NACK), poison_push (a
+    #                      NaN/Inf/oversized push zeroed out of a merge),
+    #                      poison_quarantine (sender crossed the strike
+    #                      budget), corrupt_snapshot (standby rejected a
+    #                      REPLICATE slab), ckpt_fallback (restore
+    #                      skipped an unverifiable generation); peer=the
+    #                      offending sender/file, a=strike count or
+    #                      generation — the health engine's
+    #                      data_corruption rule reads the same counters,
+    #                      the flight tape gives the per-event trail
 
 
 _EV_NAMES = {int(e): e.name for e in FlightEv}

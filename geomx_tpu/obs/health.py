@@ -83,7 +83,16 @@ _FENCE_KEYS = ("eviction_fenced_pushes", "fenced_rejects",
 RULES = ("round_stall", "replication_lag", "shard_imbalance",
          "goodput_collapse", "rtt_outlier", "fence_spike",
          "replica_staleness", "churn_storm", "serve_overload",
-         "replica_flap", "net_partition")
+         "replica_flap", "net_partition", "data_corruption")
+
+# counters summed per node by the data_corruption rule: every reject
+# the integrity plane produces (wire checksum mismatches, poisoned
+# gradient pushes, corrupt checkpoint/replication snapshots) plus the
+# quarantines they escalated into — a repeat offender shows up as a
+# sustained per-node rate here long before training loss moves
+_INTEGRITY_KEYS = ("integrity_wire_rejects", "integrity_wire_nacks",
+                   "integrity_poison_rejects", "integrity_ckpt_rejects",
+                   "integrity_codec_rejects", "poison_quarantines")
 
 # membership-transition counters summed by the churn_storm rule: the
 # churn orchestrator's injected-event family (registered on the global
@@ -185,7 +194,8 @@ class HealthEngine:
                      self._rule_rtt_outlier, self._rule_fence_spike,
                      self._rule_replica_staleness, self._rule_churn_storm,
                      self._rule_serve_overload, self._rule_replica_flap,
-                     self._rule_net_partition):
+                     self._rule_net_partition,
+                     self._rule_data_corruption):
             try:
                 records.extend(rule(now))
             except Exception:  # one broken rule must not mute the rest
@@ -446,6 +456,43 @@ class HealthEngine:
                 message=f"{total:.0f} fenced/evicted events in the "
                         f"window (threshold {self.fence_spike})",
                 events=total, threshold=self.fence_spike)
+            if rec:
+                out.append(rec)
+        return out
+
+    def _rule_data_corruption(self, now: float) -> List[dict]:
+        """Sustained integrity rejects from one node mean its data path
+        is rotting — a flaky NIC corrupting frames, a worker emitting
+        NaN gradients, a disk eating checkpoint generations.  Any
+        single reject is survivable by design (checksum → NACK resend,
+        poison → zeroed + typed error, corrupt snapshot → previous
+        generation); this rule pages when the RATE says the fault is
+        chronic, naming the offender the quarantine machinery is
+        already throttling."""
+        bound = int(getattr(self.config, "obs_corruption_events", 8))
+        out = []
+        for node in self.collector.nodes():
+            total = 0.0
+            quarantines = 0.0
+            seen = False
+            for key in _INTEGRITY_KEYS:
+                pts = self.collector.series(node, key)
+                if len(pts) >= 2:
+                    seen = True
+                    delta = pts[-1][1] - pts[0][1]
+                    total += delta
+                    if key == "poison_quarantines":
+                        quarantines += delta
+            if not seen:
+                continue
+            rec = self._set_state(
+                "data_corruption", node, total > bound, now,
+                severity="critical" if quarantines else "warn",
+                message=f"{total:.0f} integrity rejects in the window "
+                        f"(threshold {bound}"
+                        + (f", {quarantines:.0f} quarantines)"
+                           if quarantines else ")"),
+                events=total, quarantines=quarantines, threshold=bound)
             if rec:
                 out.append(rec)
         return out

@@ -225,19 +225,30 @@ class DgtReassembler:
             self._done_order.append(key)
             if len(self._done_order) > self._done_cap:
                 self._done.discard(self._done_order.popleft())
-        total = final.total_bytes
+        total = max(0, int(final.total_bytes))
         vals = np.zeros(total, dtype=final.vals.dtype)
         for s, chunk in have.items():
-            off = chunk.val_bytes
-            meta4 = (chunk.body or {}).get("_dgt4") if isinstance(
-                chunk.body, dict) else None
-            if meta4 is not None:
-                dec = dequant4(chunk.vals, meta4["n"], meta4["lo"],
-                               meta4["hi"])
-                vals[off:off + len(dec)] = dec
-                self.dgt4_decoded += 1
-            else:
-                vals[off:off + len(chunk.vals)] = chunk.vals
+            # defensive bounds: a chunk that decoded despite in-flight
+            # damage (legacy unstamped frames) may carry a nonsense
+            # offset/length — scatter it nowhere (≡ a lost lossy chunk,
+            # zero-filled) instead of raising out of the receive path
+            try:
+                off = int(chunk.val_bytes)
+                meta4 = (chunk.body or {}).get("_dgt4") if isinstance(
+                    chunk.body, dict) else None
+                if meta4 is not None:
+                    dec = dequant4(chunk.vals, int(meta4["n"]),
+                                   meta4["lo"], meta4["hi"])
+                else:
+                    dec = chunk.vals
+                n = len(dec)
+                if off < 0 or off + n > total:
+                    continue
+                vals[off:off + n] = dec
+                if meta4 is not None:
+                    self.dgt4_decoded += 1
+            except (ValueError, TypeError, KeyError, OverflowError):
+                continue
         out = Message(
             sender=final.sender, recipient=final.recipient,
             domain=final.domain, app_id=final.app_id,

@@ -29,6 +29,51 @@ from geomx_tpu.core.config import NodeId
 # either side may upgrade first.
 WIRE_V2 = os.environ.get("GEOMX_WIRE_FORMAT", "v2").strip().lower() != "v1"
 
+# Wire-integrity stamping (``GEOMX_INTEGRITY_WIRE=1`` /
+# Config.enable_integrity_wire; off by default).  When on, every v2
+# frame carries two 32-bit checksums between the meta blob and the
+# array descriptors — one over the fixed header + meta pickle, one over
+# the descriptors + payload bytes — and a marker in the header's first
+# spare byte says they are present.  The DECODER keys on the marker,
+# not on this flag, so a stamped frame verifies wherever it lands and
+# an unstamped (legacy) frame is accepted unchanged; with the flag off
+# the encoder output is bit-for-bit the legacy frame.
+WIRE_INTEGRITY = (os.environ.get("GEOMX_INTEGRITY_WIRE", "")
+                  .strip().lower() in ("1", "true", "yes", "on"))
+
+# crc32c (Castagnoli) when a native wheel is available; zlib's crc32 is
+# the always-present fallback — same 32-bit space, same chaining API,
+# and C speed either way.  Both sides of one deployment share a build,
+# so the polynomial choice never splits a cluster.
+try:  # pragma: no cover - depends on the host image
+    from crc32c import crc32c as _crc32
+except ImportError:
+    from zlib import crc32 as _crc32
+
+
+def wire_checksum(data, value: int = 0) -> int:
+    """Checksum one buffer (chainable: pass the previous value)."""
+    return _crc32(data, value) & 0xFFFFFFFF
+
+
+class WireCorruption(ValueError):
+    """A v2 frame failed its integrity check (or could not be parsed
+    past a verified checksum block).  Carries whatever header identity
+    survived verification so the receiving fabric can count the reject
+    and NACK the sender's resender (``sender`` is ``""`` when the
+    header/meta region itself failed — nothing in the frame can be
+    trusted, and recovery is the sender's resend timer)."""
+
+    def __init__(self, what: str, *, sender: str = "", msg_sig: int = -1,
+                 boot: int = 0, channel: int = 0, domain=None):
+        super().__init__(f"wire integrity: {what}")
+        self.what = what
+        self.sender = sender
+        self.msg_sig = msg_sig
+        self.boot = boot
+        self.channel = channel
+        self.domain = domain
+
 
 class Control(enum.Enum):
     """Control message types (ref: message.h:125-137)."""
@@ -110,6 +155,16 @@ class Control(enum.Enum):
     #                    indirect probes still hear the suspect
     #                    QUARANTINES instead of evicting (kvstore/
     #                    eviction.py; docs/deployment.md)
+    NACK = 20          # wire-integrity negative ack (data-integrity
+    #                    plane, GEOMX_INTEGRITY_WIRE): a receiver whose
+    #                    frame failed its checksum tells the sender's
+    #                    resender to retransmit NOW instead of waiting
+    #                    out the resend backoff.  msg_sig names the
+    #                    corrupted message; the van treats it as "reset
+    #                    the retry clock and resend" — the replay-dedup
+    #                    window absorbs the case where an uncorrupted
+    #                    copy also arrived.  Best-effort: a lost NACK
+    #                    just falls back to the resend timer.
 
 
 class Domain(enum.Enum):
@@ -278,6 +333,11 @@ class Message:
     _HDR = struct.Struct("<B B i i q B B B i i q q q q q B q q q q q q q")
     _V2_MAGIC = -20206
     _DTYPE_WHITELIST = frozenset("?bhilqBHILQefdg")  # bool/int/uint/float
+    # byte offset (within the packed header) of the first spare pad
+    # byte, reused as the integrity marker: 0 = plain legacy frame,
+    # 1 = an 8-byte checksum block follows the meta blob.  The second
+    # spare byte stays reserved.
+    _INTEGRITY_BYTE = 19
 
     def _meta_blob(self) -> bytes:
         return pickle.dumps({
@@ -287,12 +347,13 @@ class Message:
             "compr": self.compr,
         }, protocol=4)
 
-    def _pack_hdr(self) -> bytes:
+    def _pack_hdr(self, integrity: bool = False) -> bytes:
         flags = ((self.request << 0) | (self.push << 1) | (self.pull << 2)
                  | (self.sampled << 3))
         return self._HDR.pack(
             self.control.value, self.domain.value, self.app_id, self.customer_id,
-            self.timestamp, flags, 0, 0, self.cmd, self.priority,
+            self.timestamp, flags, 1 if integrity else 0, 0, self.cmd,
+            self.priority,
             self.first_key, self.seq, self.seq_begin, self.seq_end,
             self.total_bytes, self.channel, self.val_bytes, self.msg_sig,
             self.boot, self.trace_id, self.span_id, self.parent_span_id,
@@ -303,17 +364,21 @@ class Message:
         """Serialize to a scatter-gather buffer list (v2): one small
         prelude + each payload array's own memory, uncopied.  The
         caller must finish transmitting before mutating the arrays
-        (the fabric sends synchronously, so this holds)."""
-        prelude = io.BytesIO()
-        prelude.write(struct.pack("<i", self._V2_MAGIC))
-        prelude.write(self._pack_hdr())
+        (the fabric sends synchronously, so this holds).
+
+        With ``WIRE_INTEGRITY`` on, an 8-byte checksum block
+        (``<II``: header+meta crc, descriptor+payload crc) sits between
+        the meta blob and the descriptors, announced by the header's
+        integrity marker byte; off (the default) the output is
+        bit-for-bit the legacy frame."""
+        integrity = WIRE_INTEGRITY
+        hdr = self._pack_hdr(integrity=integrity)
         meta_b = self._meta_blob()
-        prelude.write(struct.pack("<i", len(meta_b)))
-        prelude.write(meta_b)
+        descr = io.BytesIO()
         arrs = []
         for a in (self.keys, self.vals, self.lens):
             if a is None:
-                prelude.write(b"\x00")
+                descr.write(b"\x00")
                 arrs.append(None)
                 continue
             a = np.asarray(a)
@@ -324,25 +389,40 @@ class Message:
             if a.dtype.char not in self._DTYPE_WHITELIST:
                 raise TypeError(
                     f"non-plain dtype {a.dtype} cannot ride the wire")
-            descr = a.dtype.str.encode("ascii")
-            prelude.write(struct.pack("<B", len(descr)))
-            prelude.write(descr)
-            prelude.write(struct.pack("<B", a.ndim))
-            for d in a.shape:
-                prelude.write(struct.pack("<q", d))
+            d = a.dtype.str.encode("ascii")
+            descr.write(struct.pack("<B", len(d)))
+            descr.write(d)
+            descr.write(struct.pack("<B", a.ndim))
+            for dim in a.shape:
+                descr.write(struct.pack("<q", dim))
             arrs.append(a)
-        frames = [prelude.getvalue()]
-        off = len(frames[0])
+        descr_b = descr.getvalue()
+        meta_len_b = struct.pack("<i", len(meta_b))
+        head = 4 + len(hdr) + 4 + len(meta_b) \
+            + (8 if integrity else 0) + len(descr_b)
+        payload_frames = []
+        off = head
         for a in arrs:
             if a is None or a.nbytes == 0:
                 continue
             pad = -off % 8
             if pad:
-                frames.append(b"\x00" * pad)
+                payload_frames.append(b"\x00" * pad)
                 off += pad
-            frames.append(memoryview(a.reshape(-1).view(np.uint8)))
+            payload_frames.append(memoryview(a.reshape(-1).view(np.uint8)))
             off += a.nbytes
-        return frames
+        if integrity:
+            crc_meta = wire_checksum(hdr + meta_len_b + meta_b)
+            crc_payload = wire_checksum(descr_b)
+            for f in payload_frames:
+                crc_payload = wire_checksum(f, crc_payload)
+            crc_block = struct.pack("<II", crc_meta, crc_payload)
+            prelude = b"".join((struct.pack("<i", self._V2_MAGIC), hdr,
+                                meta_len_b, meta_b, crc_block, descr_b))
+        else:
+            prelude = b"".join((struct.pack("<i", self._V2_MAGIC), hdr,
+                                meta_len_b, meta_b, descr_b))
+        return [prelude] + payload_frames
 
     def to_bytes(self) -> bytes:
         if not WIRE_V2:
@@ -375,6 +455,12 @@ class Message:
 
     @classmethod
     def _unpack_hdr(cls, data, off: int) -> dict:
+        if off + cls._HDR.size > len(data):
+            # explicit bound: the v2 caller pre-checks, but the v1 path
+            # trusts a length prefix the frame itself carried — a
+            # truncated buffer must fail typed, not with a raw
+            # struct.error inside the framing
+            raise ValueError("truncated frame (header)")
         (control, domain, app_id, customer_id, timestamp, flags, _, _, cmd,
          priority, first_key, seq, seq_begin, seq_end, total_bytes, channel,
          val_bytes, msg_sig, boot, trace_id, span_id, parent_span_id,
@@ -403,52 +489,95 @@ class Message:
         adopt contract with no memcpy.  Read-only input (a UDP
         datagram's bytes) yields read-only views; the adopt gate then
         takes its defensive copy."""
+        if len(data) < 4:
+            raise ValueError("truncated frame (length prefix)")
         (first,) = struct.unpack_from("<i", data, 0)
         if first != cls._V2_MAGIC:
             return cls._from_bytes_v1(data, first)
         off = 4
-        fields = cls._unpack_hdr(data, off)
+        if off + cls._HDR.size + 4 > len(data):
+            raise ValueError("truncated v2 frame (header)")
+        marker = data[off + cls._INTEGRITY_BYTE]
+        hdr_start = off
         off += cls._HDR.size
         (meta_len,) = struct.unpack_from("<i", data, off)
         off += 4
         if meta_len < 0 or off + meta_len > len(data):
             raise ValueError("truncated v2 frame (meta)")
+        if marker:
+            # verify the header+meta span BEFORE header enum decoding
+            # and unpickling: a frame that fails here is untrustworthy
+            # end to end (the header identity included), so the error
+            # carries no NACK target
+            if off + meta_len + 8 > len(data):
+                raise WireCorruption("truncated checksum block")
+            crc_meta, crc_payload = struct.unpack_from(
+                "<II", data, off + meta_len)
+            got = wire_checksum(
+                memoryview(data)[hdr_start:off + meta_len])
+            if got != crc_meta:
+                raise WireCorruption("header/meta checksum mismatch")
+        fields = cls._unpack_hdr(data, hdr_start)
         meta = pickle.loads(bytes(data[off:off + meta_len]))
         off += meta_len
-        descrs = []
-        for _ in range(3):
-            (dlen,) = struct.unpack_from("<B", data, off)
-            off += 1
-            if dlen == 0:
-                descrs.append(None)
-                continue
-            if off + dlen + 1 > len(data):
-                raise ValueError("truncated v2 frame (descriptor)")
-            dt = np.dtype(bytes(data[off:off + dlen]).decode("ascii"))
-            off += dlen
-            (ndim,) = struct.unpack_from("<B", data, off)
-            off += 1
-            shape = struct.unpack_from(f"<{ndim}q", data, off)
-            off += 8 * ndim
-            descrs.append((dt, tuple(shape)))
-        arrs = []
-        for d in descrs:
-            if d is None:
-                arrs.append(None)
-                continue
-            dt, shape = d
-            count = 1
-            for s in shape:
-                count *= s
-            if count:
-                off += -off % 8
-                if off + count * dt.itemsize > len(data):
-                    raise ValueError("truncated v2 frame (payload)")
-            a = np.frombuffer(data, dtype=dt, count=count, offset=off)
-            off += count * dt.itemsize
-            if len(shape) != 1:
-                a = a.reshape(shape)
-            arrs.append(a)
+        if marker:
+            off += 8
+        payload_start = off
+        try:
+            descrs = []
+            for _ in range(3):
+                (dlen,) = struct.unpack_from("<B", data, off)
+                off += 1
+                if dlen == 0:
+                    descrs.append(None)
+                    continue
+                if off + dlen + 1 > len(data):
+                    raise ValueError("truncated v2 frame (descriptor)")
+                dt = np.dtype(bytes(data[off:off + dlen]).decode("ascii"))
+                off += dlen
+                (ndim,) = struct.unpack_from("<B", data, off)
+                off += 1
+                shape = struct.unpack_from(f"<{ndim}q", data, off)
+                off += 8 * ndim
+                descrs.append((dt, tuple(shape)))
+            arrs = []
+            for d in descrs:
+                if d is None:
+                    arrs.append(None)
+                    continue
+                dt, shape = d
+                count = 1
+                for s in shape:
+                    count *= s
+                if count:
+                    off += -off % 8
+                    if off + count * dt.itemsize > len(data):
+                        raise ValueError("truncated v2 frame (payload)")
+                a = np.frombuffer(data, dtype=dt, count=count, offset=off)
+                off += count * dt.itemsize
+                if len(shape) != 1:
+                    a = a.reshape(shape)
+                arrs.append(a)
+        except WireCorruption:
+            raise
+        except (ValueError, TypeError, UnicodeDecodeError,
+                struct.error) as e:
+            if marker:
+                # the verified meta names the sender — NACKable
+                raise WireCorruption(
+                    f"payload parse failed ({e})",
+                    sender=meta.get("sender", ""),
+                    msg_sig=fields["msg_sig"], boot=fields["boot"],
+                    channel=fields["channel"], domain=fields["domain"])
+            raise
+        if marker:
+            got = wire_checksum(memoryview(data)[payload_start:off])
+            if got != crc_payload:
+                raise WireCorruption(
+                    "payload checksum mismatch",
+                    sender=meta.get("sender", ""),
+                    msg_sig=fields["msg_sig"], boot=fields["boot"],
+                    channel=fields["channel"], domain=fields["domain"])
         return cls(
             sender=NodeId.parse(meta["sender"]) if meta["sender"] else None,
             recipient=(NodeId.parse(meta["recipient"])
@@ -468,6 +597,8 @@ class Message:
         off += hlen
         blobs = []
         for _ in range(4):
+            if off + 8 > len(data):
+                raise ValueError("truncated v1 frame")
             (blen,) = struct.unpack_from("<q", data, off); off += 8
             if blen < 0 or off + blen > len(data):
                 raise ValueError("truncated v1 frame")

@@ -28,9 +28,10 @@ from typing import Dict, Optional, Tuple
 
 from geomx_tpu.core.config import Config, NodeId, Topology
 from geomx_tpu.transport import message as _message
-from geomx_tpu.transport.message import Message
+from geomx_tpu.transport.message import (Control, Domain, Message,
+                                         WireCorruption)
 from geomx_tpu.transport.reactor import Reactor, resolve_transport
-from geomx_tpu.transport.van import FaultPolicy, _Mailbox
+from geomx_tpu.transport.van import FaultPolicy, _Mailbox, corrupt_bytes
 
 
 class _RecvConn:
@@ -41,14 +42,15 @@ class _RecvConn:
     ``Message.from_bytes`` — zero-copy views over the receive buffer,
     exactly the wire-v2 contract the thread path honors."""
 
-    __slots__ = ("fabric", "sock", "box", "_hdr", "_hdr_view", "_hdr_got",
-                 "_buf", "_view", "_got", "_need", "_reg")
+    __slots__ = ("fabric", "sock", "box", "node_s", "_hdr", "_hdr_view",
+                 "_hdr_got", "_buf", "_view", "_got", "_need", "_reg")
 
     def __init__(self, fabric: "TcpFabric", sock: socket.socket,
-                 box: _Mailbox):
+                 box: _Mailbox, node_s: str = ""):
         self.fabric = fabric
         self.sock = sock
         self.box = box
+        self.node_s = node_s
         self._hdr = bytearray(8)
         self._hdr_view = memoryview(self._hdr)
         self._hdr_got = 0
@@ -96,6 +98,11 @@ class _RecvConn:
                     # the ``donated`` contract lets servers adopt them
                     try:
                         self.box.put(Message.from_bytes(buf))
+                    except WireCorruption as e:
+                        # checksum verdict on a complete frame: the
+                        # length-prefix framing is INTACT, so the stream
+                        # stays up — reject the frame, NACK the sender
+                        self.fabric._on_corrupt_frame(self.node_s, e)
                     except Exception:
                         # a malformed frame poisons the stream framing —
                         # drop the connection like the thread path does
@@ -325,6 +332,51 @@ class TcpFabric:
         # failover / replication / eviction counters
         self._sys_dropped = None
         self._sys_udp_dropped = None
+        # data-integrity ledger: frames a receiver's checksum rejected
+        # (per-node counters live in the metrics registry)
+        self.corrupt_rejected = 0
+        self._integrity_counters: Dict[str, object] = {}
+
+    def _count_integrity_reject(self, node_s: str):
+        with self._registry_mu:
+            self.corrupt_rejected += 1
+        if not node_s:
+            return
+        c = self._integrity_counters.get(node_s)
+        if c is None:
+            from geomx_tpu.utils.metrics import system_counter
+
+            c = self._integrity_counters.setdefault(
+                node_s, system_counter(f"{node_s}.integrity_wire_rejects"))
+            # first reject for this receiver only — the counter carries
+            # the volume, the log line is the operator breadcrumb
+            print(f"{node_s}: wire checksum rejected a corrupt frame "
+                  "(counted in integrity_wire_rejects)", flush=True)
+        c.inc()
+
+    def _on_corrupt_frame(self, node_s: str, err: WireCorruption):
+        """A complete TCP frame failed its checksum.  Count the reject,
+        then NACK the sender (when the verified meta named one) so its
+        resender retransmits NOW instead of waiting out the backoff.
+        The NACK is sent from a short-lived thread: deliver() may dial
+        a cold connection, and neither the reactor loop nor a recv
+        thread may block on that."""
+        self._count_integrity_reject(node_s)
+        if not err.sender or err.msg_sig < 0 or err.channel != 0:
+            return  # no trustworthy sender identity, or a lossy channel
+        nack = Message(sender=node_s, recipient=err.sender,
+                       control=Control.NACK,
+                       domain=err.domain or Domain.LOCAL,
+                       msg_sig=err.msg_sig, boot=err.boot)
+
+        def _send():
+            try:
+                self.deliver(nack)
+            except (KeyError, OSError):
+                pass  # sender unreachable: its resend timer recovers
+
+        threading.Thread(target=_send, daemon=True,
+                         name=f"tcp-nack-{node_s}").start()
 
     def _count_drop(self, udp: bool = False):
         """Ledger a lost message (caller holds ``_registry_mu``)."""
@@ -403,18 +455,20 @@ class TcpFabric:
             srv.setblocking(False)
             udp.setblocking(False)
             self._reactor_regs.append(self.reactor.register(
-                srv, read_cb=lambda: self._accept_ready(srv, box)))
+                srv, read_cb=lambda: self._accept_ready(srv, box, s)))
             self._reactor_regs.append(self.reactor.register(
-                udp, read_cb=lambda: self._udp_ready(udp, box)))
+                udp, read_cb=lambda: self._udp_ready(udp, box, s)))
         else:
-            threading.Thread(target=self._accept_loop, args=(srv, box),
+            threading.Thread(target=self._accept_loop, args=(srv, box, s),
                              name=f"tcp-accept-{s}", daemon=True).start()
-            threading.Thread(target=self._udp_recv_loop, args=(udp, box),
+            threading.Thread(target=self._udp_recv_loop,
+                             args=(udp, box, s),
                              name=f"udp-recv-{s}", daemon=True).start()
         return box
 
     # ---- reactor-mode readiness callbacks -----------------------------------
-    def _accept_ready(self, srv: socket.socket, box: _Mailbox):
+    def _accept_ready(self, srv: socket.socket, box: _Mailbox,
+                      node_s: str):
         while not self._stop:
             try:
                 conn, _ = srv.accept()
@@ -422,11 +476,12 @@ class TcpFabric:
                 return
             except OSError:
                 return
-            rc = _RecvConn(self, conn, box)
+            rc = _RecvConn(self, conn, box, node_s)
             with self._registry_mu:
                 self._accepted.append(rc)
 
-    def _udp_ready(self, sock: socket.socket, box: _Mailbox):
+    def _udp_ready(self, sock: socket.socket, box: _Mailbox,
+                   node_s: str):
         while not self._stop:
             try:
                 data, _ = sock.recvfrom(65535)
@@ -438,13 +493,20 @@ class TcpFabric:
                 continue  # shutdown poke
             try:
                 msg = Message.from_bytes(data)
+            except WireCorruption:
+                # checksum verdict on a lossy datagram: counted but
+                # never NACKed — DGT chunks are never retransmitted, and
+                # the reassembler zero-fills the hole by design
+                self._count_integrity_reject(node_s)
+                continue
             except Exception:
                 continue  # truncated/corrupt datagram: lossy by design
             with self._registry_mu:
                 self.udp_datagrams_recv += 1
             box.put(msg)
 
-    def _udp_recv_loop(self, sock: socket.socket, box: _Mailbox):
+    def _udp_recv_loop(self, sock: socket.socket, box: _Mailbox,
+                       node_s: str):
         while not self._stop:
             try:
                 data, _ = sock.recvfrom(65535)
@@ -452,6 +514,9 @@ class TcpFabric:
                 return
             try:
                 msg = Message.from_bytes(data)
+            except WireCorruption:
+                self._count_integrity_reject(node_s)  # see _udp_ready
+                continue
             except Exception:
                 continue  # truncated/corrupt datagram: lossy by design
             with self._registry_mu:
@@ -473,16 +538,19 @@ class TcpFabric:
                 self._udp_send[channel] = s
             return s
 
-    def _accept_loop(self, srv: socket.socket, box: _Mailbox):
+    def _accept_loop(self, srv: socket.socket, box: _Mailbox,
+                     node_s: str):
         while not self._stop:
             try:
                 conn, _ = srv.accept()
             except OSError:
                 return
-            threading.Thread(target=self._recv_loop, args=(conn, box),
+            threading.Thread(target=self._recv_loop,
+                             args=(conn, box, node_s),
                              daemon=True).start()
 
-    def _recv_loop(self, conn: socket.socket, box: _Mailbox):
+    def _recv_loop(self, conn: socket.socket, box: _Mailbox,
+                   node_s: str = ""):
         with self._registry_mu:
             self._accepted.append(conn)
         try:
@@ -499,7 +567,12 @@ class TcpFabric:
                 # np.frombuffer views over it, and the message's
                 # ``donated`` contract lets the server adopt them as
                 # its accumulators without a defensive copy
-                box.put(Message.from_bytes(data))
+                try:
+                    box.put(Message.from_bytes(data))
+                except WireCorruption as e:
+                    # complete frame, bad checksum: framing is intact,
+                    # the stream survives — reject + NACK the sender
+                    self._on_corrupt_frame(node_s, e)
         except OSError:
             return  # connection torn down (peer reset or fabric shutdown)
         finally:
@@ -568,6 +641,17 @@ class TcpFabric:
         else:  # v1-pinned encoder (GEOMX_WIRE_FORMAT=v1)
             frames = [msg.to_bytes()]
             total = len(frames[0])
+        roll = self.fault.corruption_roll(msg)
+        if roll is not None:
+            # seeded in-flight damage: flatten the scatter-gather list
+            # and corrupt the serialized frame — what a flipped bit on
+            # the physical WAN does; the receiver's checksum (or lack of
+            # one) decides what happens next
+            mode, rng = roll
+            data = corrupt_bytes(b"".join(bytes(f) for f in frames),
+                                 rng, mode)
+            frames = [data]
+            total = len(data)
         if msg.channel >= 1 and total <= self.UDP_MAX:
             # lossy DGT channel: one best-effort datagram, no dial, no
             # retransmit; send failures are losses by design

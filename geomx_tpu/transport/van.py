@@ -36,11 +36,33 @@ import logging
 
 from geomx_tpu.core.config import Config, NodeId
 from geomx_tpu.trace import context as _tctx
-from geomx_tpu.transport.message import Control, Domain, Message
+from geomx_tpu.transport.message import (Control, Domain, Message,
+                                         WireCorruption)
 
 _WIRE_LOG = logging.getLogger("geomx.wire")
 _wire_bootstrap_lock = threading.Lock()
 _wire_bootstrapped = False
+
+_CORRUPT_MODES = ("bitflip", "truncate")
+
+
+def corrupt_bytes(raw: bytes, rng: random.Random,
+                  mode: str = "bitflip") -> bytes:
+    """Deterministically damage one serialized frame: flip a single
+    seeded bit, or truncate at a seeded offset.  The damage model is
+    intentionally minimal — one flipped bit is the HARDEST corruption
+    for an application to notice without a checksum, so it is what the
+    integrity plane's detection-coverage soak injects."""
+    if mode not in _CORRUPT_MODES:
+        raise ValueError(f"unknown corrupt mode '{mode}' "
+                         f"(one of {_CORRUPT_MODES})")
+    if len(raw) < 2:
+        return bytes(raw)
+    if mode == "truncate":
+        return bytes(raw[:rng.randrange(1, len(raw))])
+    buf = bytearray(raw)
+    buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+    return bytes(buf)
 
 
 class FaultPolicy:
@@ -92,6 +114,11 @@ class FaultPolicy:
         # directed link cuts: (sender, recipient) node strings, "*" wild
         self._cuts: set = set()
         self.cut_dropped = 0  # messages eaten by a partition
+        # in-flight corruption rules: (sender, recipient) -> [rate, mode,
+        # seeded rng], "*" wild on either side.  Each rule owns its own
+        # Random so a scripted corruption tape reproduces exactly
+        # regardless of what the shared drop/duplicate rng consumed.
+        self._corrupt_rules: Dict[tuple, list] = {}
 
     # ---- targeted partition injection ------------------------------------
     def partition(self, a: str, b: str = "*", symmetric: bool = True):
@@ -133,6 +160,55 @@ class FaultPolicy:
         cannot express without also cutting intra-party traffic."""
         for p in peers:
             self.partition(node, p, symmetric=symmetric)
+
+    # ---- targeted corruption injection -----------------------------------
+    def corrupt(self, a: str = "*", b: str = "*", rate: float = 1.0,
+                mode: str = "bitflip", seed: int = 0):
+        """Damage data frames on the link a→b in flight with probability
+        ``rate`` (``mode`` in {"bitflip", "truncate"}).  Control traffic
+        is spared — corruption chaos must not eat the very NACKs/ACKs
+        that recover from it (a cut already models total link failure).
+        Per-rule seeded rng: the same (seed, message sequence) produces
+        the same corruption tape."""
+        if mode not in _CORRUPT_MODES:
+            raise ValueError(f"unknown corrupt mode '{mode}' "
+                             f"(one of {_CORRUPT_MODES})")
+        a, b = str(a), str(b)
+        with self._lock:
+            self._corrupt_rules[(a, b)] = [float(rate), mode,
+                                           random.Random(seed)]
+
+    def heal_corrupt(self, a: Optional[str] = None,
+                     b: Optional[str] = None):
+        """Remove corruption rules — same shape as :meth:`heal`."""
+        with self._lock:
+            if a is None:
+                self._corrupt_rules.clear()
+                return
+            a = str(a)
+            if b is None:
+                self._corrupt_rules = {k: v
+                                       for k, v in self._corrupt_rules.items()
+                                       if a not in k}
+            else:
+                self._corrupt_rules.pop((a, str(b)), None)
+
+    def corruption_roll(self, msg: Message):
+        """Roll the seeded dice for ``msg``: ``(mode, rng)`` when this
+        frame should be damaged in flight, else None.  Data frames only
+        (``Control.EMPTY``) — see :meth:`corrupt`."""
+        if not self._corrupt_rules or msg.control is not Control.EMPTY:
+            return None
+        s, r = str(msg.sender), str(msg.recipient)
+        with self._lock:
+            for key in ((s, r), (s, "*"), ("*", r), ("*", "*")):
+                rule = self._corrupt_rules.get(key)
+                if rule is not None:
+                    rate, mode, rng = rule
+                    if rng.random() < rate:
+                        return mode, rng
+                    return None
+        return None
 
     def is_cut(self, msg: Message) -> bool:
         if not self._cuts:
@@ -256,6 +332,17 @@ class InProcFabric:
         self._link_free: Dict[tuple, float] = {}  # (sender, domain) -> t
         self.dropped = 0  # observability for loss-injection tests
         self.duplicated = 0  # messages re-delivered by duplicate_rate
+        # corruption-injection ledger (chaos soaks assert coverage):
+        # injected = frames damaged in flight; detected = checksum caught
+        # it (NACK sent when the frame was reliable); dropped = damage
+        # broke framing outright (resend timer recovers); delivered =
+        # the frame still decoded — with integrity off this is the
+        # silent-poison path the plane exists to close.
+        self.corrupt_injected = 0
+        self.corrupt_detected = 0
+        self.corrupt_dropped = 0
+        self.corrupt_delivered = 0
+        self._integrity_counters: Dict[str, object] = {}
         self._serial_q: "queue.Queue" = queue.Queue()
         self._serial_receivers: Dict[str, Callable[[Message], None]] = {}
         self._serial_thread: Optional[threading.Thread] = None
@@ -304,6 +391,9 @@ class InProcFabric:
         if self.fault.should_drop(msg):
             self.dropped += 1
             return False
+        roll = self.fault.corruption_roll(msg)
+        if roll is not None:
+            return self._deliver_corrupted(msg, *roll)
         if self.fault.should_duplicate(msg):
             # at-least-once injection: a shallow copy rides the same
             # path (in-proc payloads are by-reference anyway; the copy
@@ -316,6 +406,49 @@ class InProcFabric:
             self.duplicated += 1
             self._route(copy.copy(msg))
         return self._route(msg)
+
+    def _deliver_corrupted(self, msg: Message, mode: str,
+                           rng: random.Random) -> bool:
+        """Emulate in-flight damage for the by-reference fabric: the
+        frame is serialized, corrupted, and re-decoded — exactly what a
+        flipped WAN bit does to a real socket.  A checksum-stamped frame
+        surfaces as :class:`WireCorruption` (counted + NACKed so the
+        sender retransmits NOW); unstamped damage either breaks framing
+        (dropped; the resend timer recovers) or decodes anyway — the
+        silent-poison delivery the integrity plane exists to close."""
+        self.corrupt_injected += 1
+        try:
+            raw = corrupt_bytes(msg.to_bytes(), rng, mode)
+        except Exception:
+            return self._route(msg)  # unserializable: deliver clean
+        try:
+            decoded = Message.from_bytes(bytearray(raw))
+        except WireCorruption:
+            self.corrupt_detected += 1
+            self._count_integrity_reject(str(msg.recipient))
+            if msg.msg_sig >= 0 and msg.channel == 0:
+                # reliable frame: tell the sender instead of waiting out
+                # its resend backoff.  Lossy DGT channels are never
+                # resent, so there is nothing to NACK.
+                self._route(Message(
+                    sender=msg.recipient, recipient=msg.sender,
+                    control=Control.NACK, domain=msg.domain,
+                    msg_sig=msg.msg_sig, boot=msg.boot))
+            return False
+        except Exception:
+            self.corrupt_dropped += 1
+            return False
+        self.corrupt_delivered += 1
+        return self._route(decoded)
+
+    def _count_integrity_reject(self, node_s: str):
+        c = self._integrity_counters.get(node_s)
+        if c is None:
+            from geomx_tpu.utils.metrics import system_counter
+
+            c = self._integrity_counters.setdefault(
+                node_s, system_counter(f"{node_s}.integrity_wire_rejects"))
+        c.inc()
 
     def _route(self, msg: Message) -> bool:
         if self.serial:
@@ -502,6 +635,7 @@ class Van:
         self._seen_cap = 100_000
         self._sig_counter = itertools.count(1)
         self._resend_thread: Optional[threading.Thread] = None
+        self._nack_counter = None  # lazy integrity_wire_nacks
 
     # ---- lifecycle ----------------------------------------------------------
     def start(self, receiver: Callable[[Message], None]):
@@ -756,6 +890,35 @@ class Van:
             self._log_wire("RECV", msg, n)
         if msg.control is Control.ACK:
             self._pending_acks.pop(msg.msg_sig, None)
+            return
+        if msg.control is Control.NACK:
+            # receiver-side integrity verdict: our frame arrived damaged.
+            # Retransmit immediately instead of waiting out the resend
+            # backoff; the retry budget still applies, so a link that
+            # corrupts every copy eventually gives up like a timeout
+            # would (the reference resender has no NACK — corruption
+            # there IS a timeout).  Duplicate delivery of the resend is
+            # absorbed by the receiver's replay-dedup window.
+            entry = self._pending_acks.get(msg.msg_sig)
+            if entry is not None:
+                if self._nack_counter is None:
+                    from geomx_tpu.utils.metrics import system_counter
+
+                    self._nack_counter = system_counter(
+                        f"{self.node}.integrity_wire_nacks")
+                self._nack_counter.inc()
+                if fl is not None:
+                    from geomx_tpu.obs.flight import FlightEv
+
+                    fl.record(FlightEv.CORRUPT, peer=str(msg.sender),
+                              note="wire_nack_resend")
+                if entry[2] >= self._max_retries:
+                    self._pending_acks.pop(msg.msg_sig, None)
+                else:
+                    entry[1] = time.monotonic()
+                    entry[2] += 1
+                    self._account_send(entry[0])
+                    self._deliver_guarded(entry[0])
             return
         # ACK + dedup keyed on the *sender's* resender being active (it
         # stamped msg_sig) — never on this receiver's own config.

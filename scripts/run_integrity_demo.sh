@@ -1,0 +1,163 @@
+#!/usr/bin/env bash
+# Data-integrity demo (ISSUE 17): a real OS-process TCP cluster rides
+# out in-flight frame corruption AND a byzantine NaN worker, with the
+# integrity plane (wire checksums + gradient hygiene) catching both.
+#
+# Two faults land mid-training, both on party 0 (party 1 is the healthy
+# control — and the cluster terminator is party 0's rank-0 worker, so
+# the FAULTED party must be the slow one or the exit broadcast would
+# tear the cluster down under the laggard's feet):
+#
+#   * party 0's server carries a scripted GEOMX_NETFAULT_PLAN: ~25 s in,
+#     its WAN uplink to the global server starts corrupting 25 % of data
+#     frames in flight (seeded bit flips) for 10 s — the rot a flaky NIC
+#     inflicts;
+#   * worker:1@p0 turns byzantine at step 40: every gradient it pushes
+#     from then on is all-NaN (GEOMX_TEST_POISON_STEPS).
+#
+# Asserted, in order:
+#
+#   1. the corruption tape cuts in and the RECEIVER's wire checksum
+#      rejects the damaged frames (counted + NACK-resent — training
+#      never sees them);
+#   2. the local server's finiteness screen rejects the poisoned pushes
+#      and QUARANTINES the poisoner after GEOMX_POISON_QUARANTINE_N
+#      strikes — reversibly folded out, never evicted;
+#   3. the status console shows the quarantined worker (qworkers=1) and
+#      the health engine pages a data_corruption alert;
+#   4. training completes on every worker with finite losses — zero
+#      corrupted payloads reached a merge.
+#
+# Env: BASE_PORT (9700), STEPS (120)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+BASE_PORT="${BASE_PORT:-9700}"
+STEPS="${STEPS:-120}"
+LOG_DIR="$(mktemp -d)"
+export JAX_PLATFORMS=cpu
+export JAX_PLATFORM_NAME=cpu
+export GEOMX_BASE_PORT="$BASE_PORT"
+# the status console derives its address plan from env (the launchers
+# get the same topology via flags)
+export GEOMX_NUM_PARTIES=2
+export GEOMX_WORKERS_PER_PARTY=2
+# the integrity plane (all off by default — this demo turns it on)
+export GEOMX_INTEGRITY_WIRE=1
+export GEOMX_INTEGRITY_PUSH_SCREEN=1
+export GEOMX_POISON_QUARANTINE_N=3
+# health plane: data_corruption pages fast so the demo can grep it
+export GEOMX_OBS=1
+export GEOMX_OBS_INTERVAL=0.3
+export GEOMX_OBS_CORRUPTION_EVENTS=5
+export GEOMX_REQUEST_RETRY_S="${GEOMX_REQUEST_RETRY_S:-1.0}"
+# pace every worker ~250 ms/step so the corrupt window (25 s..35 s)
+# lands provably mid-training and steps remain after it heals
+export GEOMX_TEST_STEP_SLEEP_MS='{"worker:0@p0": 250, "worker:1@p0": 250,
+                                  "worker:0@p1": 250, "worker:1@p1": 250}'
+# worker:1@p0 pushes all-NaN gradients from step 40 on
+export GEOMX_TEST_POISON_STEPS='{"worker:1@p0": 40}'
+
+# the corruption tape, applied ONLY inside party 0's server process:
+# bit-flip 25 % of its outbound WAN data frames for 10 s
+NETFAULT_PLAN='[{"at_s": 25.0, "duration_s": 10.0, "kind": "corrupt",
+                 "src": "server:0@p0", "dst": "global_server:0",
+                 "rate": 0.25, "corrupt_mode": "bitflip"}]'
+
+COMMON=(--parties 2 --workers 2 --base-port "$BASE_PORT" \
+        --steps "$STEPS" --sync mixed)
+
+pids=()
+declare -A PID_OF
+launch() {  # launch <role> [extra env as K=V ...]
+  local role="$1"; shift
+  env "$@" python -m geomx_tpu.launch --role "$role" "${COMMON[@]}" \
+    >"$LOG_DIR/${role//[:@]/_}.log" 2>&1 &
+  pids+=($!)
+  PID_OF["$role"]=$!
+}
+
+launch "global_scheduler:0"
+launch "global_server:0"
+launch "scheduler:0@p0"
+launch "server:0@p0" GEOMX_NETFAULT_PLAN="$NETFAULT_PLAN"
+launch "worker:0@p0"
+launch "worker:1@p0"
+launch "scheduler:0@p1"
+launch "server:0@p1"
+launch "worker:0@p1"
+launch "worker:1@p1"
+cleanup() {
+  local status=$?
+  kill "${pids[@]}" 2>/dev/null || true
+  if [ "$status" -eq 0 ]; then
+    rm -rf "$LOG_DIR"
+  else
+    echo "demo failed — logs kept at $LOG_DIR"
+  fi
+}
+trap cleanup EXIT
+
+wait_for_log() {  # wait_for_log <file> <pattern> <tries>
+  for _ in $(seq 1 "$3"); do
+    grep -q "$2" "$LOG_DIR/$1" 2>/dev/null && return 0
+    sleep 0.5
+  done
+  echo "TIMEOUT waiting for '$2' in $1"; tail -5 "$LOG_DIR/$1" || true
+  return 1
+}
+
+wait_for_log "worker_0_p0.log" "configured — training begins" 300
+echo ">>> training running; waiting for the scripted corruption window"
+
+# ---- 1. the tape cuts in; the receiver's checksum rejects -------------
+wait_for_log "server_0_p0.log" \
+  "netfault cut corrupt server:0@p0->global_server:0" 120
+echo ">>> party 0's WAN uplink is corrupting frames"
+wait_for_log "global_server_0.log" "wire checksum rejected a corrupt frame" 60
+echo ">>> wire checksum caught the damage (NACK resend in flight)"
+
+# ---- 2. the byzantine worker strikes out and is quarantined -----------
+wait_for_log "server_0_p0.log" \
+  "quarantined worker:1@p0 after .* poisoned pushes" 180
+if grep -hq "evicted worker\|evicted: worker:1@p0" "$LOG_DIR"/*.log; then
+  echo "FAIL: the poisoner was evicted instead of quarantined"
+  exit 1
+fi
+echo ">>> poisoner quarantined (reversibly folded out, not evicted)"
+
+# ---- 3. the telemetry plane sees both -----------------------------------
+QSEEN=0
+for _ in $(seq 1 12); do
+  python -m geomx_tpu.status --timeout 5 >"$LOG_DIR/status.txt" \
+    2>"$LOG_DIR/status.err" || true
+  if grep -q "qworkers=1" "$LOG_DIR/status.txt"; then QSEEN=1; break; fi
+  sleep 0.5
+done
+[ "$QSEEN" = 1 ] \
+  || { echo "FAIL: status console never showed the quarantined worker"
+       cat "$LOG_DIR/status.txt" 2>/dev/null || true; exit 1; }
+echo ">>> status console shows p0 qworkers=1"
+wait_for_log "global_scheduler_0.log" "health ALERT data_corruption" 60
+echo ">>> health engine paged data_corruption"
+
+# ---- 4. heal + training completes with finite losses ------------------
+wait_for_log "server_0_p0.log" \
+  "netfault heal corrupt server:0@p0->global_server:0" 120
+fail=0
+for role in "worker:0@p0" "worker:1@p0" "worker:0@p1" "worker:1@p1"; do
+  wait "${PID_OF[$role]}" || fail=1
+  f="$LOG_DIR/${role//[:@]/_}.log"
+  grep -q "steps=" "$f" || { echo "FAIL: $role never finished"; fail=1; }
+done
+if grep -hq "last_loss=nan" "$LOG_DIR"/worker_*.log; then
+  echo "FAIL: a NaN reached the model — corrupted payload merged"
+  fail=1
+fi
+
+echo "=== summary ==="
+grep -h "netfault\|wire checksum\|quarantined\|health ALERT" \
+  "$LOG_DIR"/*.log | sort -u || true
+grep -h "steps=" "$LOG_DIR"/worker_*.log || true
+echo "integrity demo exit=$fail"
+exit $fail

@@ -441,6 +441,6 @@ def test_live_tree_baseline_is_committed_and_justified():
 def test_checker_registry_catalog():
     assert set(CHECKERS) == {"lock-discipline", "reactor-blocking",
                              "wire-protocol", "config-drift",
-                             "metrics-doc"}
+                             "metrics-doc", "decode-bounds"}
     for name, cls in CHECKERS.items():
         assert cls.name == name and cls.description
