@@ -506,6 +506,22 @@ class Config:
     #                                No effect under the numpy backend;
     #                                GEOMX_MERGE_OPT_DEVICE=0 keeps the
     #                                jax backend's optimizer on the host
+    codec_device: bool = True  # device-resident WAN codec stage for the
+    #                            jax merge backend: encode reads the
+    #                            device merge accumulator directly
+    #                            (jitted top-k / quantize kernels) and
+    #                            materializes only the wire-ready
+    #                            compressed payload; decode runs jitted
+    #                            dequantize/scatter and lands the grads
+    #                            straight in device merge buffers via
+    #                            seed().  Wire format is bit-identical
+    #                            to the numpy codecs (cross-decode
+    #                            parity is tested).  No effect under the
+    #                            numpy backend; deterministic mode
+    #                            forces numpy codecs.
+    #                            GEOMX_CODEC_DEVICE=0 keeps the codec
+    #                            pass on the host (see
+    #                            kvstore.backend.resolve_codec_device)
     heartbeat_interval_s: float = 0.0   # 0 = off
     heartbeat_timeout_s: float = 10.0
     # --- crash-tolerant membership (heartbeat-driven ACTUATION; requires
@@ -1055,6 +1071,7 @@ class Config:
             merge_quantized=_env_bool("GEOMX_MERGE_QUANTIZED"),
             merge_residual=_env_bool("GEOMX_MERGE_RESIDUAL", True),
             merge_opt_device=_env_bool("GEOMX_MERGE_OPT_DEVICE", True),
+            codec_device=_env_bool("GEOMX_CODEC_DEVICE", True),
             heartbeat_interval_s=_env_float(
                 "GEOMX_HEARTBEAT_INTERVAL", _env_float("PS_HEARTBEAT_INTERVAL", 0.0)
             ),

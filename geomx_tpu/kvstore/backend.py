@@ -126,6 +126,22 @@ class MergeBackend:
         survives failover and reassignment)."""
         return None
 
+    def make_codec_stage(self, config):
+        """Codec stage of the WAN path: return a device-resident codec
+        engine for ``config`` (push-compression + decode kernels), or
+        None when this backend keeps the codecs on the host (the numpy
+        path always does; the jax path returns one when
+        ``codec_device`` resolves on — see
+        :func:`resolve_codec_device`).  The servers treat a non-None
+        return as "encode may read the device accumulator directly and
+        decode may land device arrays": the encode side materializes
+        only the wire-ready compressed payload, the decode side feeds
+        ``seed``/``accumulate`` a device array the backend recognizes
+        without re-staging.  Wire frames are bit-identical to the
+        :mod:`geomx_tpu.compression.codecs` reference in both
+        directions (cross-decode parity is part of the contract)."""
+        return None
+
     def stop(self) -> None:  # release device handles, if any
         pass
 
@@ -241,6 +257,25 @@ def resolve_opt_device(config) -> bool:
     if not bool(getattr(config, "merge_opt_device", True)):
         return False
     env = os.environ.get("GEOMX_MERGE_OPT_DEVICE", "").strip().lower()
+    if env:
+        return env not in ("0", "false", "no", "off")
+    return True
+
+
+def resolve_codec_device(config) -> bool:
+    """Whether the jax backend should run the device-resident WAN codec
+    stage: ``Config.codec_device`` (default on), with
+    ``GEOMX_CODEC_DEVICE`` honored as the env override for
+    directly-constructed Configs (same fallback idiom as
+    GEOMX_MERGE_OPT_DEVICE).  Deterministic mode forces the host
+    codecs — they are the bit-compat reference and their dispatch is
+    replayable.  Irrelevant under the numpy backend, which has no
+    device to encode on."""
+    if getattr(config, "deterministic", False):
+        return False
+    if not bool(getattr(config, "codec_device", True)):
+        return False
+    env = os.environ.get("GEOMX_CODEC_DEVICE", "").strip().lower()
     if env:
         return env not in ("0", "false", "no", "off")
     return True

@@ -960,6 +960,11 @@ class WorkerKVStore:
         return int(reply.get("steps", 1))
 
     def stop(self):
+        if self.ts_client is not None:
+            # stops the dissemination drain (a dedicated thread under
+            # the threaded transport, a shared-reactor Periodic under
+            # lightweight mode — which would otherwise tick forever)
+            self.ts_client.stop()
         self.worker.stop()
 
 

@@ -157,13 +157,29 @@ class JaxBackend(MergeBackend):
         self.d2h_bytes = 0
         self.merge_device_ms = 0.0
         self.opt_device_ms = 0.0
+        # codec-stage counters (ISSUE 20): wall spent in jitted codec
+        # kernels, wire-ready compressed bytes materialized (the ONLY
+        # D2H the device codec path pays), and full-tensor bytes that
+        # crossed the host boundary for codec work (the quantity the
+        # device path exists to eliminate — bench.py's host_copy_bytes;
+        # exactly 0 in steady state with device codecs on)
+        self.codec_device_ms = 0.0
+        self.codec_d2h_bytes = 0
+        self.codec_host_bytes = 0
 
     # ---- staging ------------------------------------------------------------
     def _stage(self, v: np.ndarray, device):
         """One H2D copy of the (possibly zero-copy wire view) payload,
         f32-promoted.  ``ascontiguousarray`` is the identity for the
         aligned f32 views wire format v2 decodes, so the device_put
-        reads straight out of the receive buffer."""
+        reads straight out of the receive buffer.  A payload that is
+        ALREADY a device array (the codec stage's decode output) stages
+        for free: no host round-trip, no ``h2d_bytes`` — placement is
+        pinned with an intra-device (or D2D, under a mesh) transfer."""
+        if isinstance(v, self._jax.Array):
+            if v.dtype != self._jnp.float32:
+                v = v.astype(self._jnp.float32)
+            return self._jax.device_put(v, device)
         arr = np.ascontiguousarray(v, dtype=np.float32)
         staged = self._jax.device_put(arr, device)
         with self._mu:
@@ -352,9 +368,28 @@ class JaxBackend(MergeBackend):
 
     def screen_finite(self, v: np.ndarray, mag_max: float = 0.0) -> bool:
         """Device screen: the jitted fused reduction ships one scalar
-        back (single sync) instead of round-tripping the tensor."""
+        back (single sync) instead of round-tripping the tensor.  A
+        device-resident payload (codec-stage decode output) is screened
+        in place — ``ascontiguousarray`` on it would silently D2H the
+        whole tensor."""
+        if isinstance(v, self._jax.Array):
+            if v.dtype != self._jnp.float32:
+                v = v.astype(self._jnp.float32)
+            return bool(self._screen(v, np.float32(mag_max)))
         arr = np.ascontiguousarray(v, dtype=np.float32)
         return bool(self._screen(arr, np.float32(mag_max)))
+
+    # ---- codec stage --------------------------------------------------------
+    def make_codec_stage(self, config):
+        """A :class:`CodecStage` when ``codec_device`` resolves on (see
+        :func:`geomx_tpu.kvstore.backend.resolve_codec_device`), else
+        None — the servers keep the host numpy codecs, the bit-compat
+        reference."""
+        from geomx_tpu.kvstore.backend import resolve_codec_device
+
+        if not resolve_codec_device(config):
+            return None
+        return CodecStage(self)
 
     # ---- optimizer stage ----------------------------------------------------
     def make_device_optimizer(self, spec: dict):
@@ -394,6 +429,9 @@ class JaxBackend(MergeBackend):
                     "merge_opt_device": self._opt_device,
                     "merge_device_ms": round(self.merge_device_ms, 3),
                     "opt_device_ms": round(self.opt_device_ms, 3),
+                    "codec_device_ms": round(self.codec_device_ms, 3),
+                    "codec_d2h_bytes": self.codec_d2h_bytes,
+                    "codec_host_bytes": self.codec_host_bytes,
                     "h2d_bytes": self.h2d_bytes,
                     "d2h_bytes": self.d2h_bytes}
 
@@ -500,8 +538,9 @@ class DeviceOptimizer:
     def _grad_ref(self, accum):
         if isinstance(accum, _DeviceAccum):
             return self._be._reduced(accum)
-        return self._be._stage(np.ascontiguousarray(accum, np.float32),
-                               self._be._devices[0])
+        # _stage handles host arrays (one billed H2D) and already-device
+        # arrays (codec-stage decode output; no host round-trip) alike
+        return self._be._stage(accum, self._be._devices[0])
 
     def _update(self, k: int, w, g, scale: float):
         raise NotImplementedError
@@ -668,3 +707,347 @@ class DeviceAdam(DeviceOptimizer):
 
 
 _DEVICE_OPTS = {"sgd": DeviceSgd, "nag": DeviceNag, "adam": DeviceAdam}
+
+
+class CodecStage:
+    """Device-resident WAN codec engine (ISSUE 20).
+
+    One per server under the jax backend when ``codec_device`` resolves
+    on.  The LOCAL tier uses :meth:`make_push_codec` to build the
+    :class:`DeviceCodec` push family — encode reads the device merge
+    accumulator directly (``round_value``) and materializes ONLY the
+    wire-ready compressed payload (billed to ``codec_d2h_bytes``); the
+    GLOBAL tier uses :meth:`decode` — structural validation runs
+    host-side on the (already-host) compressed payload with the exact
+    :mod:`geomx_tpu.compression.codecs` gates (truncation / bit-flips
+    land the same typed :class:`CodecError`, never an OOB scatter), then
+    jitted dequantize/scatter kernels land the gradient as a device
+    array that :meth:`JaxBackend.seed` recognizes and never re-stages.
+
+    Wire frames are bit-identical to the numpy reference in both
+    directions: fp16/2bit device ENCODERS emit byte-identical frames
+    for identical state; the BSC device encoder picks its support via
+    exact ``jax.lax.top_k`` (k = ratio·n) instead of the reference's
+    sampled-threshold scan — a legal selection under the same
+    ``[f32 values ‖ int32 indices bit-cast to f32]`` layout — and every
+    DECODER (device or numpy) reconstructs any legal frame bitwise
+    identically (tests/test_device_codec.py pins the full cross-decode
+    matrix).  The stage is stateless on the decode side, so the
+    epoch-fence ``DecoderBank.clear()`` semantics need no device
+    analog."""
+
+    device = True
+
+    def __init__(self, be: "JaxBackend"):
+        self._be = be
+        jax, jnp = be._jax, be._jnp
+        self._jax, self._jnp = jax, jnp
+        # decode kernels (receiver side; shape/length-cached by jit)
+        self._dec_f16 = jax.jit(lambda p: p.astype(jnp.float32))
+
+        def _scatter(vals, idx, n):
+            return jnp.zeros(n, jnp.float32).at[idx].set(vals)
+
+        self._dec_bsc = jax.jit(_scatter, static_argnums=(2,))
+
+        def _unpack2bit(b, t, n):
+            q = jnp.stack([b & 3, (b >> 2) & 3, (b >> 4) & 3,
+                           (b >> 6) & 3], axis=1).reshape(-1)[:n]
+            z = jnp.zeros((), jnp.float32)
+            return jnp.where(q == 1, t, jnp.where(q == 2, -t, z))
+
+        self._dec_2bit = jax.jit(_unpack2bit, static_argnums=(2,))
+
+    # ---- residency helpers (server-side seam) -------------------------------
+    def is_device(self, v) -> bool:
+        return isinstance(v, self._jax.Array)
+
+    def round_value(self, accum):
+        """The completed round as a single device array, WITHOUT the
+        host materialization ``MergeBackend.materialize`` would pay —
+        the zero-D2H handoff from the merge lanes to the encoder."""
+        if isinstance(accum, _DeviceAccum):
+            return self._be._reduced(accum)
+        return accum  # host-seeded (row-sparse) rounds pass through
+
+    def concat(self, vs):
+        """Multi-key round packing on device (``np.concatenate`` over
+        device arrays would silently round-trip every value host-side)."""
+        return self._jnp.concatenate(
+            [self._jnp.asarray(v, self._jnp.float32) for v in vs])
+
+    def to_host(self, v) -> np.ndarray:
+        """Full-tensor D2H for the fallback event paths (degraded-round
+        absorb, adaptive raw stash) — billed to ``codec_host_bytes`` so
+        the steady-state "host copies == 0" contract stays auditable."""
+        host = np.asarray(v)
+        with self._be._mu:
+            self._be.codec_host_bytes += host.nbytes
+        return host
+
+    def _ensure_device(self, arr):
+        """Encoder input residency: device arrays pass through; a host
+        array (row-sparse or re-encode fallback) pays one H2D, billed as
+        a codec host copy."""
+        if isinstance(arr, self._jax.Array):
+            if arr.dtype != self._jnp.float32:
+                arr = arr.astype(self._jnp.float32)
+            return arr
+        host = np.ascontiguousarray(arr, dtype=np.float32)
+        with self._be._mu:
+            self._be.codec_host_bytes += host.nbytes
+        return self._jax.device_put(host, self._be._devices[0])
+
+    def _wire(self, payload) -> np.ndarray:
+        """Materialize one encoded frame as the wire-ready host buffer —
+        THE single D2H of the device encode path (compressed bytes only,
+        billed to ``codec_d2h_bytes``).  The returned view keeps the
+        device buffer alive; senders ship it donated and never mutate."""
+        host = np.asarray(payload)
+        with self._be._mu:
+            self._be.codec_d2h_bytes += host.nbytes
+        return host
+
+    def _bill(self, t0: float) -> None:
+        dt = (time.perf_counter() - t0) * 1e3
+        with self._be._mu:
+            self._be.codec_device_ms += dt
+
+    # ---- push-codec factory (sender side) -----------------------------------
+    def make_push_codec(self, config: dict):
+        """Device analog of :func:`geomx_tpu.compression.make_push_codec`
+        — same config schema, same ValueError on unknown types, device
+        implementations for the full family."""
+        typ = config.get("type", "none")
+        if typ == "none":
+            return None
+        if typ == "fp16":
+            return DeviceFp16Codec(self)
+        if typ == "2bit":
+            return DeviceTwoBitCodec(
+                self, threshold=config.get("threshold", 0.5))
+        if typ == "bsc":
+            return DeviceBscCodec(self, ratio=config.get("ratio", 0.01),
+                                  momentum=config.get("momentum", 0.9))
+        if typ == "mpq":
+            return DeviceMpqSelector(
+                self, size_bound=config.get("size_bound", 200_000),
+                ratio=config.get("ratio", 0.01),
+                momentum=config.get("momentum", 0.9))
+        raise ValueError(f"unknown compression type '{typ}'")
+
+    # ---- decode (receiver side) ---------------------------------------------
+    def decode(self, compr: str, key: int, payload: np.ndarray,
+               orig_len: int, threshold: float = 0.5):
+        """Tag-dispatched decode to a DEVICE f32 array — drop-in for
+        :func:`geomx_tpu.compression.decompress_payload` with identical
+        structural gates (host-side, on the small compressed buffer,
+        BEFORE any device work or scatter)."""
+        from geomx_tpu.compression.codecs import (CodecError,
+                                                  _check_index_bounds,
+                                                  unpack_sparse)
+
+        t0 = time.perf_counter()
+        dev0 = self._be._devices[0]
+        if compr == "fp16":
+            if len(payload) != orig_len:
+                raise CodecError(
+                    f"fp16 payload carries {len(payload)} values for a "
+                    f"{orig_len}-element tensor", tag="fp16", key=key)
+            p = self._jax.device_put(
+                np.ascontiguousarray(payload, np.float16), dev0)
+            out = self._dec_f16(p)
+        elif compr == "bsc":
+            vals, idx = unpack_sparse(payload, key=key)
+            _check_index_bounds(idx, orig_len, "bsc", key)
+            out = self._dec_bsc(
+                self._jax.device_put(vals, dev0),
+                self._jax.device_put(idx.astype(np.int32), dev0),
+                int(orig_len))
+        elif compr == "2bit":
+            b = np.ascontiguousarray(payload, dtype=np.uint8)
+            if len(b) < (orig_len + 3) // 4:
+                raise CodecError(
+                    f"2bit payload holds {len(b) * 4} codes for a "
+                    f"{orig_len}-element tensor", tag="2bit", key=key)
+            out = self._dec_2bit(self._jax.device_put(b, dev0),
+                                 np.float32(threshold), int(orig_len))
+        else:
+            raise CodecError(f"unknown compr tag '{compr}'", tag=compr,
+                             key=key)
+        self._bill(t0)
+        return out
+
+
+class DeviceCodec:
+    """Push-direction device codec base: same duck-typed surface as
+    :class:`geomx_tpu.compression.codecs.Codec` (``name`` /
+    ``compress`` / ``decompress`` / ``dense_delta``), plus ``device``
+    so the round-close can tell the server it may skip the accumulator
+    materialization.  ``compress`` accepts a device array (the hot
+    path) or a host ndarray (fallback re-encodes) and always returns
+    the wire-ready HOST payload; jitted kernels never donate the
+    gradient input — it may alias an in-flight view (pull responses,
+    white-box test snapshots), only stage-private state is donated."""
+
+    device = True
+    name = "abstract"
+
+    def __init__(self, stage: CodecStage):
+        self._stage = stage
+        self._jax = stage._jax
+        self._jnp = stage._jnp
+
+    @property
+    def dense_delta(self) -> bool:
+        return False
+
+
+class DeviceFp16Codec(DeviceCodec):
+    name = "fp16"
+
+    def __init__(self, stage):
+        super().__init__(stage)
+        self._enc = self._jax.jit(
+            lambda x: x.astype(self._jnp.float16))
+
+    def compress(self, key, arr):
+        t0 = time.perf_counter()
+        out = self._enc(self._stage._ensure_device(arr))
+        self._stage._bill(t0)
+        return self._stage._wire(out)
+
+    def decompress(self, key, payload, orig_len):
+        return self._stage.decode("fp16", key, payload, orig_len)
+
+
+class DeviceTwoBitCodec(DeviceCodec):
+    """{−t, 0, +t} with device-resident per-key residual; byte-packed
+    4 codes/byte exactly like the numpy/native encoders — for identical
+    residual state the emitted frame is BYTE-identical (the quantize
+    decisions are exact f32 comparisons on IEEE-identical sums)."""
+
+    name = "2bit"
+
+    def __init__(self, stage, threshold: float = 0.5):
+        super().__init__(stage)
+        self.threshold = float(threshold)
+        self._residual: Dict[int, object] = {}
+        jnp = self._jnp
+
+        def enc(r, g, t):
+            r = r + g
+            pos = r > t
+            neg = r < -t
+            q = jnp.where(pos, np.uint8(1),
+                          jnp.where(neg, np.uint8(2), np.uint8(0)))
+            # untouched elements keep their exact residual bits (a
+            # blanket r - t*pos would flip -0.0 to +0.0)
+            r = jnp.where(pos, r - t, jnp.where(neg, r + t, r))
+            pad = (-q.shape[0]) % 4
+            qp = jnp.pad(q, (0, pad)).reshape(-1, 4)
+            packed = (qp[:, 0] | (qp[:, 1] << 2) | (qp[:, 2] << 4)
+                      | (qp[:, 3] << 6))
+            return packed.astype(jnp.uint8), r
+
+        self._enc = self._jax.jit(enc, donate_argnums=(0,))
+
+    def compress(self, key, arr):
+        t0 = time.perf_counter()
+        g = self._stage._ensure_device(arr)
+        n = int(g.shape[0])
+        r = self._residual.get(key)
+        if r is None or int(r.shape[0]) != n:
+            r = self._jnp.zeros(n, self._jnp.float32)
+        packed, r = self._enc(r, g, np.float32(self.threshold))
+        self._residual[key] = r
+        self._stage._bill(t0)
+        return self._stage._wire(packed)
+
+    def decompress(self, key, payload, orig_len):
+        return self._stage.decode("2bit", key, payload, orig_len,
+                                  self.threshold)
+
+
+class DeviceBscCodec(DeviceCodec):
+    """DGC-style Bi-Sparse push compressor on device: momentum velocity
+    + accumulated mass exactly like :class:`BscCodec`, but the support
+    is picked by exact ``jax.lax.top_k`` over |accum| (k = ratio·n,
+    floor 1) instead of the sampled-threshold scan — no host RNG, no
+    full-array host pass, deterministic payload size.  The frame is the
+    same ``[f32 values ‖ int32 indices bit-cast to f32]`` layout, so
+    either family's decoder reconstructs it bitwise."""
+
+    name = "bsc"
+
+    def __init__(self, stage, ratio: float = 0.01,
+                 momentum: float = 0.9):
+        super().__init__(stage)
+        self.ratio = float(ratio)
+        self.momentum = float(momentum)
+        self._velocity: Dict[int, object] = {}
+        self._accum: Dict[int, object] = {}
+        jax, jnp = self._jax, self._jnp
+
+        def enc(v, u, g, m, k):
+            v = m * v + g
+            u = u + v
+            mag = jnp.abs(u)
+            _, idx = jax.lax.top_k(mag, k)
+            vals = u[idx]
+            v = v.at[idx].set(np.float32(0.0))
+            u = u.at[idx].set(np.float32(0.0))
+            wire = jnp.concatenate([
+                vals.astype(jnp.float32),
+                jax.lax.bitcast_convert_type(idx.astype(jnp.int32),
+                                             jnp.float32)])
+            return wire, v, u
+
+        self._enc = jax.jit(enc, static_argnums=(4,),
+                            donate_argnums=(0, 1))
+
+    def compress(self, key, arr):
+        t0 = time.perf_counter()
+        g = self._stage._ensure_device(arr)
+        n = int(g.shape[0])
+        v = self._velocity.get(key)
+        u = self._accum.get(key)
+        if v is None or int(v.shape[0]) != n:
+            v = self._jnp.zeros(n, self._jnp.float32)
+            u = self._jnp.zeros(n, self._jnp.float32)
+        k = max(1, int(self.ratio * n))
+        wire, v, u = self._enc(v, u, g, np.float32(self.momentum), k)
+        self._velocity[key] = v
+        self._accum[key] = u
+        self._stage._bill(t0)
+        return self._stage._wire(wire)
+
+    def decompress(self, key, payload, orig_len):
+        return self._stage.decode("bsc", key, payload, orig_len)
+
+    @property
+    def dense_delta(self) -> bool:
+        return True
+
+
+def _mpq_base():
+    from geomx_tpu.compression.codecs import MpqSelector
+
+    return MpqSelector
+
+
+class DeviceMpqSelector(_mpq_base()):
+    """Mixed-precision selector over the DEVICE family: same
+    ``size_bound`` split and pick counters as the numpy
+    :class:`MpqSelector` (it subclasses it, so the server's
+    ``isinstance`` dispatch and QUERY_STATS counters keep working),
+    with the two rungs swapped for their device implementations."""
+
+    device = True
+
+    def __init__(self, stage, size_bound: int = 200_000,
+                 ratio: float = 0.01, momentum: float = 0.9):
+        super().__init__(size_bound=size_bound, ratio=ratio,
+                         momentum=momentum)
+        self.fp16 = DeviceFp16Codec(stage)
+        self.bsc = DeviceBscCodec(stage, ratio=ratio, momentum=momentum)

@@ -374,6 +374,11 @@ class LocalServer:
         # per-backend — a device backend caps how many can usefully run.
         self._backend = make_merge_backend(self.config,
                                            str(postoffice.node))
+        # device-resident WAN codec stage (ISSUE 20): non-None iff the
+        # jax backend is active and codec_device resolves on — encode
+        # then reads the device merge accumulator directly and the only
+        # D2H is the wire-ready compressed payload
+        self._codec_stage = self._backend.make_codec_stage(self.config)
         self._mu, self._shards = make_merge_lanes(
             self.config, postoffice.node, self._backend)
         self._ctr_mu = threading.Lock()  # leaf lock for shared counters
@@ -430,6 +435,10 @@ class LocalServer:
         # pull-down so compressed (BSC) responses can detect a desynced
         # tracked view and resync dense (BroadcastCompressor.compress)
         self._pull_ver: Dict[int, int] = {}
+        # per-key weight version of the last APPLIED pull-down ("wv"
+        # stamp from GlobalServer._weight_wv); a strictly-older late
+        # response is dropped instead of rolling the replica back
+        self._weight_ver: Dict[int, int] = {}
         # feature observability (acceptance runs + QUERY_STATS)
         self.hfa_gated_key_rounds = 0  # K2-gated (key, round) pairs
         self.ts_deliveries = 0      # inter-party overlay deliveries adopted
@@ -575,6 +584,7 @@ class LocalServer:
                         # the INIT base; echo 0 re-enters the
                         # sparse-from-INIT path consistently
                         self._pull_ver[k] = 0
+                        self._weight_ver.pop(k, None)
                     fresh.append((k, v))
             # pulls that raced ahead of init can be servable now
             for k, _ in fresh:
@@ -1029,6 +1039,9 @@ class LocalServer:
                 # longer matches this replica; -1 never equals a tracked
                 # version, so the next compressed pull resyncs dense
                 self._pull_ver[k] = -1
+                # the global tier may have restarted too — accept any
+                # weight-version stamp after a warm boot
+                self._weight_ver.pop(k, None)
                 self._drain_parked_locked(st)
             self.warm_boots += 1
         from geomx_tpu.utils.metrics import system_counter
@@ -1109,6 +1122,29 @@ class LocalServer:
               f"silent for {self._degrade_window:.1f}s, party rounds "
               "continue against frozen weights and accumulate a "
               "catch-up delta", flush=True)
+
+    def _host_kvs(self, kvs: KVPairs) -> KVPairs:
+        """Materialize a device-resident round for the host fallback
+        paths (degraded absorb, anything that does numpy arithmetic on
+        the values) — billed by the codec stage as a codec host copy
+        so the steady-state zero-host-traffic contract stays auditable.
+        The identity for host rounds."""
+        if (self._codec_stage is None
+                or not self._codec_stage.is_device(kvs.vals)):
+            return kvs
+        return KVPairs(kvs.keys, self._codec_stage.to_host(kvs.vals),
+                       kvs.lens)
+
+    def _make_push_codec(self, body: dict):
+        """Build the push codec for a SET_COMPRESSION / WAN-policy body:
+        the device family when the codec stage is active (encode reads
+        the device accumulator, ships wire-identical frames), else the
+        numpy reference.  Both raise ValueError on malformed bodies."""
+        from geomx_tpu.compression import make_push_codec
+
+        if self._codec_stage is not None:
+            return self._codec_stage.make_push_codec(body)
+        return make_push_codec(body)
 
     def _absorb_degraded_round(self, kvs: KVPairs, keys: List[int]):
         """A party round completed while the WAN uplink is dark: fold
@@ -1696,8 +1732,25 @@ class LocalServer:
         if (self.hfa_enabled and st.hfa_inv > 0.0
                 and abs(st.hfa_inv - 1.0) > 1e-9):
             st.accum = self._backend.scale(st.accum, 1.0 / st.hfa_inv)
-        bundle = {"k": k, "v": self._backend.materialize(st.accum),
-                  "gated": gated, "rs": st.row_sparse}
+        # device-resident handoff (ISSUE 20): when a device push codec
+        # will consume this round, skip the host materialization — the
+        # encoder reads the device accumulator and the only D2H is the
+        # compressed wire payload.  Every path that still needs host
+        # bytes is excluded here: HFA (local applies + weight pushes),
+        # row-sparse rounds (host-seeded scatter), the inter-TS merge
+        # relay, adaptive WAN (raw host stash for fence retries), and a
+        # dark uplink (degraded absorb; re-checked race-safely in
+        # _push_up_send via _host_kvs).
+        keep_device = (self._codec_stage is not None
+                       and getattr(self.push_codec, "device", False)
+                       and not gated and not st.row_sparse
+                       and not self.hfa_enabled
+                       and self.ts_push_inter is None
+                       and not self._adaptive and not self._degraded
+                       and not isinstance(st.accum, np.ndarray))
+        v = (self._codec_stage.round_value(st.accum) if keep_device
+             else self._backend.materialize(st.accum))
+        bundle = {"k": k, "v": v, "gated": gated, "rs": st.row_sparse}
         st.hfa_inv = 0.0
         st.accum = None
         st.count = 0
@@ -1723,8 +1776,17 @@ class LocalServer:
             # single-key rounds (the big-tensor regime) hand the
             # accumulator over as-is — concatenate([one]) is a full
             # copy (~0.27 s at 200 MB on this host)
+            if len(vs) == 1:
+                vals = vs[0]
+            elif (self._codec_stage is not None
+                  and any(self._codec_stage.is_device(v) for v in vs)):
+                # device rounds stay device: np.concatenate would
+                # silently round-trip every value through the host
+                vals = self._codec_stage.concat(vs)
+            else:
+                vals = np.concatenate(vs)
             return KVPairs(np.array([b["k"] for b in bs], dtype=np.int64),
-                           vs[0] if len(vs) == 1 else np.concatenate(vs),
+                           vals,
                            np.array([len(v) for v in vs], dtype=np.int64))
 
         local = [b for b in bundles if b["gated"]]
@@ -1817,8 +1879,11 @@ class LocalServer:
         if self._degraded:
             # the WAN uplink is dark (partition mode): the round stays
             # in the party — accumulate the merged gradient into the
-            # catch-up delta and finish against the frozen weights
-            self._absorb_degraded_round(kvs, keys)
+            # catch-up delta and finish against the frozen weights.
+            # A device-resident round materializes here (the absorb is
+            # host arithmetic by design; _degraded may have flipped
+            # after the round-close decision kept it on device).
+            self._absorb_degraded_round(self._host_kvs(kvs), keys)
             return
         if self._prof.running:
             self._prof.count("wan_rounds", 1.0)
@@ -2062,11 +2127,9 @@ class LocalServer:
         epoch = int(p["epoch"])
         if epoch <= self._policy_epoch:
             return  # stale (an older broadcast raced a fence adoption)
-        from geomx_tpu.compression import make_push_codec
-
         comp = dict(p["compression"])
         try:
-            codec = make_push_codec(comp)
+            codec = self._make_push_codec(comp)
         except ValueError:
             import logging
 
@@ -2256,6 +2319,7 @@ class LocalServer:
         pulls already drained); the rest finish normally."""
         tags = kvs.tags or {}
         pv = kvs.pv or {}
+        wv = kvs.wv or {}
         with self._tr.span("local.pull_down"):
             live = []
             for k, v in kvs.slices():
@@ -2265,6 +2329,20 @@ class LocalServer:
                             and self._keys[k].epoch != epochs.get(k)):
                         continue  # aborted by a restore
                     tag = tags.get(k, "")
+                    if k in wv and wv[k] < self._weight_ver.get(k, -1):
+                        # overlapping rounds flush their responses with
+                        # no stripes held, so round N's response can
+                        # arrive AFTER round N+1's (its encode races
+                        # the next close — widest when the weight
+                        # materializes off-device first).  Applying it
+                        # would roll the replica back a round and serve
+                        # stale weights to every worker until the next
+                        # push; dropping it still finishes the round.
+                        # Strictly-older only: an equal stamp is the
+                        # same weights (re-applying is idempotent)
+                        self.stale_pull_skips += 1
+                        live.append(k)
+                        continue
                     if k in pv:
                         # overlapping rounds can deliver responses out of
                         # order (van delay/priority queues): a bsc delta is
@@ -2285,6 +2363,8 @@ class LocalServer:
                     self.store[k] = self._decode_pull_value(k, v, tag)
                     if k in pv:
                         self._pull_ver[k] = pv[k]
+                    if k in wv:
+                        self._weight_ver[k] = wv[k]
                 live.append(k)
             self._finish_round(live)
 
@@ -2446,8 +2526,7 @@ class LocalServer:
         if msg.cmd == Ctrl.SET_SYNC_MODE:
             self.sync_mode = bool(body["sync"])
         elif msg.cmd == Ctrl.SET_COMPRESSION:
-            from geomx_tpu.compression import (compression_allowed,
-                                               make_push_codec)
+            from geomx_tpu.compression import compression_allowed
 
             if body == self.compression:
                 # idempotent: a mid-training recreation would drop the
@@ -2464,7 +2543,7 @@ class LocalServer:
                 self.server.reply_cmd(msg, body={"error": why})
                 return
             try:
-                self.push_codec = make_push_codec(body)
+                self.push_codec = self._make_push_codec(body)
                 self.compression = body
             except ValueError as e:
                 self.server.reply_cmd(msg, body={"error": str(e)})
@@ -2589,6 +2668,12 @@ class LocalServer:
                 out.get("d2h_bytes") or 0)
             system_gauge(f"{self.po.node}.opt_device_ms").set(
                 out.get("opt_device_ms") or 0)
+            # codec stage (ISSUE 20): encode kernel time + wire-ready
+            # compressed D2H — host_copy auditing rides the same stats
+            system_gauge(f"{self.po.node}.codec_device_ms").set(
+                out.get("codec_device_ms") or 0)
+            system_gauge(f"{self.po.node}.codec_d2h_bytes").set(
+                out.get("codec_d2h_bytes") or 0)
         return out
 
     def leave_global(self, timeout: float = 30.0) -> dict:
@@ -2658,7 +2743,8 @@ class LocalServer:
 
 
 class _GlobalKeyState:
-    __slots__ = ("accum", "count", "parked_pushes", "parked_pulls")
+    __slots__ = ("accum", "count", "parked_pushes", "parked_pulls", "ver",
+                 "contributors", "deferred")
 
     def __init__(self):
         self.accum: Optional[np.ndarray] = None
@@ -2667,6 +2753,21 @@ class _GlobalKeyState:
         # when its remaining-set empties
         self.parked_pushes: List[list] = []
         self.parked_pulls: List[Message] = []
+        # BSP same-sender fence: senders already merged into the OPEN
+        # round; a second plain push from one of them belongs to the
+        # NEXT round and waits in ``deferred`` (entries
+        # ``(sender, value, parked-push entry, donated)``) until this
+        # round closes — see the fence comment in _push_sync.merge_one
+        self.contributors: set = set()
+        self.deferred: List[tuple] = []
+        # weight version: bumped with every store update that produces
+        # NEW weights (round close / async push / catch-up merge).
+        # Stamped onto pull-down responses ("wv" body) so a subscriber
+        # can drop a late response that would roll its replica back —
+        # responses to overlapping rounds are flushed with no stripes
+        # held and CAN reorder in flight (the encode of round N's
+        # response races round N+1's close)
+        self.ver = 0
 
 
 class GlobalServer:
@@ -2699,6 +2800,11 @@ class GlobalServer:
         # Lanes are built per merge backend (kvstore/backend.py).
         self._backend = make_merge_backend(self.config,
                                            str(postoffice.node))
+        # device-resident WAN codec stage (ISSUE 20): compressed pushes
+        # decode through jitted kernels straight into device arrays the
+        # merge lanes seed without re-staging (zero full-tensor host
+        # traffic on the push→decode→merge→optimize chain)
+        self._codec_stage = self._backend.make_codec_stage(self.config)
         self._mu, self._shards = make_merge_lanes(
             self.config, f"g{postoffice.node}", self._backend)
         self._ack_mu = threading.Lock()  # leaf lock: a parked push's
@@ -2707,6 +2813,12 @@ class GlobalServer:
         self._pc_mu = threading.RLock()  # leaf lock: the pull
         #                                  compressor's per-subscriber
         #                                  views/caches are not striped
+        self._wv_mu = threading.Lock()   # leaf lock: pairs a store
+        #                                  write with its ver bump so a
+        #                                  responder snapshots (weights,
+        #                                  wv) coherently.  May be taken
+        #                                  under a stripe or _pc_mu;
+        #                                  takes no lock itself
         # ---- failover state (tentpole PR 1) ----
         self.is_standby = bool(standby)
         self.term = 0              # fencing epoch; bumped by promotion
@@ -3226,6 +3338,18 @@ class GlobalServer:
                 lens.append(self.store.length(k)
                             if isinstance(self.store, WeightStore)
                             else len(self.store[k]))
+        if self._codec_stage is not None:
+            # device decode (ISSUE 20): structural gates run host-side
+            # on the small compressed buffer (same CodecError fencing),
+            # then jitted kernels land each gradient as a device array
+            # the merge lanes seed with no re-staging.  Device dispatch
+            # serializes anyway, so the host codec pool buys nothing.
+            with self._tr.span("codec.decode"):
+                vs = [self._codec_stage.decode(msg.compr, k, p, ln, thr)
+                      for (k, p), ln in zip(pairs, lens)]
+                vals = vs[0] if len(vs) == 1 else self._codec_stage.concat(vs)
+            return KVPairs(np.array([k for k, _ in pairs], dtype=np.int64),
+                           vals, np.array(lens, dtype=np.int64))
         pool = codec_pool(self.config) if len(pairs) > 1 else None
         with self._tr.span("codec.decode"):
             if pool is None:
@@ -3288,6 +3412,20 @@ class GlobalServer:
         completed_keys: List[int] = []
         done_mu = threading.Lock()
 
+        # BSP same-sender fence: a party's round-N+1 push can arrive
+        # while round N is still open (WAN pushes pipeline ahead of the
+        # pull-down, and the first device-codec encode JIT-compiles, so
+        # one party's two rounds can outrun another party's first).
+        # Counting it would close round N from ONE party's two pushes —
+        # the global weights still see every gradient, but that party's
+        # pull-down serves a close its peers never reached, rolling its
+        # replica a round behind.  Defer it to the next round instead.
+        # Pre-merged pushes (num_merge > 1) carry several parties under
+        # one sender and HFA deltas are milestone-additive — neither is
+        # sender-gated.
+        sender_s = str(msg.sender)
+        gate = num_merge == 1 and not hfa_delta
+
         def merge_one(k: int, v: np.ndarray):
             k_acks: List[tuple] = []
             k_reparks: List[Message] = []
@@ -3295,17 +3433,24 @@ class GlobalServer:
             opened = False
             with self._mu.stripe(k):
                 st = self._keys.setdefault(k, _GlobalKeyState())
-                if st.accum is None:
-                    st.accum = self._backend.seed(v, msg.donated, key=k)
-                    opened = True
+                if (gate and st.accum is not None
+                        and sender_s in st.contributors):
+                    st.deferred.append((sender_s, v, entry, msg.donated))
                 else:
-                    st.accum = self._backend.accumulate(st.accum, v)
-                st.count += num_merge
-                st.parked_pushes.append(entry)
-                if st.count >= self.num_contributors:
-                    completed = True
-                    self._complete_key_locked(k, hfa_delta, k_acks,
-                                              k_reparks)
+                    if st.accum is None:
+                        st.accum = self._backend.seed(v, msg.donated,
+                                                      key=k)
+                        opened = True
+                    else:
+                        st.accum = self._backend.accumulate(st.accum, v)
+                    st.count += num_merge
+                    st.parked_pushes.append(entry)
+                    if gate:
+                        st.contributors.add(sender_s)
+                    if st.count >= self.num_contributors:
+                        completed = True
+                        self._complete_key_locked(k, hfa_delta, k_acks,
+                                                  k_reparks)
             if opened and self._flight is not None:
                 # a fresh aggregation round opened for this key — the
                 # stall forensic's "who was the round waiting on"
@@ -3344,12 +3489,20 @@ class GlobalServer:
                             "(no checkpoint to resume from)"}
             st.accum = None
             st.count = 0
+            st.contributors.clear()
             with self._ack_mu:
                 for ent in st.parked_pushes:
                     ent[1].discard(k)
                     if not ent[1]:
                         to_ack.append((ent[0], err))
+                # fence-deferred pushes never reached parked_pushes —
+                # fail them the same way, don't hang their senders
+                for _, _, ent, _ in st.deferred:
+                    ent[1].discard(k)
+                    if not ent[1]:
+                        to_ack.append((ent[0], err))
             st.parked_pushes.clear()
+            st.deferred.clear()
             return
         with self._tr.span("global.opt"):
             dev = self._dev_opt
@@ -3361,9 +3514,9 @@ class GlobalServer:
                 # that host consumers materialize on demand
                 raw = self.store.raw(k)
                 if hfa_delta:
-                    self.store[k] = dev.add_delta(raw, st.accum)
+                    new_w = dev.add_delta(raw, st.accum)
                 else:
-                    self.store[k] = dev.step(
+                    new_w = dev.step(
                         k, raw, st.accum, 1.0 / self.num_contributors)
             else:
                 # the weighted mean at round close consumes a HOST
@@ -3374,16 +3527,20 @@ class GlobalServer:
                     # milestone deltas come pre-divided by
                     # num_global_workers; apply additively (ref:
                     # HandleHFAAccumulate :959-972)
-                    self.store[k] = self.store[k] + accum
+                    new_w = self.store[k] + accum
                 else:
                     # accum is donated: update_scaled may build the new
                     # weights in it, skipping the /num temporary and the
                     # result allocation (big-tensor hot path)
-                    self.store[k] = self.optimizer.update_scaled(
+                    new_w = self.optimizer.update_scaled(
                         k, self.store[k], accum,
                         1.0 / self.num_contributors)
+            with self._wv_mu:
+                self.store[k] = new_w
+                st.ver += 1
         st.accum = None
         st.count = 0
+        st.contributors.clear()
         with self._ack_mu:
             for ent in st.parked_pushes:
                 ent[1].discard(k)
@@ -3391,6 +3548,29 @@ class GlobalServer:
                     to_ack.append((ent[0], None))
         st.parked_pushes.clear()
         reparks.extend(self._serve_parked_pulls_locked(k))
+        if st.deferred:
+            # replay pushes the same-sender fence parked for the round
+            # that just opened.  An item whose sender is already in the
+            # NEW round (two deferred rounds from one party) re-defers;
+            # per-sender FIFO is preserved.  A cascade close recurses —
+            # depth is bounded by the backlog / num_contributors
+            backlog, st.deferred = st.deferred, []
+            for item in backlog:
+                d_sender, v, ent, donated = item
+                if st.accum is not None and d_sender in st.contributors:
+                    st.deferred.append(item)
+                    continue
+                if st.accum is None:
+                    st.accum = self._backend.seed(v, donated, key=k)
+                else:
+                    st.accum = self._backend.accumulate(st.accum, v)
+                st.count += 1
+                st.parked_pushes.append(ent)
+                st.contributors.add(d_sender)
+                if st.count >= self.num_contributors:
+                    # _merge_finish only counts the outer close
+                    self.key_rounds += 1
+                    self._complete_key_locked(k, False, to_ack, reparks)
 
     def _merge_finish(self, to_ack: List[tuple],
                       reparks: List[Message],
@@ -3517,19 +3697,27 @@ class GlobalServer:
             for k, v in kvs.slices():
                 k = int(k)
                 grad = v.astype(np.float32)  # copy: donated below
+                if self._dev_opt is None and not isinstance(grad,
+                                                            np.ndarray):
+                    # device-decoded push meeting a HOST optimizer
+                    # engine (DCASGD / opt stage off): one explicit D2H
+                    grad = np.asarray(grad)
                 if self._dev_opt is not None:
                     # async tier on the device stage: one H2D of the
                     # push, jitted update, weights stay device-resident
                     # (DCASGD never constructs a device optimizer — its
                     # per-sender backups are host bookkeeping)
-                    self.store[k] = self._dev_opt.step(
+                    new_w = self._dev_opt.step(
                         k, self.store.raw(k), grad, 1.0)
                 elif isinstance(self.optimizer, DCASGD):
-                    self.store[k] = self.optimizer.update(
+                    new_w = self.optimizer.update(
                         k, self.store[k], grad, sender=str(msg.sender))
                 else:
-                    self.store[k] = self.optimizer.update_scaled(
+                    new_w = self.optimizer.update_scaled(
                         k, self.store[k], grad, 1.0)
+                with self._wv_mu:
+                    self.store[k] = new_w
+                    self._keys.setdefault(k, _GlobalKeyState()).ver += 1
             self.key_rounds += len(kvs.keys)
             if self._flight is not None:
                 self._flight.record(FlightEv.ROUND_COMPLETE,
@@ -3592,15 +3780,21 @@ class GlobalServer:
                 if k not in self.store:
                     continue  # key retired while the party was dark
                 grad = v.astype(np.float32)
+                if self._dev_opt is None and not isinstance(grad,
+                                                            np.ndarray):
+                    grad = np.asarray(grad)  # host optimizer engine
                 if self._dev_opt is not None:
-                    self.store[k] = self._dev_opt.step(
+                    new_w = self._dev_opt.step(
                         k, self.store.raw(k), grad, 1.0)
                 elif isinstance(self.optimizer, DCASGD):
-                    self.store[k] = self.optimizer.update(
+                    new_w = self.optimizer.update(
                         k, self.store[k], grad, sender=str(msg.sender))
                 else:
-                    self.store[k] = self.optimizer.update_scaled(
+                    new_w = self.optimizer.update_scaled(
                         k, self.store[k], grad, 1.0)
+                with self._wv_mu:
+                    self.store[k] = new_w
+                    self._keys.setdefault(k, _GlobalKeyState()).ver += 1
             self.catchup_merges += 1
             self._auto_ckpt_locked(len(kvs.keys))
             if self._repl is not None:
@@ -3669,14 +3863,29 @@ class GlobalServer:
                           or self.compression.get("type") == "fp16"):
             self._respond_pull_compressed(req)
             return
-        ks, vs, ls = [], [], []
+        ks, vs, ls, wvs = [], [], [], {}
         for k in req.keys:
             k = int(k)
-            w = self.store[k]
+            w, wvs[str(k)] = self._weight_wv(k)
             ks.append(k); vs.append(w); ls.append(len(w))
         self.server.response(req, KVPairs(
             np.array(ks, dtype=np.int64), _store_payload(vs),
-            np.array(ls, dtype=np.int64)))
+            np.array(ls, dtype=np.int64)),
+            body={"wv": wvs})
+
+    def _weight_wv(self, k: int):
+        """Coherent ``(weights, weight-version)`` snapshot for a
+        pull-down response.  Writers pair the store write with the ver
+        bump under ``_wv_mu``, so taking it here rules out stamping new
+        weights with an old version (or vice versa) — the subscriber's
+        roll-back guard (:meth:`LocalServer._on_pull_down`) relies on
+        the stamp never under-reporting.  The term rides the high bits:
+        a promoted standby restarts per-key counters at 0 but its
+        bumped term keeps the stamps monotonic across the failover."""
+        with self._wv_mu:
+            st = self._keys.get(k)
+            return self.store[k], ((self.term << 48)
+                                   + (st.ver if st is not None else 0))
 
     def _respond_pull_compressed(self, req: Message):
         """Pull-direction compression (the second half of Bi-Sparse,
@@ -3705,10 +3914,10 @@ class GlobalServer:
         echo = {}
         if isinstance(req.body, dict):
             echo = req.body.get("pv", {}) or {}
-        ks, chunks, ls, tags, pvs = [], [], [], {}, {}
+        ks, chunks, ls, tags, pvs, wvs = [], [], [], {}, {}, {}
         for k in req.keys:
             k = int(k)
-            w = self.store[k]
+            w, wvs[str(k)] = self._weight_wv(k)
             if typ == "fp16" or (size_bound and len(w) < size_bound):
                 payload = w.astype(np.float16)
                 tags[str(k)] = "fp16"
@@ -3726,7 +3935,7 @@ class GlobalServer:
             req,
             KVPairs(np.array(ks, dtype=np.int64), np.concatenate(chunks),
                     np.array(ls, dtype=np.int64)),
-            body={"compr": tags, "pv": pvs},
+            body={"compr": tags, "pv": pvs, "wv": wvs},
         )
 
     def _on_set_wan_policy(self, msg: Message, body: dict):
@@ -4525,6 +4734,12 @@ class GlobalServer:
                 out.get("d2h_bytes") or 0)
             system_gauge(f"{self.po.node}.opt_device_ms").set(
                 out.get("opt_device_ms") or 0)
+            # codec stage (ISSUE 20): decode kernel time + wire-ready
+            # compressed D2H — host_copy auditing rides the same stats
+            system_gauge(f"{self.po.node}.codec_device_ms").set(
+                out.get("codec_device_ms") or 0)
+            system_gauge(f"{self.po.node}.codec_d2h_bytes").set(
+                out.get("codec_d2h_bytes") or 0)
         return out
 
     def stop(self):

@@ -39,6 +39,10 @@ class KVPairs:
     pv: Optional[dict] = None             # int key -> pull-view version
     #                                       (BSC pull handshake; see
     #                                       BroadcastCompressor.compress)
+    wv: Optional[dict] = None             # int key -> weight version
+    #                                       (global pull-down ordering
+    #                                       stamp; see GlobalServer.
+    #                                       _weight_wv)
 
     def __post_init__(self):
         self.keys = np.asarray(self.keys, dtype=np.int64)
@@ -479,16 +483,18 @@ class KVWorker(_App):
         ts = msg.timestamp
         if msg.keys is not None and msg.vals is not None:
             # pull (or push_pull) response carrying data
-            tags = pv = None
+            tags = pv = wv = None
             if isinstance(msg.body, dict) and "compr" in msg.body:
                 tags = {int(k): t for k, t in msg.body["compr"].items()}
             if isinstance(msg.body, dict) and "pv" in msg.body:
                 pv = {int(k): int(v) for k, v in msg.body["pv"].items()}
+            if isinstance(msg.body, dict) and "wv" in msg.body:
+                wv = {int(k): int(v) for k, v in msg.body["wv"].items()}
             with self._mu:
                 buf = self._pull_bufs.get(ts)
                 if buf is not None:
                     buf.append(KVPairs(msg.keys, msg.vals, msg.lens,
-                                       tags=tags, pv=pv))
+                                       tags=tags, pv=pv, wv=wv))
                     done = len(buf) == self._pull_expected.get(ts, -1)
                 else:
                     done = False
@@ -516,11 +522,14 @@ class KVWorker(_App):
         ks, vs, ls = [], [], []
         tags: dict = {}
         pv: dict = {}
+        wv: dict = {}
         for p in parts:
             if p.tags:
                 tags.update(p.tags)
             if p.pv:
                 pv.update(p.pv)
+            if p.wv:
+                wv.update(p.wv)
             for k, v in p.slices():
                 ks.append(k); vs.append(v); ls.append(len(v))
         order = np.argsort(np.asarray(ks, dtype=np.int64), kind="stable")
@@ -528,7 +537,8 @@ class KVWorker(_App):
         vals = (np.concatenate([vs[i] for i in order])
                 if vs else np.empty(0, np.float32))
         lens = np.asarray(ls, dtype=np.int64)[order]
-        return KVPairs(keys, vals, lens, tags=tags or None, pv=pv or None)
+        return KVPairs(keys, vals, lens, tags=tags or None, pv=pv or None,
+                       wv=wv or None)
 
 
 class KVServer(_App):

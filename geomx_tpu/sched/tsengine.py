@@ -25,6 +25,7 @@ message.h:135-136).
 
 from __future__ import annotations
 
+import queue
 import random
 import threading
 import time
@@ -34,6 +35,17 @@ from typing import Dict, List, Optional, Sequence
 from geomx_tpu.core.config import Config, NodeId
 from geomx_tpu.ps.postoffice import Postoffice
 from geomx_tpu.transport.message import Control, Domain, Message
+from geomx_tpu.transport.reactor import Periodic, resolve_reactor_workers
+
+# Lightweight mode runs dissemination jobs on the shared reactor pool,
+# and a job PARKS its worker across scheduler/ack round-trips (bounded
+# by ts_ask_timeout_s).  Cap how many may park at once to half the pool:
+# relays beyond the cap simply stay queued until a slot frees, so the
+# reply/ack handler channels can always find a worker — without the cap,
+# enough concurrent relays would occupy every worker and stall the very
+# replies they are waiting on until timeout.
+_DISSEM_SLOTS = threading.BoundedSemaphore(
+    max(2, resolve_reactor_workers() // 2))
 
 
 class TsScheduler:
@@ -161,14 +173,27 @@ class TsClient:
         self._ack_order: "collections.deque" = collections.deque()
         self._seq = 0
         postoffice.add_control_hook(self._on_control)
-        # dissemination runs on a dedicated thread: the ask/send loop
-        # blocks on round-trips, and blocking a customer/handler thread
-        # deadlocks when two nodes relay to each other concurrently
+        # dissemination must never run on a customer/handler dispatch
+        # lane: the ask/send loop blocks on round-trips, and blocking a
+        # handler deadlocks when two nodes relay to each other
+        # concurrently.  Lightweight mode folds the job queue onto the
+        # reactor timer wheel (a Periodic tick drains it on the worker
+        # pool, slot-capped by _DISSEM_SLOTS); the threaded transport
+        # keeps the dedicated per-node drain thread.
         self._dq: "_queue.Queue" = _queue.Queue()
-        self._dissem_thread = threading.Thread(
-            target=self._dissem_loop, daemon=True,
-            name=f"ts-dissem-{postoffice.node}")
-        self._dissem_thread.start()
+        self._dissem_thread = None
+        self._dissem_task = None
+        fabric = getattr(postoffice.van, "fabric", None)
+        reactor = getattr(fabric, "reactor", None)
+        if getattr(fabric, "lightweight", False) and reactor is not None:
+            self._dissem_task = Periodic(
+                0.005, self._drain_dissem,
+                name=f"ts-dissem-{postoffice.node}", reactor=reactor)
+        else:
+            self._dissem_thread = threading.Thread(
+                target=self._dissem_loop, daemon=True,
+                name=f"ts-dissem-{postoffice.node}")
+            self._dissem_thread.start()
 
     def disseminate_async(self, keys, vals, lens, it: str, cmd: int):
         """Queue a relay round: ask the scheduler for receivers and send
@@ -181,22 +206,47 @@ class TsClient:
             job = self._dq.get()
             if job is None:
                 return
-            keys, vals, lens, it, cmd = job
-            last, thr = None, None
-            try:
-                while True:
-                    recv = self.ask_receiver(it, last, thr)
-                    if recv is None:
-                        break
-                    thr = self.send_model(recv, keys, vals, lens, it, cmd)
-                    last = str(recv)
-            except TimeoutError:  # pragma: no cover - surfaced in logs
-                import logging
+            self._run_dissem(job)
 
-                logging.getLogger(__name__).warning(
-                    "%s: TS dissemination round %s aborted", self.po.node, it)
+    def _drain_dissem(self):
+        """One timer-wheel tick: run queued dissemination rounds on this
+        pool worker, as long as a park slot is free.  A job left queued
+        by slot exhaustion is retried next tick — relays are latency-
+        tolerant (the overlay already pipelines hops)."""
+        while True:
+            if not _DISSEM_SLOTS.acquire(blocking=False):
+                return  # pool protection: stay queued, retry next tick
+            try:
+                try:
+                    job = self._dq.get_nowait()
+                except queue.Empty:
+                    return
+                if job is None:
+                    continue  # stop() sentinel
+                self._run_dissem(job)
+            finally:
+                _DISSEM_SLOTS.release()
+
+    def _run_dissem(self, job):
+        keys, vals, lens, it, cmd = job
+        last, thr = None, None
+        try:
+            while True:
+                recv = self.ask_receiver(it, last, thr)
+                if recv is None:
+                    break
+                thr = self.send_model(recv, keys, vals, lens, it, cmd)
+                last = str(recv)
+        except TimeoutError:  # pragma: no cover - surfaced in logs
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s: TS dissemination round %s aborted", self.po.node, it)
 
     def stop(self):
+        if self._dissem_task is not None:
+            self._dissem_task.stop()
+            self._dissem_task = None
         self._dq.put(None)
 
     def _on_control(self, msg: Message) -> bool:

@@ -54,14 +54,19 @@ _VALID_TRANSPORTS = ("threads", "reactor")
 def resolve_transport(config=None) -> str:
     """The effective transport engine: ``Config.transport`` when set,
     else the ``GEOMX_TRANSPORT`` env (so a whole test suite can be
-    shaken under the reactor fabric — ``GEOMX_TRANSPORT=reactor
+    shaken under the threaded fabric — ``GEOMX_TRANSPORT=threads
     pytest ...`` — without threading the knob through every fixture,
     the way GEOMX_SERVER_SHARDS / GEOMX_GLOBAL_SHARDS work), default
-    ``threads``."""
+    ``reactor``.
+
+    The reactor became the default after the flip checklist in
+    docs/perf.md "Default-flip evidence" closed (clean blocking audits,
+    full-suite parity, measured scaling); ``GEOMX_TRANSPORT=threads``
+    stays supported as the escape hatch."""
     t = str(getattr(config, "transport", "") or "") if config is not None \
         else ""
     if not t:
-        t = os.environ.get("GEOMX_TRANSPORT", "") or "threads"
+        t = os.environ.get("GEOMX_TRANSPORT", "") or "reactor"
     t = t.strip().lower()
     if t not in _VALID_TRANSPORTS:
         raise ValueError(
@@ -478,7 +483,13 @@ class Reactor:
 
     # ---- handler pool --------------------------------------------------------
     def submit(self, fn: Callable[[], None]):
-        self._pool.submit(self._guard, fn)
+        try:
+            self._pool.submit(self._guard, fn)
+        except RuntimeError:
+            # raced stop(): a timer tick fired while the pool was
+            # shutting down — dropping it matches the thread-loop
+            # semantics (a stopped loop simply never runs its next turn)
+            pass
 
     @staticmethod
     def _guard(fn):

@@ -582,6 +582,7 @@ class Van:
         self._chan = None  # lightweight-mode serial dispatch channel
         self._resend_task = None  # timer-wheel resend entry
         self._send_thread: Optional[threading.Thread] = None
+        self._send_task = None  # timer-wheel priority drain (lightweight)
         self._pq: "queue.PriorityQueue" = queue.PriorityQueue()
         self._pq_tie = itertools.count()
         self.use_priority_queue = use_priority_queue
@@ -660,10 +661,27 @@ class Van:
             )
             self._recv_thread.start()
         if self._use_send_thread:
-            self._send_thread = threading.Thread(
-                target=self._send_loop, name=f"van-send-{self.node}", daemon=True
-            )
-            self._send_thread.start()
+            if getattr(self.fabric, "lightweight", False):
+                # timer-wheel drain instead of a per-node priority
+                # thread: each tick pops everything queued (highest
+                # priority first) and transmits on a pool worker.
+                # Periodic skips overlapping ticks, so a bandwidth-
+                # shaped deliver() sleep still serializes transmissions
+                # exactly as the dedicated drain thread did — and the
+                # between-tick dwell is where later high-priority
+                # messages overtake queued ones (the P3 reorder window).
+                from geomx_tpu.transport.reactor import Periodic
+
+                self._send_task = Periodic(
+                    0.002, self._drain_pq,
+                    name=f"van-send-{self.node}",
+                    reactor=self.fabric.reactor)
+            else:
+                self._send_thread = threading.Thread(
+                    target=self._send_loop, name=f"van-send-{self.node}",
+                    daemon=True
+                )
+                self._send_thread.start()
         if self._resend_timeout > 0:
             reactor = getattr(self.fabric, "reactor", None)
             if reactor is not None:
@@ -705,6 +723,9 @@ class Van:
             stopper = Message(sender=self.node, recipient=self.node,
                               control=Control.TERMINATE)
             self._box.put(stopper)
+        if self._send_task is not None:
+            self._send_task.stop()
+            self._send_task = None
         if self._use_send_thread:
             self._pq.put((0, next(self._pq_tie), None))
         if self._recv_thread:
@@ -853,6 +874,26 @@ class Van:
                 return
             if tie < self._max_popped_tie:
                 self.pq_overtakes += 1  # enqueued before one already sent
+            else:
+                self._max_popped_tie = tie
+            self._send_now(msg)
+
+    def _drain_pq(self):
+        """Lightweight-mode priority drain (one timer-wheel tick): pop
+        everything queued right now, highest priority first.  Runs on
+        the reactor worker pool; a bandwidth-shaped ``deliver()`` may
+        park this worker for the transmission — bounded by the link
+        model, and the skipped-tick rule keeps at most one drain
+        in flight per van."""
+        while self._running:
+            try:
+                _, tie, msg = self._pq.get_nowait()
+            except queue.Empty:
+                return
+            if msg is None:
+                continue  # stop() sentinel from a prior incarnation
+            if tie < self._max_popped_tie:
+                self.pq_overtakes += 1
             else:
                 self._max_popped_tie = tie
             self._send_now(msg)

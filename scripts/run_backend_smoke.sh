@@ -16,8 +16,15 @@
 # moments, proving the export_state/import_state snapshot hooks carry
 # the trajectory across all of them.
 #
+# Since ISSUE 20 the sweep also pins the DEVICE CODEC STAGE on
+# (GEOMX_CODEC_DEVICE=1, the default) and adds the codec / adaptive-WAN
+# / device-codec suites: every compression rung (fp16/2bit/bsc/mpq)
+# encodes from the device accumulator and decodes into device merge
+# buffers, with the numpy codecs cross-checked bitwise by
+# tests/test_device_codec.py.
+#
 # Env: PYTEST_ARGS (extra pytest flags), GEOMX_MERGE_BACKEND (default jax),
-#      GEOMX_MERGE_OPT_DEVICE (default 1)
+#      GEOMX_MERGE_OPT_DEVICE (default 1), GEOMX_CODEC_DEVICE (default 1)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -25,10 +32,13 @@ export JAX_PLATFORMS=cpu
 export JAX_PLATFORM_NAME=cpu
 export GEOMX_MERGE_BACKEND=${GEOMX_MERGE_BACKEND:-jax}
 export GEOMX_MERGE_OPT_DEVICE=${GEOMX_MERGE_OPT_DEVICE:-1}
+export GEOMX_CODEC_DEVICE=${GEOMX_CODEC_DEVICE:-1}
 
 exec python -m pytest -q -m 'not slow' -p no:cacheprovider \
   tests/test_kvstore.py tests/test_failover.py tests/test_eviction.py \
   tests/test_sharded_merge.py tests/test_recovery.py \
   tests/test_sharded_global.py \
   tests/test_merge_backend.py tests/test_device_opt.py \
+  tests/test_compression.py tests/test_adaptive_wan.py \
+  tests/test_device_codec.py \
   ${PYTEST_ARGS:-}

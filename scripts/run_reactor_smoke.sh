@@ -1,11 +1,13 @@
 #!/usr/bin/env bash
 # Event-driven transport smoke lane (ISSUE 12 satellite): run the
 # transport + kvstore/failover/eviction/sharded-global parity subset
-# with GEOMX_TRANSPORT=reactor, so the reactor fabric (selector loops,
-# write queues, timer wheel) and the lightweight-party dispatch path
-# cannot silently rot while tier-1 runs the default threads transport.
-# In-proc Simulations flip into lightweight mode under this knob;
-# TcpFabric tests exercise the real non-blocking wire path.
+# under the reactor fabric (selector loops, write queues, timer wheel)
+# and the lightweight-party dispatch path.  Since ISSUE 20 the reactor
+# IS the process default (resolve_transport), so this lane inherits it
+# — GEOMX_TRANSPORT=reactor is still pinned below so the lane keeps its
+# meaning even if someone exports the threads escape hatch in their
+# shell.  In-proc Simulations flip into lightweight mode under this
+# knob; TcpFabric tests exercise the real non-blocking wire path.
 #
 # Env: PYTEST_ARGS (extra pytest flags), GEOMX_REACTOR_LOOPS (loop pool
 # size, default auto = min(4, cpus)), GEOMX_REACTOR_WORKERS (handler

@@ -33,7 +33,10 @@ import pytest  # noqa: E402
 
 # Thread names that are allowed to outlive a Simulation: process-
 # lifetime shared pools (fixed-size, O(1) in node count, by design
-# never torn down) plus interpreter/jax internals.
+# never torn down) plus interpreter/jax internals.  Per-node loops
+# (van-recv/van-send/van-resend/ts-dissem/heartbeat/monitors) are NOT
+# listed: under the reactor default they are timer-wheel entries, and
+# under GEOMX_TRANSPORT=threads they must stop with their Simulation.
 _PROCESS_LIFETIME_THREADS = (
     "geomx-reactor",   # shared reactor loops + handler pool
     "geomx-codec",     # shared codec pool (kvstore/common.py)

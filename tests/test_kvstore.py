@@ -405,6 +405,96 @@ def test_master_worker_drives_configuration():
         sim.shutdown()
 
 
+def test_global_same_sender_round_fence():
+    """BSP same-sender fence on the global sync merge: a party's
+    round-N+1 push arriving while round N is still open (WAN pushes
+    pipeline; a slow peer encode widens the window) must DEFER to the
+    next round — merging it would close round N from one party's two
+    pushes and serve that party a close its peers never reached."""
+    from geomx_tpu.kvstore.common import Cmd
+    from geomx_tpu.ps.kv_app import KVPairs
+    from geomx_tpu.transport.message import Message
+
+    sim = make_sim(parties=2, workers=1)
+    try:
+        ws = sim.all_workers()
+        for w in ws:
+            w.init(0, np.zeros(8, np.float32))
+        for w in ws:
+            w.wait_all()
+        gs = sim.global_servers[0]
+        gs.server.response = lambda *a, **k: None  # merge only, no wire
+        key = int(next(iter(gs.store)))
+
+        def push(sender, ts):
+            m = Message(sender=sender, recipient=gs.po.node, push=True,
+                        request=True, timestamp=ts, cmd=Cmd.DEFAULT,
+                        keys=np.array([key], np.int64),
+                        vals=np.ones(8, np.float32),
+                        lens=np.array([8], np.int64))
+            gs._push_sync(m, KVPairs(m.keys, m.vals, m.lens))
+
+        base_rounds = gs.key_rounds
+        push("server:0@p0", 101)
+        push("server:0@p0", 102)  # same sender, round still open
+        assert gs._shards.drain(10)
+        st = gs._keys[key]
+        assert st.count == 1, "second same-sender push merged into " \
+                              "the open round"
+        assert len(st.deferred) == 1
+        assert gs.key_rounds == base_rounds  # round 1 still open
+        push("server:0@p1", 101)  # peer's push closes round 1
+        assert gs._shards.drain(10)
+        # the deferred push replayed into round 2: open, count 1
+        assert gs.key_rounds == base_rounds + 1
+        assert st.count == 1 and not st.deferred
+        assert "server:0@p0" in st.contributors
+        push("server:0@p1", 102)  # closes round 2
+        assert gs._shards.drain(10)
+        assert gs.key_rounds == base_rounds + 2
+        assert st.count == 0 and not st.contributors
+        # weight-version stamp: one bump per close, coherent snapshot
+        _, wv = gs._weight_wv(key)
+        assert wv == (gs.term << 48) + st.ver and st.ver >= 2
+    finally:
+        sim.shutdown()
+
+
+def test_pull_down_drops_stale_weight_version():
+    """Receiver half of the ordering guard: pull-down responses are
+    flushed with no stripes held and CAN reorder in flight; a response
+    stamped strictly older than the last applied weight version must
+    be dropped (applying it would roll the replica back a round)."""
+    from geomx_tpu.ps.kv_app import KVPairs
+
+    sim = make_sim(parties=1, workers=1)
+    try:
+        w = sim.all_workers()[0]
+        w.init(0, np.zeros(8, np.float32))
+        w.push(0, np.ones(8, np.float32))
+        w.wait_all()
+        w.pull_sync(0)
+        ls = sim.local_servers[0]
+        key = int(next(iter(ls.store)))
+        fresh = np.full(8, -2.0, np.float32)
+        stale = np.full(8, -1.0, np.float32)
+        skips = ls.stale_pull_skips
+        ls._on_pull_down(KVPairs(np.array([key], np.int64), fresh,
+                                 np.array([8], np.int64), wv={key: 7}))
+        np.testing.assert_array_equal(ls.store[key], fresh)
+        # the late round-N response (older stamp) must NOT roll back
+        ls._on_pull_down(KVPairs(np.array([key], np.int64), stale,
+                                 np.array([8], np.int64), wv={key: 6}))
+        np.testing.assert_array_equal(ls.store[key], fresh)
+        assert ls.stale_pull_skips == skips + 1
+        # an equal stamp is the same weights — re-applying is fine
+        ls._on_pull_down(KVPairs(np.array([key], np.int64), fresh.copy(),
+                                 np.array([8], np.int64), wv={key: 7}))
+        np.testing.assert_array_equal(ls.store[key], fresh)
+    finally:
+        sim.shutdown()
+
+
 def test_merged_round_parks_member_pulls_until_complete():
     """advisor r5: during a PARTIAL TS-merged round (some push carried
     num_merge>1, so count > distinct senders) an established member's

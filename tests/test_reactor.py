@@ -66,6 +66,8 @@ def free_base_port(span: int = 16):
 
 def test_resolve_transport_precedence(monkeypatch):
     monkeypatch.delenv("GEOMX_TRANSPORT", raising=False)
+    assert resolve_transport(None) == "reactor"  # the ISSUE 20 default
+    monkeypatch.setenv("GEOMX_TRANSPORT", "threads")  # escape hatch
     assert resolve_transport(None) == "threads"
     monkeypatch.setenv("GEOMX_TRANSPORT", "reactor")
     assert resolve_transport(None) == "reactor"
@@ -326,19 +328,26 @@ def test_lightweight_thread_count_is_o1_in_party_count():
 
 
 def test_timer_wheel_absorbs_heartbeat_and_resend_threads():
-    """With heartbeats + the resender on, a lightweight sim must run
-    ZERO per-node timer threads (heartbeat-* / van-resend-*) and zero
-    per-node dispatch threads (van-recv-* / customer-*) — they all
+    """With heartbeats + the resender on — plus a bandwidth-shaped
+    fabric (priority send queues) and the intra-party TS overlay
+    (dissemination clients) — a lightweight sim must run ZERO per-node
+    timer threads (heartbeat-* / van-resend-*), zero per-node dispatch
+    threads (van-recv-* / customer-*), and zero per-node drain threads
+    (van-send-* / ts-dissem-*, the two PR 12 left behind) — they all
     live on the shared wheel/pool — while heartbeats still arrive at
     the schedulers."""
+    from geomx_tpu.transport.van import FaultPolicy
+
     before = set(threading.enumerate())  # earlier tests' stop-flagged
     #                                      loops may still be winding down
     cfg = Config(topology=Topology(num_parties=2, workers_per_party=2),
                  heartbeat_interval_s=0.05, resend_timeout_ms=200,
-                 enable_flight=False)
-    sim = Simulation(cfg, lightweight=True)
+                 enable_intra_ts=True, enable_flight=False)
+    sim = Simulation(cfg, lightweight=True,
+                     fault=FaultPolicy(wan_bandwidth_bps=1e12))
     try:
         banned = ("heartbeat-", "van-resend-", "van-recv-", "customer-",
+                  "van-send-", "ts-dissem-",
                   "WorkerEvictionMonitor", "LocalServerRecoveryMonitor",
                   "metrics-pump-")
         names = [t.name for t in threading.enumerate() if t not in before]

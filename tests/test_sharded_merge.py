@@ -177,10 +177,12 @@ def test_pull_not_blocked_behind_other_keys_merge():
     lane is stuck, a pull of key A must still be served (split pull
     lane routes it around the push queue; stripes keep A's state free).
     This is the sharded half of the split_pull_queue guarantee — the
-    single-lock half lives in test_robustness.py."""
+    single-lock half lives in test_robustness.py.  lightweight=False:
+    lightweight mode runs merge lanes inline with server_shards forced
+    to 1 — the sharded configuration under test doesn't exist there."""
     cfg = Config(topology=Topology(num_parties=1, workers_per_party=2),
                  server_shards=4)
-    sim = Simulation(cfg)
+    sim = Simulation(cfg, lightweight=False)
     try:
         ws = sim.all_workers()
         ws[0].set_optimizer({"type": "sgd", "lr": 1.0})
